@@ -1,0 +1,15 @@
+"""alphafold2-tpu: a TPU-native (JAX/XLA/Pallas/pjit) protein-structure
+framework with the capabilities of lucidrains/alphafold2.
+
+Public API parity with the reference
+(/root/reference/alphafold2_pytorch/__init__.py:1):
+    from alphafold2_tpu import Alphafold2, Evoformer
+"""
+
+__version__ = "0.1.0"
+
+from alphafold2_tpu import constants  # noqa: F401
+
+# Model classes are imported lazily-but-eagerly here; they only require jax.
+from alphafold2_tpu.model.alphafold2 import Alphafold2  # noqa: F401
+from alphafold2_tpu.model.evoformer import Evoformer  # noqa: F401

@@ -1,0 +1,186 @@
+"""Global constants for alphafold2-tpu.
+
+Capability parity with the reference constants module
+(/root/reference/alphafold2_pytorch/constants.py:5-113): bucket counts,
+embedding dims, the 14-atom-per-residue sidechainnet layout and per-residue
+covalent-bond graphs. Unlike the reference there is no global mutable DEVICE
+(constants.py:29-30 there) — JAX manages placement via jit/sharding.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Model-level constants (reference constants.py:5-15)
+# ---------------------------------------------------------------------------
+
+MAX_NUM_MSA = 20
+MAX_NUM_TEMPLATES = 10
+NUM_AMINO_ACIDS = 21
+NUM_EMBEDDS_TR = 1280  # ESM-1b embedding width
+NUM_EMBEDDS_T5 = 1024  # ProtT5-XL embedding width
+NUM_COORDS_PER_RES = 14  # sidechainnet atom slots per residue
+
+DISTOGRAM_BUCKETS = 37
+THETA_BUCKETS = 25
+PHI_BUCKETS = 13
+OMEGA_BUCKETS = 25
+
+# Distogram bin edges span 2..20 Angstrom (reference utils.py:41,47)
+DISTOGRAM_MIN_DIST = 2.0
+DISTOGRAM_MAX_DIST = 20.0
+
+IGNORE_INDEX = -100
+
+# ---------------------------------------------------------------------------
+# Pretrained-embedding constants (reference constants.py:19-25)
+# ---------------------------------------------------------------------------
+
+MSA_EMBED_DIM = 768
+MSA_MODEL_PATH = ["facebookresearch/esm", "esm_msa1_t12_100M_UR50S"]
+
+ESM_EMBED_DIM = 1280
+ESM_MODEL_PATH = ["facebookresearch/esm", "esm1b_t33_650M_UR50S"]
+
+PROTTRAN_EMBED_DIM = 1024
+
+# ---------------------------------------------------------------------------
+# Amino-acid vocabulary (sidechainnet ordering) and atom layout
+# ---------------------------------------------------------------------------
+
+# Sidechainnet / proteinnet ordering: alphabetical by 3-letter code, then pad.
+AA_ALPHABET = "ARNDCQEGHILKMFPSTWYV_"
+
+ONE_TO_THREE = {
+    "A": "ALA", "R": "ARG", "N": "ASN", "D": "ASP", "C": "CYS",
+    "Q": "GLN", "E": "GLU", "G": "GLY", "H": "HIS", "I": "ILE",
+    "L": "LEU", "K": "LYS", "M": "MET", "F": "PHE", "P": "PRO",
+    "S": "SER", "T": "THR", "W": "TRP", "Y": "TYR", "V": "VAL",
+}
+
+THREE_TO_ONE = {v: k for k, v in ONE_TO_THREE.items()}
+
+# Sidechain atom names beyond the N/CA/C/O backbone, in sidechainnet build
+# order (slot 4 onward of the 14-atom layout).
+SIDECHAIN_ATOMS = {
+    "ALA": ["CB"],
+    "ARG": ["CB", "CG", "CD", "NE", "CZ", "NH1", "NH2"],
+    "ASN": ["CB", "CG", "OD1", "ND2"],
+    "ASP": ["CB", "CG", "OD1", "OD2"],
+    "CYS": ["CB", "SG"],
+    "GLN": ["CB", "CG", "CD", "OE1", "NE2"],
+    "GLU": ["CB", "CG", "CD", "OE1", "OE2"],
+    "GLY": [],
+    "HIS": ["CB", "CG", "ND1", "CD2", "CE1", "NE2"],
+    "ILE": ["CB", "CG1", "CG2", "CD1"],
+    "LEU": ["CB", "CG", "CD1", "CD2"],
+    "LYS": ["CB", "CG", "CD", "CE", "NZ"],
+    "MET": ["CB", "CG", "SD", "CE"],
+    "PHE": ["CB", "CG", "CD1", "CD2", "CE1", "CE2", "CZ"],
+    "PRO": ["CB", "CG", "CD"],
+    "SER": ["CB", "OG"],
+    "THR": ["CB", "OG1", "CG2"],
+    "TRP": ["CB", "CG", "CD1", "CD2", "NE1", "CE2", "CE3", "CZ2", "CZ3", "CH2"],
+    "TYR": ["CB", "CG", "CD1", "CD2", "CE1", "CE2", "CZ", "OH"],
+    "VAL": ["CB", "CG1", "CG2"],
+}
+
+BACKBONE_ATOMS = ["N", "CA", "C", "O"]
+
+# Per-residue covalent-bond graphs over the 14-slot atom layout
+# (reference constants.py:34-113).  Slot 0..3 = N,CA,C,O; 4.. = sidechain.
+AA_DATA = {
+    "A": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4]]},
+    "R": {"bonds": [[0, 1], [1, 2], [2, 3], [2, 4], [4, 5], [5, 6],
+                    [6, 7], [7, 8], [8, 9], [8, 10]]},
+    "N": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4], [4, 5], [5, 6], [5, 7]]},
+    "D": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4], [4, 5], [5, 6], [5, 7]]},
+    "C": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4], [4, 5]]},
+    "Q": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4], [4, 5], [5, 6],
+                    [6, 7], [6, 8]]},
+    "E": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4], [4, 5], [5, 6],
+                    [6, 7], [7, 8]]},
+    "G": {"bonds": [[0, 1], [1, 2], [2, 3]]},
+    "H": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4], [4, 5], [5, 6],
+                    [6, 7], [7, 8], [8, 9], [5, 9]]},
+    "I": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4], [4, 5], [5, 6], [4, 7]]},
+    "L": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4], [4, 5], [5, 6], [5, 7]]},
+    "K": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4], [4, 5], [5, 6],
+                    [6, 7], [7, 8]]},
+    "M": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4], [4, 5], [5, 6], [6, 7]]},
+    "F": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4], [4, 5], [5, 6],
+                    [6, 7], [7, 8], [8, 9], [9, 10], [5, 10]]},
+    "P": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4], [4, 5], [5, 6], [0, 6]]},
+    "S": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4], [4, 5]]},
+    "T": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4], [4, 5], [4, 6]]},
+    "W": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4], [4, 5], [5, 6],
+                    [6, 7], [7, 8], [8, 9], [9, 10], [10, 11], [11, 12],
+                    [12, 13], [5, 13], [8, 13]]},
+    "Y": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4], [4, 5], [5, 6],
+                    [6, 7], [7, 8], [8, 9], [8, 10], [10, 11], [5, 11]]},
+    "V": {"bonds": [[0, 1], [1, 2], [2, 3], [1, 4], [4, 5], [4, 6]]},
+    "_": {"bonds": []},
+}
+
+
+def _build_atom_ids() -> dict:
+    """Token id per atom name (reference utils.py:108-116): sorted unique set
+    of backbone + sidechain names plus the empty-slot token ''."""
+    names = {"", "N", "CA", "C", "O"}
+    for atoms in SIDECHAIN_ATOMS.values():
+        names.update(atoms)
+    return {name: i for i, name in enumerate(sorted(names))}
+
+
+ATOM_IDS = _build_atom_ids()
+NUM_ATOM_TOKENS = len(ATOM_IDS)
+
+
+def _cloud_mask(aa: str) -> np.ndarray:
+    """Occupied atom slots of the 14-slot layout (reference utils.py:118-127)."""
+    mask = np.zeros(NUM_COORDS_PER_RES, dtype=np.float32)
+    if aa == "_":
+        return mask
+    n_atoms = 4 + len(SIDECHAIN_ATOMS[ONE_TO_THREE[aa]])
+    mask[:n_atoms] = 1
+    return mask
+
+
+def _atom_id_embedds(aa: str) -> np.ndarray:
+    """Atom-token id per slot (reference utils.py:129-139)."""
+    ids = np.zeros(NUM_COORDS_PER_RES, dtype=np.int32)
+    if aa == "_":
+        return ids
+    atoms = BACKBONE_ATOMS + SIDECHAIN_ATOMS[ONE_TO_THREE[aa]]
+    for i, atom in enumerate(atoms):
+        ids[i] = ATOM_IDS[atom]
+    return ids
+
+
+CUSTOM_INFO = {
+    aa: {"cloud_mask": _cloud_mask(aa), "atom_id_embedd": _atom_id_embedds(aa)}
+    for aa in AA_ALPHABET
+}
+
+# Dense (21, 14) lookup tables indexed by token id — TPU-friendly gathers
+# instead of per-residue Python dict lookups.
+CLOUD_MASK_TABLE = np.stack(
+    [CUSTOM_INFO[aa]["cloud_mask"] for aa in AA_ALPHABET]
+)
+ATOM_ID_TABLE = np.stack(
+    [CUSTOM_INFO[aa]["atom_id_embedd"] for aa in AA_ALPHABET]
+)
+
+# Dense bond-adjacency lookup: (21, 14, 14) symmetric 0/1 per residue type.
+def _bond_adjacency() -> np.ndarray:
+    adj = np.zeros((len(AA_ALPHABET), NUM_COORDS_PER_RES, NUM_COORDS_PER_RES),
+                   dtype=np.float32)
+    for idx, aa in enumerate(AA_ALPHABET):
+        for i, j in AA_DATA[aa]["bonds"]:
+            adj[idx, i, j] = 1.0
+            adj[idx, j, i] = 1.0
+    return adj
+
+
+BOND_ADJACENCY_TABLE = _bond_adjacency()

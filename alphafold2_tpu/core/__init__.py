@@ -1,0 +1,2 @@
+from alphafold2_tpu.core import geometry, quaternion, rigid  # noqa: F401
+from alphafold2_tpu.core.rigid import Rigid  # noqa: F401

@@ -1,0 +1,317 @@
+"""Geometry and structure-quality metrics, pure JAX.
+
+Capability parity with the reference geometry layer
+(/root/reference/alphafold2_pytorch/utils.py:45-50, 718-761, 881-1247,
+1254-1344) — distance binning, distogram centering, dihedrals, Kabsch
+alignment, RMSD / GDT / TM-score / lDDT, and the distance-matrix loss.
+
+TPU-first design notes:
+- everything is batched, mask-aware and static-shaped (no boolean indexing —
+  the torch reference's `t[mask]` patterns do not compile under XLA);
+- all functions are differentiable and `jit`/`vmap`-compatible;
+- convention: coordinates are (..., N, 3) ("points-last-dim"), unlike the
+  reference's (B, 3, N). The wrappers in this module accept (..., N, 3).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu import constants
+
+# ---------------------------------------------------------------------------
+# Pairwise distances & distogram targets
+# ---------------------------------------------------------------------------
+
+
+def cdist(x: jnp.ndarray, y: jnp.ndarray, eps: float = 1e-12) -> jnp.ndarray:
+    """Pairwise Euclidean distances. x: (..., N, D), y: (..., M, D)."""
+    d2 = jnp.sum((x[..., :, None, :] - y[..., None, :, :]) ** 2, axis=-1)
+    # sqrt has an unstable gradient at exactly 0 (the diagonal); clamp.
+    return jnp.sqrt(jnp.maximum(d2, eps))
+
+
+def distogram_boundaries(
+    num_buckets: int = constants.DISTOGRAM_BUCKETS,
+    min_dist: float = constants.DISTOGRAM_MIN_DIST,
+    max_dist: float = constants.DISTOGRAM_MAX_DIST,
+) -> jnp.ndarray:
+    """linspace(2, 20, B); reference utils.py:41,47."""
+    return jnp.linspace(min_dist, max_dist, num_buckets)
+
+
+def bucketed_distance_matrix(
+    coords: jnp.ndarray,
+    mask: jnp.ndarray,
+    num_buckets: int = constants.DISTOGRAM_BUCKETS,
+    ignore_index: int = constants.IGNORE_INDEX,
+) -> jnp.ndarray:
+    """Distogram CE targets (reference utils.py:45-50).
+
+    coords: (..., N, 3); mask: (..., N) bool. Returns (..., N, N) int32 with
+    `ignore_index` outside the pair mask.
+    """
+    distances = cdist(coords, coords)
+    boundaries = distogram_boundaries(num_buckets)[:-1]
+    # side='left' == torch.bucketize default (right=False): a value exactly
+    # on a boundary stays in the lower bucket
+    buckets = jnp.searchsorted(boundaries, distances, side="left")
+    pair_mask = mask[..., :, None] & mask[..., None, :]
+    return jnp.where(pair_mask, buckets, ignore_index).astype(jnp.int32)
+
+
+def center_distogram(
+    distogram: jnp.ndarray,
+    bins: jnp.ndarray | None = None,
+    center: str = "mean",
+    wide: str = "std",
+    eps: float = 1e-7,
+):
+    """Central distance estimate + confidence weights from a distogram
+    (reference utils.py:718-761).
+
+    distogram: (..., N, N, B) non-negative bin weights (probabilities ok).
+    Returns (central (..., N, N), weights (..., N, N)).
+    """
+    if bins is None:
+        bins = distogram_boundaries()
+    # bin centers: shift down half a step; first bin -> 1.5 A, last bin is
+    # the catch-all "far" bin at 1.33 * max (reference utils.py:731-733).
+    step = bins[2] - bins[1]
+    n_bins = bins - 0.5 * step
+    n_bins = n_bins.at[0].set(1.5)
+    n_bins = n_bins.at[-1].set(1.33 * bins[-1])
+
+    magnitudes = distogram.sum(axis=-1)
+
+    if center == "median":
+        cum = jnp.cumsum(distogram, axis=-1)
+        target = 0.5 * cum[..., -1:]
+        idx = jnp.sum(cum < target, axis=-1)
+        idx = jnp.minimum(idx, n_bins.shape[0] - 1)
+        central = n_bins[idx]
+    else:  # mean
+        central = (distogram * n_bins).sum(axis=-1) / (magnitudes + eps)
+
+    # pairs predicted beyond the last real bin are ignored downstream
+    valid = (central <= bins[-2]).astype(distogram.dtype)
+
+    n = distogram.shape[-2]
+    eye = jnp.eye(n, dtype=distogram.dtype)
+    central = central * (1.0 - eye)  # zero diagonal
+
+    if wide in ("var", "std"):
+        disp = (distogram * (n_bins - central[..., None]) ** 2).sum(axis=-1)
+        disp = disp / (magnitudes + eps)
+        if wide == "std":
+            disp = jnp.sqrt(jnp.maximum(disp, 0.0))
+    else:
+        disp = jnp.zeros_like(central)
+
+    weights = valid / (1.0 + disp)
+    weights = jnp.nan_to_num(weights) * (1.0 - eye)
+    return central, weights
+
+
+# ---------------------------------------------------------------------------
+# Dihedrals
+# ---------------------------------------------------------------------------
+
+
+def dihedral(c1, c2, c3, c4) -> jnp.ndarray:
+    """Dihedral angle (radians) via the atan2 polymer-physics formula
+    (reference utils.py:881-897). Inputs (..., 3), output (...,)."""
+    u1 = c2 - c1
+    u2 = c3 - c2
+    u3 = c4 - c3
+    c12 = jnp.cross(u1, u2)
+    c23 = jnp.cross(u2, u3)
+    y = jnp.sum(jnp.linalg.norm(u2, axis=-1, keepdims=True) * u1 * c23, axis=-1)
+    x = jnp.sum(c12 * c23, axis=-1)
+    return jnp.arctan2(y, x)
+
+
+def backbone_phis(n_coords, ca_coords, c_coords) -> jnp.ndarray:
+    """Phi dihedrals C(-1)-N-CA-C per residue 1..L-1 (reference
+    utils.py:917-956, vectorized). Inputs (..., L, 3); output (..., L-1)."""
+    return dihedral(
+        c_coords[..., :-1, :],
+        n_coords[..., 1:, :],
+        ca_coords[..., 1:, :],
+        c_coords[..., 1:, :],
+    )
+
+
+def fraction_negative_phis(n_coords, ca_coords, c_coords, mask=None):
+    """Proportion of negative phi angles, the mirror-selection statistic
+    (reference utils.py:948-956). Output (...,)."""
+    phis = backbone_phis(n_coords, ca_coords, c_coords)
+    neg = (phis < 0).astype(jnp.float32)
+    if mask is not None:
+        m = (mask[..., :-1] & mask[..., 1:]).astype(jnp.float32)
+        return (neg * m).sum(-1) / jnp.maximum(m.sum(-1), 1.0)
+    return neg.mean(-1)
+
+
+# ---------------------------------------------------------------------------
+# Kabsch alignment
+# ---------------------------------------------------------------------------
+
+
+def kabsch(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+):
+    """Optimal-rotation alignment of x onto y (reference utils.py:999-1029).
+
+    x, y: (..., N, 3); mask: (..., N) optional. Returns (x_aligned, y_centered)
+    both centered at the origin, with x rotated onto y. Differentiable; the
+    SVD sign fix uses `where` instead of Python branching so it is jittable.
+    """
+    if mask is None:
+        w = jnp.ones(x.shape[:-1], dtype=x.dtype)
+    else:
+        w = mask.astype(x.dtype)
+    wsum = jnp.maximum(w.sum(-1, keepdims=True), 1.0)[..., None]
+    x_mu = (x * w[..., None]).sum(-2, keepdims=True) / wsum
+    y_mu = (y * w[..., None]).sum(-2, keepdims=True) / wsum
+    x_c = (x - x_mu) * w[..., None]
+    y_c = (y - y_mu) * w[..., None]
+
+    # covariance (3,3); stop-gradient like the reference's `.detach()` at
+    # utils.py:1008 so alignment is treated as a constant rotation in the vjp
+    c = jax.lax.stop_gradient(jnp.swapaxes(x_c, -1, -2) @ y_c)
+    u, s, vt = jnp.linalg.svd(c, full_matrices=False)
+    det = jnp.linalg.det(u) * jnp.linalg.det(vt)
+    flip = jnp.where(det < 0, -1.0, 1.0)[..., None]
+    u = u.at[..., :, -1].multiply(flip)
+    rot = u @ vt
+    return x_c @ rot, y_c
+
+
+# ---------------------------------------------------------------------------
+# Metrics (reference utils.py:1098-1247)
+# ---------------------------------------------------------------------------
+
+
+def _masked_mean(x, mask, axis):
+    if mask is None:
+        return x.mean(axis=axis)
+    m = mask.astype(x.dtype)
+    return (x * m).sum(axis=axis) / jnp.maximum(m.sum(axis=axis), 1.0)
+
+
+def rmsd(x, y, mask=None) -> jnp.ndarray:
+    """RMSD between point sets (..., N, 3) -> (...,). Matches reference
+    rmsd_torch (utils.py:1098-1100): mean over both coord dim and points."""
+    sq = (x - y) ** 2
+    if mask is not None:
+        m = mask[..., None].astype(x.dtype)
+        return jnp.sqrt((sq * m).sum((-1, -2)) /
+                        jnp.maximum(3.0 * mask.astype(x.dtype).sum(-1), 1.0))
+    return jnp.sqrt(sq.mean((-1, -2)))
+
+
+def gdt(x, y, mask=None, mode: str = "TS", weights=None) -> jnp.ndarray:
+    """GDT_TS / GDT_HA (reference utils.py:1106-1141, 1313-1327)."""
+    cutoffs = jnp.array([0.5, 1.0, 2.0, 4.0] if mode.upper() == "HA"
+                        else [1.0, 2.0, 4.0, 8.0], dtype=x.dtype)
+    if weights is None:
+        weights = jnp.ones_like(cutoffs)
+    else:
+        weights = jnp.asarray(weights, dtype=x.dtype)
+    dist = jnp.linalg.norm(x - y, axis=-1)  # (..., N)
+    under = (dist[..., None, :] <= cutoffs[:, None]).astype(x.dtype)
+    frac = _masked_mean(under, None if mask is None else mask[..., None, :], -1)
+    return (frac * weights).mean(-1)
+
+
+def tm_score(x, y, mask=None) -> jnp.ndarray:
+    """TM-score (reference utils.py:1143-1150). x, y: (..., N, 3)."""
+    n = x.shape[-2] if mask is None else jnp.maximum(
+        mask.astype(x.dtype).sum(-1), 1.0)
+    l_eff = jnp.maximum(15.0, jnp.asarray(n, dtype=x.dtype))
+    d0 = 1.24 * jnp.cbrt(l_eff - 15.0) - 1.8
+    dist = jnp.linalg.norm(x - y, axis=-1)
+    score = 1.0 / (1.0 + (dist / d0[..., None]) ** 2)
+    return _masked_mean(score, mask, -1)
+
+
+def lddt_ca(
+    true_ca: jnp.ndarray,
+    pred_ca: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+    r0: float = 15.0,
+    thresholds=(0.5, 1.0, 2.0, 4.0),
+) -> jnp.ndarray:
+    """Per-residue CA lDDT in [0, 1] (reference utils.py:1204-1247),
+    vectorized & mask-based instead of the reference's boolean indexing.
+
+    true_ca, pred_ca: (..., L, 3) C-alpha coordinates; mask: (..., L).
+    Returns (..., L).
+    """
+    if mask is None:
+        mask = jnp.ones(true_ca.shape[:-1], dtype=bool)
+    m = mask.astype(true_ca.dtype)
+    pair_m = m[..., :, None] * m[..., None, :]
+    n = true_ca.shape[-2]
+    off_diag = 1.0 - jnp.eye(n, dtype=true_ca.dtype)
+    pair_m = pair_m * off_diag
+
+    dt = cdist(true_ca, true_ca)
+    dp = cdist(pred_ca, pred_ca)
+    incl = (dt < r0).astype(true_ca.dtype) * pair_m
+    diff = jnp.abs(dp - dt)
+    th = jnp.asarray(thresholds, dtype=true_ca.dtype)
+    ok = (diff[..., None] < th).astype(true_ca.dtype).mean(-1)
+    denom = jnp.maximum(incl.sum(-1), 1e-9)
+    return (ok * incl).sum(-1) / denom * m
+
+
+def distmat_loss(
+    x=None, y=None, x_mat=None, y_mat=None,
+    p: float = 2.0, q: float = 2.0, mask=None, clamp=None,
+) -> jnp.ndarray:
+    """Alignment-free distance-matrix loss (reference utils.py:1057-1096)."""
+    if x_mat is None:
+        if clamp is not None:
+            x = jnp.clip(x, *clamp)
+        x_mat = cdist(x, x) if p == 2 else (
+            jnp.abs(x[..., :, None, :] - x[..., None, :, :]) ** p
+        ).sum(-1) ** (1.0 / p)
+    if y_mat is None:
+        if clamp is not None:
+            y = jnp.clip(y, *clamp)
+        y_mat = cdist(y, y) if p == 2 else (
+            jnp.abs(y[..., :, None, :] - y[..., None, :, :]) ** p
+        ).sum(-1) ** (1.0 / p)
+    loss = (x_mat - y_mat) ** 2
+    if q != 2:
+        loss = loss ** (q / 2.0)
+    if mask is None:
+        return loss.mean()
+    m = mask.astype(loss.dtype)
+    return (loss * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Aligned-metric conveniences
+# ---------------------------------------------------------------------------
+
+
+def kabsch_rmsd(x, y, mask=None) -> jnp.ndarray:
+    """RMSD after optimal alignment of x onto y."""
+    x_a, y_c = kabsch(x, y, mask=mask)
+    return rmsd(x_a, y_c, mask=mask)
+
+
+def kabsch_tm(x, y, mask=None) -> jnp.ndarray:
+    x_a, y_c = kabsch(x, y, mask=mask)
+    return tm_score(x_a, y_c, mask=mask)
+
+
+def kabsch_gdt(x, y, mask=None, mode: str = "TS") -> jnp.ndarray:
+    x_a, y_c = kabsch(x, y, mask=mask)
+    return gdt(x_a, y_c, mask=mask, mode=mode)

@@ -1,0 +1,73 @@
+"""Quaternion algebra in pure JAX.
+
+Replaces the reference's dependency on pytorch3d's C++/CUDA quaternion ops
+(/root/reference/alphafold2_pytorch/alphafold2.py:20, :868, :886, :890).
+Closed-form math — XLA fuses these into surrounding computation, so no
+custom kernel is needed.
+
+Convention: quaternions are (..., 4) with scalar part first, (w, x, y, z).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def identity_quaternion(shape=(), dtype=jnp.float32) -> jnp.ndarray:
+    """(1, 0, 0, 0) broadcast to shape + (4,)."""
+    q = jnp.zeros((*shape, 4), dtype=dtype)
+    return q.at[..., 0].set(1.0)
+
+
+def quaternion_multiply(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Hamilton product a * b, both (..., 4) wxyz."""
+    aw, ax, ay, az = jnp.moveaxis(a, -1, 0)
+    bw, bx, by, bz = jnp.moveaxis(b, -1, 0)
+    return jnp.stack(
+        [
+            aw * bw - ax * bx - ay * by - az * bz,
+            aw * bx + ax * bw + ay * bz - az * by,
+            aw * by - ax * bz + ay * bw + az * bx,
+            aw * bz + ax * by - ay * bx + az * bw,
+        ],
+        axis=-1,
+    )
+
+
+def quaternion_to_matrix(q: jnp.ndarray) -> jnp.ndarray:
+    """Unit-normalized rotation matrix from (..., 4) wxyz -> (..., 3, 3).
+
+    Rows are the images of the basis vectors: `v @ R` rotates a row-vector v,
+    matching the reference's `einsum('b n c, b n c d -> b n d', points, R)`
+    usage (alphafold2.py:891) with pytorch3d matrices.
+    """
+    q = q / jnp.linalg.norm(q, axis=-1, keepdims=True)
+    w, x, y, z = jnp.moveaxis(q, -1, 0)
+    r00 = 1 - 2 * (y * y + z * z)
+    r01 = 2 * (x * y - z * w)
+    r02 = 2 * (x * z + y * w)
+    r10 = 2 * (x * y + z * w)
+    r11 = 1 - 2 * (x * x + z * z)
+    r12 = 2 * (y * z - x * w)
+    r20 = 2 * (x * z - y * w)
+    r21 = 2 * (y * z + x * w)
+    r22 = 1 - 2 * (x * x + y * y)
+    return jnp.stack(
+        [
+            jnp.stack([r00, r01, r02], axis=-1),
+            jnp.stack([r10, r11, r12], axis=-1),
+            jnp.stack([r20, r21, r22], axis=-1),
+        ],
+        axis=-2,
+    )
+
+
+def quaternion_invert(q: jnp.ndarray) -> jnp.ndarray:
+    """Conjugate of a unit quaternion."""
+    return q * jnp.asarray([1.0, -1.0, -1.0, -1.0], dtype=q.dtype)
+
+
+def rotate_vector(q: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """Rotate (..., 3) vectors by (..., 4) quaternions."""
+    r = quaternion_to_matrix(q)
+    return jnp.einsum("...c,...cd->...d", v, r)

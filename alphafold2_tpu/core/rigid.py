@@ -1,0 +1,63 @@
+"""Rigid-body frames (rotation + translation) for the structure module.
+
+The reference keeps frames as raw (quaternions, translations) tensors inside
+`Alphafold2.forward` (alphafold2.py:857-891); here they are a first-class
+pytree so they can flow through `lax.scan`, `jit` and shardings untouched.
+
+Convention (matches the reference's einsums at alphafold2.py:887,891):
+  global = local @ R + t      # row-vector application
+with R = quaternion_to_matrix(q). Composition of an update (dq, dt) in the
+local frame is q <- q * dq, t <- t + dt @ R.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from alphafold2_tpu.core import quaternion as quat
+
+
+class Rigid(NamedTuple):
+    """Batch of rigid frames; quaternions (..., 4) wxyz, translations (..., 3)."""
+
+    quaternions: jnp.ndarray
+    translations: jnp.ndarray
+
+    @classmethod
+    def identity(cls, shape=(), dtype=jnp.float32) -> "Rigid":
+        return cls(
+            quaternions=quat.identity_quaternion(shape, dtype),
+            translations=jnp.zeros((*shape, 3), dtype=dtype),
+        )
+
+    @property
+    def rotations(self) -> jnp.ndarray:
+        return quat.quaternion_to_matrix(self.quaternions)
+
+    def apply(self, points: jnp.ndarray) -> jnp.ndarray:
+        """local (..., P, 3) -> global, broadcasting frames over P."""
+        r = self.rotations
+        return jnp.einsum("...pc,...cd->...pd", points, r) + \
+            self.translations[..., None, :]
+
+    def apply_single(self, points: jnp.ndarray) -> jnp.ndarray:
+        """local (..., 3) -> global, one point per frame
+        (reference alphafold2.py:891)."""
+        return jnp.einsum("...c,...cd->...d", points, self.rotations) + \
+            self.translations
+
+    def invert_apply(self, points: jnp.ndarray) -> jnp.ndarray:
+        """global (..., P, 3) -> local, broadcasting frames over P."""
+        r = self.rotations
+        local = points - self.translations[..., None, :]
+        return jnp.einsum("...pd,...cd->...pc", local, r)
+
+    def compose_update(self, dq: jnp.ndarray, dt: jnp.ndarray) -> "Rigid":
+        """Apply a local-frame update (reference alphafold2.py:886-887):
+        q <- q * dq (Hamilton), t <- t + dt @ R."""
+        r = self.rotations
+        new_q = quat.quaternion_multiply(self.quaternions, dq)
+        new_t = self.translations + jnp.einsum("...c,...cd->...d", dt, r)
+        return Rigid(new_q, new_t)

@@ -1,0 +1,24 @@
+from alphafold2_tpu.model.alphafold2 import (  # noqa: F401
+    Alphafold2,
+    Recyclables,
+    ReturnValues,
+)
+from alphafold2_tpu.model.evoformer import (  # noqa: F401
+    Evoformer,
+    EvoformerBlock,
+    MsaAttentionBlock,
+    PairwiseAttentionBlock,
+)
+from alphafold2_tpu.model.mlm import MLM  # noqa: F401
+from alphafold2_tpu.model.primitives import (  # noqa: F401
+    Attention,
+    AxialAttention,
+    FeedForward,
+    OuterMean,
+    TriangleMultiplicativeModule,
+)
+from alphafold2_tpu.model.structure import (  # noqa: F401
+    InvariantPointAttention,
+    IPABlock,
+    StructureModule,
+)

@@ -1,0 +1,191 @@
+"""Evoformer trunk: MSA/pair blocks and the scanned, rematerialized stack.
+
+Parity with the reference (/root/reference/alphafold2_pytorch/alphafold2.py:
+353-467): `PairwiseAttentionBlock` (outer-mean ingest + triangle mult out/in +
+triangle attention out/in), `MsaAttentionBlock` (row attn with pair bias, col
+attn), `EvoformerBlock` (msa attn -> msa FF -> pair attn -> pair FF, all
+residual), `Evoformer` = depth x block.
+
+TPU-first: instead of the reference's `checkpoint_sequential` (alphafold2.py:
+466), the stack runs under `nn.scan` over depth with per-layer remat
+(`nn.remat`) — constant compile time at depth 48 and O(1) stored activations
+per block, with XLA re-materializing each block's interior in the backward
+pass. Pair/MSA activations carry sharding constraints so the stack runs
+identically under a pjit mesh (see alphafold2_tpu/parallel).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from alphafold2_tpu.model.primitives import (
+    AxialAttention,
+    FeedForward,
+    OuterMean,
+    TriangleMultiplicativeModule,
+)
+from alphafold2_tpu.parallel.sharding import shard_msa, shard_pair
+
+
+class PairwiseAttentionBlock(nn.Module):
+    """Pair-track block (reference alphafold2.py:353-385)."""
+
+    dim: int
+    heads: int
+    dim_head: int = 64
+    dropout: float = 0.0
+    global_column_attn: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask=None, msa_repr=None, msa_mask=None,
+                 deterministic: bool = True):
+        if msa_repr is not None:
+            x = x + OuterMean(dim=self.dim, dtype=self.dtype,
+                              name="outer_mean")(msa_repr, mask=msa_mask)
+            x = shard_pair(x)
+
+        x = TriangleMultiplicativeModule(
+            dim=self.dim, mix="outgoing", dtype=self.dtype,
+            name="triangle_multiply_outgoing")(x, mask=mask) + x
+        x = TriangleMultiplicativeModule(
+            dim=self.dim, mix="ingoing", dtype=self.dtype,
+            name="triangle_multiply_ingoing")(x, mask=mask) + x
+        x = shard_pair(x)
+        x = AxialAttention(
+            dim=self.dim, heads=self.heads, dim_head=self.dim_head,
+            row_attn=True, col_attn=False, accept_edges=True,
+            dtype=self.dtype, name="triangle_attention_outgoing",
+        )(x, edges=x, mask=mask, deterministic=deterministic) + x
+        x = AxialAttention(
+            dim=self.dim, heads=self.heads, dim_head=self.dim_head,
+            row_attn=False, col_attn=True, accept_edges=True,
+            global_query_attn=self.global_column_attn,
+            dtype=self.dtype, name="triangle_attention_ingoing",
+        )(x, edges=x, mask=mask, deterministic=deterministic) + x
+        return shard_pair(x)
+
+
+class MsaAttentionBlock(nn.Module):
+    """MSA-track block (reference alphafold2.py:387-408)."""
+
+    dim: int
+    heads: int
+    dim_head: int = 64
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask=None, pairwise_repr=None,
+                 deterministic: bool = True):
+        x = AxialAttention(
+            dim=self.dim, heads=self.heads, dim_head=self.dim_head,
+            row_attn=True, col_attn=False, accept_edges=True,
+            dtype=self.dtype, name="row_attn",
+        )(x, mask=mask, edges=pairwise_repr, deterministic=deterministic) + x
+        x = AxialAttention(
+            dim=self.dim, heads=self.heads, dim_head=self.dim_head,
+            row_attn=False, col_attn=True,
+            dtype=self.dtype, name="col_attn",
+        )(x, mask=mask, deterministic=deterministic) + x
+        return shard_msa(x)
+
+
+class EvoformerBlock(nn.Module):
+    """One Evoformer layer (reference alphafold2.py:412-446)."""
+
+    dim: int
+    heads: int
+    dim_head: int = 64
+    attn_dropout: float = 0.0
+    ff_dropout: float = 0.0
+    global_column_attn: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, m, mask=None, msa_mask=None,
+                 deterministic: bool = True):
+        # msa attention and transition
+        m = MsaAttentionBlock(
+            dim=self.dim, heads=self.heads, dim_head=self.dim_head,
+            dropout=self.attn_dropout, dtype=self.dtype, name="msa_attn",
+        )(m, mask=msa_mask, pairwise_repr=x, deterministic=deterministic)
+        m = FeedForward(dim=self.dim, dropout=self.ff_dropout,
+                        dtype=self.dtype, name="msa_ff")(
+                            m, deterministic=deterministic) + m
+
+        # pairwise attention (ingesting the updated MSA) and transition
+        x = PairwiseAttentionBlock(
+            dim=self.dim, heads=self.heads, dim_head=self.dim_head,
+            dropout=self.attn_dropout,
+            global_column_attn=self.global_column_attn,
+            dtype=self.dtype, name="attn",
+        )(x, mask=mask, msa_repr=m, msa_mask=msa_mask,
+          deterministic=deterministic)
+        x = FeedForward(dim=self.dim, dropout=self.ff_dropout,
+                        dtype=self.dtype, name="ff")(
+                            x, deterministic=deterministic) + x
+
+        return x, m
+
+
+class Evoformer(nn.Module):
+    """depth x EvoformerBlock under scan + remat (reference alphafold2.py:
+    448-467; memory scaling via checkpoint_sequential there, jax.remat here).
+    """
+
+    dim: int
+    depth: int
+    heads: int = 8
+    dim_head: int = 64
+    attn_dropout: float = 0.0
+    ff_dropout: float = 0.0
+    global_column_attn: bool = False
+    dtype: jnp.dtype = jnp.float32
+    use_scan: bool = True
+
+    @nn.compact
+    def __call__(self, x, m, mask=None, msa_mask=None,
+                 deterministic: bool = True):
+        block_kwargs = dict(
+            dim=self.dim, heads=self.heads, dim_head=self.dim_head,
+            attn_dropout=self.attn_dropout, ff_dropout=self.ff_dropout,
+            global_column_attn=self.global_column_attn, dtype=self.dtype,
+        )
+
+        if self.use_scan and self.depth > 1:
+            # remat each block, stack parameters along a scanned depth axis:
+            # constant compile time and one block of live activations.
+            block_cls = nn.remat(
+                EvoformerBlock,
+                static_argnums=(5,),
+                prevent_cse=False,
+            )
+
+            class ScanBody(nn.Module):
+                dtype: jnp.dtype = self.dtype
+
+                @nn.compact
+                def __call__(self, carry, _):
+                    x, m = carry
+                    x, m = block_cls(**block_kwargs, name="block")(
+                        x, m, mask, msa_mask, deterministic)
+                    return (x, m), None
+
+            scan = nn.scan(
+                ScanBody,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=self.depth,
+            )
+            (x, m), _ = scan(name="layers")((x, m), None)
+        else:
+            for i in range(self.depth):
+                x, m = EvoformerBlock(**block_kwargs, name=f"layers_{i}")(
+                    x, m, mask=mask, msa_mask=msa_mask,
+                    deterministic=deterministic)
+
+        return x, m

@@ -1,0 +1,18 @@
+from alphafold2_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_NAMES,
+    DATA_AXIS,
+    PAIR_I_AXIS,
+    PAIR_J_AXIS,
+    make_mesh,
+    single_device_mesh,
+)
+from alphafold2_tpu.parallel.sharding import (  # noqa: F401
+    active_mesh,
+    msa_spec,
+    pair_spec,
+    seq_spec,
+    shard_msa,
+    shard_pair,
+    shard_seq,
+    use_mesh,
+)

@@ -1,0 +1,54 @@
+"""Device-mesh construction for multi-chip execution.
+
+The reference's "distributed backend" is empty DeepSpeed/Lightning stubs
+implying NCCL (/root/reference/training_scripts/deepspeed.py,
+lightning.py — both 0 LoC; install_deepspeed.sh). The TPU-native replacement
+is GSPMD: a named `jax.sharding.Mesh` whose collectives XLA emits over
+ICI/DCN. No NCCL, no process groups — sharding annotations only.
+
+Axis vocabulary:
+- ``data``: batch-parallel axis (DDP analog / ZeRO via sharded opt state);
+- ``i``, ``j``: the two residue axes of the O(L^2) pair representation —
+  2-D sharding of the pair tensor is the long-context strategy (SURVEY.md
+  §5.7): row attention runs local over j-shards, column attention local over
+  i-shards, triangle contractions become sharded matmuls XLA partitions with
+  all-gathers over the contracting axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+PAIR_I_AXIS = "i"
+PAIR_J_AXIS = "j"
+
+AXIS_NAMES = (DATA_AXIS, PAIR_I_AXIS, PAIR_J_AXIS)
+
+
+def make_mesh(
+    data: int = 1,
+    i: int = 1,
+    j: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (data, i, j) mesh over the given (or all) devices.
+
+    On real hardware, prefer factorizations where `i` x `j` maps to an ICI
+    torus face so ring collectives over the sharded pair axes ride ICI.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    need = data * i * j
+    if need != len(devices):
+        raise ValueError(
+            f"mesh {data}x{i}x{j}={need} != #devices {len(devices)}")
+    arr = np.asarray(devices).reshape(data, i, j)
+    return Mesh(arr, AXIS_NAMES)
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(1, 1, 1, devices=jax.devices()[:1])

@@ -1,0 +1,95 @@
+"""Sharding rules and in-model constraint helpers.
+
+The model calls `shard_pair` / `shard_msa` / `shard_seq` at block boundaries;
+under an active mesh these lower to `with_sharding_constraint`
+(GSPMD placement hints), outside a mesh they are no-ops — the same model
+code runs single-chip and multi-chip. This replaces the reference's absent
+distributed layer (SURVEY.md §2.5, §5.8) without invading model code.
+
+Tensor contracts (axes -> PartitionSpec):
+- pair  (b, i, j, d)      -> P(data, i, j, None)
+- msa   (b, m, n, d)      -> P(data, None, i, None)
+- seq   (b, n, d)         -> P(data, None, None)
+- coords(b, n, 3)         -> P(data, None, None)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from alphafold2_tpu.parallel.mesh import DATA_AXIS, PAIR_I_AXIS, PAIR_J_AXIS
+
+_state = threading.local()
+
+
+def active_mesh() -> Optional[Mesh]:
+    return getattr(_state, "mesh", None)
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Optional[Mesh]):
+    """Activate a mesh for model-internal sharding constraints.
+
+    Also enters `jax.sharding.use_mesh` so closures under jit see the mesh.
+    """
+    prev = getattr(_state, "mesh", None)
+    _state.mesh = mesh
+    try:
+        if mesh is not None:
+            with jax.sharding.use_mesh(mesh):
+                yield mesh
+        else:
+            yield None
+    finally:
+        _state.mesh = prev
+
+
+def _constraint(x, spec: P):
+    mesh = active_mesh()
+    if mesh is None:
+        return x
+    # drop axis names the mesh doesn't have or can't divide the dim
+    cleaned = []
+    for dim, axis in zip(x.shape, spec):
+        if axis is None or axis not in mesh.axis_names:
+            cleaned.append(None)
+        elif dim % mesh.shape[axis] != 0:
+            cleaned.append(None)
+        else:
+            cleaned.append(axis)
+    # pad spec to rank
+    cleaned += [None] * (x.ndim - len(cleaned))
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*cleaned)))
+
+
+def pair_spec() -> P:
+    return P(DATA_AXIS, PAIR_I_AXIS, PAIR_J_AXIS, None)
+
+
+def msa_spec() -> P:
+    return P(DATA_AXIS, None, PAIR_I_AXIS, None)
+
+
+def seq_spec() -> P:
+    return P(DATA_AXIS, None, None)
+
+
+def shard_pair(x):
+    """(b, i, j, d) pair activations: 2-D shard the residue axes."""
+    return _constraint(x, pair_spec())
+
+
+def shard_msa(x):
+    """(b, m, n, d) MSA activations: shard the sequence axis."""
+    return _constraint(x, msa_spec())
+
+
+def shard_seq(x):
+    """(b, n, d) single-track activations: data-parallel only."""
+    return _constraint(x, seq_spec())

@@ -1,0 +1,34 @@
+"""Test harness: run everything on a virtual 8-device CPU platform so
+multi-chip sharding is exercised without a TPU pod (SURVEY.md §4).
+
+Two environment gotchas this file must handle (see
+.claude/skills/verify/SKILL.md):
+- the ambient env exports JAX_PLATFORMS=axon (the tunneled TPU); tests must
+  OVERRIDE it, not setdefault, or every "CPU" test dispatches op-by-op over
+  the TPU tunnel;
+- the axon PJRT plugin is injected via PYTHONPATH=/root/.axon_site and its
+  discovery dials the tunnel even under JAX_PLATFORMS=cpu — strip it from
+  sys.path before jax initializes backends.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+os.environ.pop("PYTHONPATH", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "float32")
+
+# persistent compilation cache: the suite is compile-dominated (many tiny
+# model configs); caching across runs cuts wall-clock dramatically
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_af2tpu")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

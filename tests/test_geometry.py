@@ -1,0 +1,234 @@
+"""Geometry-layer tests: shape contracts mirroring the reference's
+tests/test_utils.py plus golden-value and property tests the reference lacks
+(SURVEY.md §4: closed-form checks for Kabsch/RMSD/dihedrals, equivariance)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu import constants
+from alphafold2_tpu.core import geometry as geo
+from alphafold2_tpu.core import quaternion as quat
+from alphafold2_tpu.core.rigid import Rigid
+
+
+def random_rotation(key):
+    q = jax.random.normal(key, (4,))
+    return quat.quaternion_to_matrix(q / jnp.linalg.norm(q))
+
+
+class TestDistogram:
+    def test_bucketed_distance_matrix(self):
+        coords = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 3)) * 5
+        mask = jnp.ones((2, 16), dtype=bool).at[:, -3:].set(False)
+        buckets = geo.bucketed_distance_matrix(coords, mask)
+        assert buckets.shape == (2, 16, 16)
+        valid = buckets[:, :13, :13]
+        assert (valid >= 0).all() and (valid < constants.DISTOGRAM_BUCKETS).all()
+        assert (buckets[:, -3:, :] == constants.IGNORE_INDEX).all()
+
+    def test_bucket_values(self):
+        # distance 2.5 lands right of boundary 2.0 -> bucket 1 (36 bins of
+        # 0.5A from 2A); below 2A -> bucket 0; above 20A -> last bucket
+        coords = jnp.array([[[0.0, 0, 0], [2.25, 0, 0], [50.0, 0, 0]]])
+        mask = jnp.ones((1, 3), dtype=bool)
+        buckets = geo.bucketed_distance_matrix(coords, mask)
+        assert buckets[0, 0, 1] == 1
+        assert buckets[0, 0, 2] == constants.DISTOGRAM_BUCKETS - 1
+        assert buckets[0, 0, 0] == 0
+
+    def test_center_distogram(self):
+        logits = jax.random.normal(jax.random.PRNGKey(1), (1, 12, 12, 37))
+        probs = jax.nn.softmax(logits, -1)
+        central, weights = geo.center_distogram(probs)
+        assert central.shape == (1, 12, 12)
+        assert weights.shape == (1, 12, 12)
+        assert (jnp.diagonal(central, axis1=1, axis2=2) == 0).all()
+        assert bool(jnp.isfinite(central).all() and jnp.isfinite(weights).all())
+
+    def test_center_distogram_median(self):
+        probs = jax.nn.softmax(
+            jax.random.normal(jax.random.PRNGKey(2), (1, 8, 8, 37)), -1)
+        central, _ = geo.center_distogram(probs, center="median")
+        assert central.shape == (1, 8, 8)
+
+
+class TestDihedral:
+    def test_known_dihedral(self):
+        # c1 sits at +y of the c2-c3 axis; c4 at +y -> cis (0), at -y ->
+        # trans (pi), at +z -> +-pi/2
+        c1 = jnp.array([1.0, 1.0, 0.0])
+        c2 = jnp.array([1.0, 0.0, 0.0])
+        c3 = jnp.array([0.0, 0.0, 0.0])
+        c4_cis = jnp.array([-1.0, 1.0, 0.0])
+        c4_trans = jnp.array([-1.0, -1.0, 0.0])
+        assert np.isclose(geo.dihedral(c1, c2, c3, c4_cis), 0.0, atol=1e-5)
+        assert np.isclose(abs(geo.dihedral(c1, c2, c3, c4_trans)), np.pi,
+                          atol=1e-5)
+        d90 = geo.dihedral(c1, c2, c3, jnp.array([0.0, 0.0, 1.0]))
+        assert np.isclose(abs(d90), np.pi / 2, atol=1e-5)
+
+    def test_rotation_invariance(self):
+        key = jax.random.PRNGKey(3)
+        pts = jax.random.normal(key, (4, 3))
+        rot = random_rotation(jax.random.PRNGKey(4))
+        d1 = geo.dihedral(*pts)
+        d2 = geo.dihedral(*(pts @ rot))
+        assert np.isclose(d1, d2, atol=1e-4)
+
+
+class TestKabsch:
+    def test_recovers_rotation(self):
+        key = jax.random.PRNGKey(5)
+        x = jax.random.normal(key, (1, 32, 3))
+        rot = random_rotation(jax.random.PRNGKey(6))
+        y = x @ rot + jnp.array([1.0, -2.0, 3.0])
+        x_a, y_c = geo.kabsch(y, x)  # align y onto x
+        assert float(geo.rmsd(x_a, y_c)[0]) < 1e-4
+
+    def test_kabsch_rmsd_zero_for_rigid_transform(self):
+        x = jax.random.normal(jax.random.PRNGKey(7), (2, 20, 3))
+        rot = random_rotation(jax.random.PRNGKey(8))
+        y = x @ rot + 5.0
+        assert float(geo.kabsch_rmsd(x, y).max()) < 1e-4
+
+    def test_masked(self):
+        x = jax.random.normal(jax.random.PRNGKey(9), (1, 16, 3))
+        rot = random_rotation(jax.random.PRNGKey(10))
+        y = x @ rot
+        # corrupt masked-out tail; alignment should ignore it
+        y = y.at[:, -4:].add(100.0)
+        mask = jnp.ones((1, 16), dtype=bool).at[:, -4:].set(False)
+        assert float(geo.kabsch_rmsd(x, y, mask=mask)[0]) < 1e-4
+
+
+class TestMetrics:
+    def test_rmsd_golden(self):
+        x = jnp.zeros((1, 10, 3))
+        y = jnp.ones((1, 10, 3))  # per-point distance sqrt(3), rmsd = 1.0
+        assert np.isclose(float(geo.rmsd(x, y)[0]), 1.0, atol=1e-6)
+
+    def test_gdt_perfect_and_modes(self):
+        x = jax.random.normal(jax.random.PRNGKey(11), (2, 16, 3))
+        assert np.allclose(geo.gdt(x, x), 1.0)
+        assert np.allclose(geo.gdt(x, x, mode="HA"), 1.0)
+        y = x + jnp.array([100.0, 0, 0])
+        assert np.allclose(geo.gdt(x, y), 0.0)
+
+    def test_gdt_halfway(self):
+        # distances of 3A: inside cutoffs 4,8 but not 1,2 -> GDT_TS = 0.5
+        x = jnp.zeros((1, 8, 3))
+        y = x.at[..., 0].add(3.0)
+        assert np.isclose(float(geo.gdt(x, y)[0]), 0.5, atol=1e-6)
+
+    def test_tm_score(self):
+        x = jax.random.normal(jax.random.PRNGKey(12), (2, 32, 3))
+        assert np.allclose(geo.tm_score(x, x), 1.0, atol=1e-6)
+        y = x + jnp.array([1000.0, 0, 0])
+        assert float(geo.tm_score(x, y).max()) < 1e-3
+
+    def test_lddt_perfect(self):
+        x = jax.random.normal(jax.random.PRNGKey(13), (1, 24, 3)) * 4
+        scores = geo.lddt_ca(x, x)
+        assert scores.shape == (1, 24)
+        assert np.allclose(scores, 1.0, atol=1e-6)
+
+    def test_lddt_degrades(self):
+        x = jax.random.normal(jax.random.PRNGKey(14), (1, 24, 3)) * 4
+        y = x + jax.random.normal(jax.random.PRNGKey(15), x.shape) * 3.0
+        scores = geo.lddt_ca(x, y)
+        assert float(scores.mean()) < 0.9
+
+    def test_lddt_mask(self):
+        x = jax.random.normal(jax.random.PRNGKey(16), (1, 24, 3)) * 4
+        mask = jnp.ones((1, 24), dtype=bool).at[:, -6:].set(False)
+        scores = geo.lddt_ca(x, x, mask=mask)
+        assert (scores[:, -6:] == 0).all()
+
+    def test_distmat_loss(self):
+        x = jax.random.normal(jax.random.PRNGKey(17), (8, 3))
+        assert np.isclose(float(geo.distmat_loss(x, x)), 0.0, atol=1e-9)
+        y = jax.random.normal(jax.random.PRNGKey(18), (8, 3))
+        assert float(geo.distmat_loss(x, y)) > 0
+
+
+class TestQuaternion:
+    def test_identity(self):
+        q = quat.identity_quaternion((2, 5))
+        r = quat.quaternion_to_matrix(q)
+        assert np.allclose(r, np.broadcast_to(np.eye(3), (2, 5, 3, 3)))
+
+    def test_multiply_matches_matrix_product(self):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(19))
+        q1 = jax.random.normal(k1, (4,))
+        q2 = jax.random.normal(k2, (4,))
+        q1 = q1 / jnp.linalg.norm(q1)
+        q2 = q2 / jnp.linalg.norm(q2)
+        r = quat.quaternion_to_matrix(quat.quaternion_multiply(q1, q2))
+        r_ref = quat.quaternion_to_matrix(q1) @ quat.quaternion_to_matrix(q2)
+        assert np.allclose(r, r_ref, atol=1e-5)
+
+    def test_rotation_is_orthonormal(self):
+        q = jax.random.normal(jax.random.PRNGKey(20), (7, 4))
+        r = quat.quaternion_to_matrix(q)
+        eye = jnp.einsum("...ij,...kj->...ik", r, r)
+        assert np.allclose(eye, np.broadcast_to(np.eye(3), (7, 3, 3)),
+                           atol=1e-5)
+        assert np.allclose(jnp.linalg.det(r), 1.0, atol=1e-5)
+
+
+class TestRigid:
+    def test_apply_invert_roundtrip(self):
+        key = jax.random.PRNGKey(21)
+        q = jax.random.normal(key, (2, 6, 4))
+        t = jax.random.normal(jax.random.PRNGKey(22), (2, 6, 3))
+        frames = Rigid(q, t)
+        pts = jax.random.normal(jax.random.PRNGKey(23), (2, 6, 5, 3))
+        back = frames.invert_apply(frames.apply(pts))
+        assert np.allclose(back, pts, atol=1e-4)
+
+    def test_identity_is_noop(self):
+        frames = Rigid.identity((1, 3))
+        pts = jax.random.normal(jax.random.PRNGKey(24), (1, 3, 4, 3))
+        assert np.allclose(frames.apply(pts), pts, atol=1e-6)
+
+    def test_compose_update_identity(self):
+        frames = Rigid.identity((1, 3))
+        dq = quat.identity_quaternion((1, 3))
+        dt = jnp.zeros((1, 3, 3))
+        new = frames.compose_update(dq, dt)
+        assert np.allclose(new.quaternions, frames.quaternions)
+        assert np.allclose(new.translations, frames.translations)
+
+
+class TestPhis:
+    def test_fraction_negative(self):
+        # helix-like synthetic backbone: deterministic output in [0, 1]
+        key = jax.random.PRNGKey(25)
+        nc = jax.random.normal(key, (2, 10, 3))
+        ca = nc + 0.5
+        cc = nc - 0.5
+        frac = geo.fraction_negative_phis(nc, ca, cc)
+        assert frac.shape == (2,)
+        assert ((frac >= 0) & (frac <= 1)).all()
+
+
+@pytest.mark.parametrize("table,shape", [
+    (constants.CLOUD_MASK_TABLE, (21, 14)),
+    (constants.ATOM_ID_TABLE, (21, 14)),
+    (constants.BOND_ADJACENCY_TABLE, (21, 14, 14)),
+])
+def test_constant_tables(table, shape):
+    assert table.shape == shape
+
+
+def test_glycine_has_no_sidechain():
+    g = constants.AA_ALPHABET.index("G")
+    assert constants.CLOUD_MASK_TABLE[g].sum() == 4  # backbone only
+
+
+def test_padding_token_empty():
+    pad = constants.AA_ALPHABET.index("_")
+    assert constants.CLOUD_MASK_TABLE[pad].sum() == 0
+    assert constants.BOND_ADJACENCY_TABLE[pad].sum() == 0

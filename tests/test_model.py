@@ -1,0 +1,294 @@
+"""Model-level tests mirroring the reference's tests/test_attention.py
+coverage (basic trunk, no-MSA, anglegrams, templates, extra-MSA, embedds,
+coords, backward, confidence, recycling) plus invariance/property tests the
+reference lacks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu import Alphafold2, constants
+from alphafold2_tpu.model import Evoformer, ReturnValues
+from alphafold2_tpu.model.mlm import MLM, get_mask_subset_with_prob
+
+
+def make_inputs(b=2, n=16, m=5, key=0):
+    k = jax.random.PRNGKey(key)
+    k1, k2 = jax.random.split(k)
+    return dict(
+        seq=jax.random.randint(k1, (b, n), 0, 21),
+        msa=jax.random.randint(k2, (b, m, n), 0, 21),
+        mask=jnp.ones((b, n), dtype=bool),
+        msa_mask=jnp.ones((b, m, n), dtype=bool),
+    )
+
+
+def small_model(**kwargs):
+    defaults = dict(dim=32, depth=1, heads=2, dim_head=16)
+    defaults.update(kwargs)
+    return Alphafold2(**defaults)
+
+
+class TestTrunk:
+    def test_main(self):
+        # reference test_attention.py::test_main
+        model = small_model(depth=2)
+        inp = make_inputs()
+        params = model.init(jax.random.PRNGKey(1), **inp)
+        ret = model.apply(params, **inp)
+        assert isinstance(ret, ReturnValues)
+        assert ret.distance.shape == (2, 16, 16, constants.DISTOGRAM_BUCKETS)
+        assert bool(jnp.isfinite(ret.distance).all())
+
+    def test_no_msa(self):
+        # reference test_attention.py::test_no_msa
+        model = small_model()
+        inp = make_inputs()
+        del inp["msa"], inp["msa_mask"]
+        params = model.init(jax.random.PRNGKey(1), **inp)
+        ret = model.apply(params, **inp)
+        assert ret.distance.shape == (2, 16, 16, constants.DISTOGRAM_BUCKETS)
+
+    def test_anglegrams(self):
+        # reference test_attention.py::test_anglegrams
+        model = small_model(predict_angles=True)
+        inp = make_inputs()
+        params = model.init(jax.random.PRNGKey(1), **inp)
+        ret = model.apply(params, **inp)
+        assert ret.theta.shape == (2, 16, 16, constants.THETA_BUCKETS)
+        assert ret.phi.shape == (2, 16, 16, constants.PHI_BUCKETS)
+        assert ret.omega.shape == (2, 16, 16, constants.OMEGA_BUCKETS)
+
+    def test_symmetrized_omega(self):
+        model = small_model(predict_angles=True, symmetrize_omega=True)
+        inp = make_inputs()
+        params = model.init(jax.random.PRNGKey(1), **inp)
+        ret = model.apply(params, **inp)
+        om = ret.omega
+        assert np.allclose(om, om.swapaxes(1, 2), atol=1e-4)
+
+    def test_distogram_symmetry(self):
+        # the distogram head consumes the symmetrized pair rep
+        model = small_model()
+        inp = make_inputs()
+        params = model.init(jax.random.PRNGKey(1), **inp)
+        ret = model.apply(params, **inp)
+        assert np.allclose(ret.distance, ret.distance.swapaxes(1, 2),
+                           atol=1e-4)
+
+    def test_templates(self):
+        # reference test_attention.py::test_templates
+        model = small_model(templates_dim=8)
+        inp = make_inputs(b=1, n=8, m=3)
+        templates = dict(
+            templates_feats=jax.random.normal(
+                jax.random.PRNGKey(3), (1, 2, 8, 8, 8)),
+            templates_mask=jnp.ones((1, 2, 8), dtype=bool),
+            templates_angles=jax.random.normal(
+                jax.random.PRNGKey(4), (1, 2, 8, 55)),
+        )
+        params = model.init(jax.random.PRNGKey(1), **inp, **templates)
+        ret = model.apply(params, **inp, **templates)
+        assert ret.distance.shape == (1, 8, 8, constants.DISTOGRAM_BUCKETS)
+
+    def test_extra_msa(self):
+        # reference test_attention.py::test_extra_msa
+        model = small_model(predict_coords=True, structure_module_depth=1)
+        inp = make_inputs(b=1, n=8, m=3)
+        extra = dict(
+            extra_msa=jax.random.randint(jax.random.PRNGKey(5), (1, 4, 8),
+                                         0, 21),
+            extra_msa_mask=jnp.ones((1, 4, 8), dtype=bool),
+        )
+        params = model.init(jax.random.PRNGKey(1), **inp, **extra)
+        coords = model.apply(params, **inp, **extra)
+        assert coords.shape == (1, 8, 3)
+
+    def test_embedds(self):
+        # reference test_attention.py::test_embedless_model
+        model = small_model(num_embedds=64)
+        inp = make_inputs(b=1, n=8)
+        del inp["msa"], inp["msa_mask"]
+        embedds = jax.random.normal(jax.random.PRNGKey(6), (1, 1, 8, 64))
+        params = model.init(jax.random.PRNGKey(1), **inp, embedds=embedds)
+        ret = model.apply(params, **inp, embedds=embedds)
+        assert ret.distance.shape == (1, 8, 8, constants.DISTOGRAM_BUCKETS)
+
+    def test_one_params_tree_serves_all_configs(self):
+        # init with the plain path, then apply every optional branch with the
+        # same tree (init-time coverage contract)
+        model = small_model(predict_coords=True, structure_module_depth=1,
+                            templates_dim=8, num_embedds=64)
+        inp = make_inputs(b=1, n=8, m=3)
+        params = model.init(jax.random.PRNGKey(1), **inp)
+        # trunk-only view of a coords model
+        ret = model.apply(params, **inp, return_trunk=True)
+        assert ret.distance is not None
+        # templates on
+        model.apply(
+            params, **inp,
+            templates_feats=jnp.zeros((1, 2, 8, 8, 8)),
+            templates_mask=jnp.ones((1, 2, 8), dtype=bool),
+            templates_angles=jnp.zeros((1, 2, 8, 55)))
+        # extra MSA on
+        model.apply(params, **inp,
+                    extra_msa=jnp.zeros((1, 4, 8), dtype=jnp.int32),
+                    extra_msa_mask=jnp.ones((1, 4, 8), dtype=bool))
+        # embedds path
+        model.apply(params, seq=inp["seq"], mask=inp["mask"],
+                    embedds=jnp.zeros((1, 1, 8, 64)))
+        # train path
+        model.apply(params, **inp, train=True,
+                    rngs={"mlm": jax.random.PRNGKey(2)})
+
+
+class TestCoords:
+    def test_coords_shape(self):
+        # reference test_attention.py::test_coords (asserts (2,16,3))
+        model = small_model(predict_coords=True, structure_module_depth=2)
+        inp = make_inputs()
+        params = model.init(jax.random.PRNGKey(1), **inp)
+        coords = model.apply(params, **inp)
+        assert coords.shape == (2, 16, 3)
+        assert bool(jnp.isfinite(coords).all())
+
+    def test_coords_backward(self):
+        # reference test_attention.py::test_coords_backwards
+        model = small_model(predict_coords=True, structure_module_depth=2)
+        inp = make_inputs(b=1, n=8)
+        params = model.init(jax.random.PRNGKey(1), **inp)
+
+        def loss_fn(p):
+            coords = model.apply(p, **inp)
+            return jnp.sum(coords ** 2)
+
+        grads = jax.grad(loss_fn)(params)
+        leaves = jax.tree_util.tree_leaves(grads)
+        assert all(bool(jnp.isfinite(g).all()) for g in leaves)
+        # gradient must reach the trunk
+        total = sum(float(jnp.abs(g).sum()) for g in leaves)
+        assert total > 0
+
+    def test_confidence(self):
+        # reference test_attention.py::test_confidence
+        model = small_model(predict_coords=True, structure_module_depth=1)
+        inp = make_inputs()
+        params = model.init(jax.random.PRNGKey(1), **inp)
+        coords, confidence = model.apply(params, **inp,
+                                         return_confidence=True)
+        assert coords.shape == (2, 16, 3)
+        assert confidence.shape == (2, 16, 1)
+
+    def test_recycling(self):
+        # reference test_attention.py::test_recycling
+        model = small_model(predict_coords=True, structure_module_depth=1)
+        inp = make_inputs(b=1, n=8)
+        params = model.init(jax.random.PRNGKey(1), **inp)
+        coords, ret = model.apply(params, **inp, return_aux_logits=True,
+                                  return_recyclables=True)
+        assert ret.recyclables is not None
+        coords2, ret2 = model.apply(params, **inp,
+                                    recyclables=ret.recyclables,
+                                    return_aux_logits=True,
+                                    return_recyclables=True)
+        assert coords2.shape == coords.shape
+        assert bool(jnp.isfinite(coords2).all())
+
+
+class TestMLM:
+    def test_mask_subset_prob(self):
+        rng = jax.random.PRNGKey(0)
+        mask = jnp.ones((4, 100), dtype=bool)
+        subset = get_mask_subset_with_prob(rng, mask, 0.15)
+        assert subset.shape == (4, 100)
+        counts = subset.sum(-1)
+        assert ((counts > 5) & (counts <= 15)).all()
+        # subset respects the validity mask
+        mask2 = mask.at[:, 50:].set(False)
+        subset2 = get_mask_subset_with_prob(rng, mask2, 0.15)
+        assert not bool(subset2[:, 50:].any())
+
+    def test_noise_and_loss(self):
+        mlm = MLM(dim=16, num_tokens=21, mask_id=21)
+        seq = jax.random.randint(jax.random.PRNGKey(1), (2, 4, 50), 1, 21)
+        mask = jnp.ones_like(seq, dtype=bool)
+        noised, replaced = mlm.noise(jax.random.PRNGKey(2), seq, mask)
+        assert noised.shape == seq.shape
+        assert bool(replaced.any())
+        # unreplaced positions untouched
+        assert bool((jnp.where(replaced, True, noised == seq)).all())
+        params = mlm.init(jax.random.PRNGKey(3),
+                          jnp.zeros((2, 4, 50, 16)), seq, replaced)
+        loss = mlm.apply(params, jnp.zeros((2, 4, 50, 16)), seq, replaced)
+        assert np.isfinite(float(loss))
+
+    def test_mlm_loss_in_training_forward(self):
+        model = small_model()
+        inp = make_inputs(b=1, n=8)
+        params = model.init(
+            {"params": jax.random.PRNGKey(1), "mlm": jax.random.PRNGKey(2)},
+            **inp, train=True)
+        ret = model.apply(params, **inp, train=True,
+                          rngs={"mlm": jax.random.PRNGKey(3)})
+        assert ret.msa_mlm_loss is not None
+        # ~ uniform CE over 21 classes at random init
+        assert 1.0 < float(ret.msa_mlm_loss) < 6.0
+
+
+class TestEvoformerModule:
+    def test_standalone_evoformer(self):
+        # public Evoformer export (reference __init__.py:1)
+        ev = Evoformer(dim=16, depth=2, heads=2, dim_head=8)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 8, 16))
+        m = jax.random.normal(jax.random.PRNGKey(1), (1, 3, 8, 16))
+        params = ev.init(jax.random.PRNGKey(2), x, m)
+        x2, m2 = ev.apply(params, x, m)
+        assert x2.shape == x.shape and m2.shape == m.shape
+
+    def test_scan_matches_loop(self):
+        # scanned stack must equal the unrolled loop given identical params
+        ev_scan = Evoformer(dim=16, depth=3, heads=2, dim_head=8,
+                            use_scan=True)
+        ev_loop = Evoformer(dim=16, depth=3, heads=2, dim_head=8,
+                            use_scan=False)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 6, 6, 16))
+        m = jax.random.normal(jax.random.PRNGKey(1), (1, 3, 6, 16))
+        p_scan = ev_scan.init(jax.random.PRNGKey(2), x, m)
+
+        # re-key loop params from the scanned (stacked) params
+        stacked = p_scan["params"]["layers"]["block"]
+        p_loop = {"params": {}}
+        for i in range(3):
+            p_loop["params"][f"layers_{i}"] = jax.tree.map(
+                lambda t, i=i: t[i], stacked)
+        xs, ms = ev_scan.apply(p_scan, x, m)
+        xl, ml = ev_loop.apply(p_loop, x, m)
+        assert np.allclose(xs, xl, atol=1e-5)
+        assert np.allclose(ms, ml, atol=1e-5)
+
+
+class TestMasking:
+    def test_padding_invariance(self):
+        """Padded positions must not change unpadded outputs."""
+        model = small_model()
+        n_real, n_pad = 8, 12
+        k = jax.random.PRNGKey(7)
+        seq_real = jax.random.randint(k, (1, n_real), 1, 21)
+        msa_real = jax.random.randint(k, (1, 3, n_real), 1, 21)
+
+        seq_padded = jnp.pad(seq_real, ((0, 0), (0, n_pad - n_real)))
+        msa_padded = jnp.pad(msa_real, ((0, 0), (0, 0), (0, n_pad - n_real)))
+        mask = jnp.arange(n_pad)[None, :] < n_real
+        msa_mask = jnp.broadcast_to(mask[:, None, :], (1, 3, n_pad))
+
+        params = model.init(jax.random.PRNGKey(1), seq_padded,
+                            msa=msa_padded, mask=mask, msa_mask=msa_mask)
+        ret_pad = model.apply(params, seq_padded, msa=msa_padded, mask=mask,
+                              msa_mask=msa_mask)
+        ret_real = model.apply(
+            params, seq_real, msa=msa_real,
+            mask=jnp.ones((1, n_real), dtype=bool),
+            msa_mask=jnp.ones((1, 3, n_real), dtype=bool))
+        assert np.allclose(ret_pad.distance[:, :n_real, :n_real],
+                           ret_real.distance, atol=2e-3)
