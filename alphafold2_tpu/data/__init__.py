@@ -1,0 +1,1 @@
+from alphafold2_tpu.data.synthetic import pad_to, synthetic_batch  # noqa: F401

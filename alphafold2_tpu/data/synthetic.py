@@ -1,0 +1,67 @@
+"""Synthetic batch generation for tests and benchmarks.
+
+Mirrors the reference's random-tensor test pattern
+(/root/reference/tests/test_attention.py:16-19) and provides fixed-shape
+batches: on TPU every shape must be static (SURVEY.md §2.5 batch strategy),
+so the generator emits crop-sized tensors directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu import constants
+
+
+def synthetic_batch(
+    rng: jax.Array,
+    batch: int = 1,
+    seq_len: int = 128,
+    msa_depth: int = 5,
+    with_coords: bool = True,
+    with_angles: bool = False,
+    pad_fraction: float = 0.0,
+):
+    """Returns a dict batch with keys seq, msa, mask, msa_mask and optional
+    coords (CA, (b, n, 3)) / theta/phi/omega bucket targets."""
+    k_seq, k_msa, k_coords, k_ang = jax.random.split(rng, 4)
+    out = {
+        "seq": jax.random.randint(k_seq, (batch, seq_len), 0,
+                                  constants.NUM_AMINO_ACIDS),
+        "msa": jax.random.randint(k_msa, (batch, msa_depth, seq_len), 0,
+                                  constants.NUM_AMINO_ACIDS),
+    }
+    n_valid = seq_len - int(seq_len * pad_fraction)
+    mask = jnp.arange(seq_len)[None, :] < n_valid
+    out["mask"] = jnp.broadcast_to(mask, (batch, seq_len))
+    out["msa_mask"] = jnp.broadcast_to(mask[:, None, :],
+                                       (batch, msa_depth, seq_len))
+    if with_coords:
+        # random-walk chain ~3.8 A steps: realistic distance distribution
+        steps = jax.random.normal(k_coords, (batch, seq_len, 3))
+        steps = steps / jnp.linalg.norm(steps, axis=-1, keepdims=True) * 3.8
+        out["coords"] = jnp.cumsum(steps, axis=1)
+    if with_angles:
+        ks = jax.random.split(k_ang, 3)
+        for key, name, buckets in (
+            (ks[0], "theta", constants.THETA_BUCKETS),
+            (ks[1], "phi", constants.PHI_BUCKETS),
+            (ks[2], "omega", constants.OMEGA_BUCKETS),
+        ):
+            out[name] = jax.random.randint(
+                key, (batch, seq_len, seq_len), 0, buckets)
+    return out
+
+
+def pad_to(x: jnp.ndarray, target_len: int, axis: int = 1,
+           value: float = 0) -> jnp.ndarray:
+    """Pad one axis to a fixed crop size (static-shape discipline)."""
+    pad = target_len - x.shape[axis]
+    if pad <= 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)

@@ -57,6 +57,10 @@ class ReturnValues:
     omega: Optional[jnp.ndarray] = None
     msa_mlm_loss: Optional[jnp.ndarray] = None
     recyclables: Optional[Recyclables] = None
+    # raw lddt-confidence head output (b, n, 1); populated on the coords
+    # path so the head can be trained (the reference's lddt_linear ships
+    # untrained — alphafold2.py:621)
+    confidence: Optional[jnp.ndarray] = None
 
 
 class Alphafold2(nn.Module):
@@ -423,6 +427,7 @@ class Alphafold2(nn.Module):
         # serves every return configuration
         confidence = nn.Dense(1, param_dtype=jnp.float32,
                               name="lddt_linear")(single_out)
+        ret_kwargs["confidence"] = confidence
 
         if return_recyclables:
             ret_kwargs["recyclables"] = Recyclables(

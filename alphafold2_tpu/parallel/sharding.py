@@ -35,13 +35,13 @@ def active_mesh() -> Optional[Mesh]:
 def use_mesh(mesh: Optional[Mesh]):
     """Activate a mesh for model-internal sharding constraints.
 
-    Also enters `jax.sharding.use_mesh` so closures under jit see the mesh.
+    Also enters `jax.set_mesh` so closures under jit see the mesh.
     """
     prev = getattr(_state, "mesh", None)
     _state.mesh = mesh
     try:
         if mesh is not None:
-            with jax.sharding.use_mesh(mesh):
+            with jax.set_mesh(mesh):
                 yield mesh
         else:
             yield None

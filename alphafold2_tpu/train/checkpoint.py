@@ -1,0 +1,57 @@
+"""Checkpoint / resume via orbax.
+
+Net-new relative to the reference, which has no torch.save/load anywhere
+(SURVEY.md §5.4). Saves the full TrainState pytree (params, optimizer state,
+step, PRNG); restore rebuilds onto an abstract target so shardings and
+dtypes come back exactly.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from alphafold2_tpu.train.state import TrainState
+
+
+class CheckpointManager:
+    """Thin orbax wrapper with a stable on-disk layout."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, state: TrainState, step: Optional[int] = None) -> int:
+        step = int(state.step) if step is None else step
+        saveable = {"params": state.params, "opt_state": state.opt_state,
+                    "step": state.step, "rng": state.rng}
+        self._mgr.save(step, args=ocp.args.StandardSave(saveable))
+        self._mgr.wait_until_finished()
+        return step
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, state: TrainState,
+                step: Optional[int] = None) -> TrainState:
+        """Restore into the structure of `state` (which supplies tx/apply_fn
+        and the pytree layout)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        target = {"params": state.params, "opt_state": state.opt_state,
+                  "step": state.step, "rng": state.rng}
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+        return state.replace(
+            params=restored["params"], opt_state=restored["opt_state"],
+            step=restored["step"], rng=restored["rng"])

@@ -1,0 +1,39 @@
+"""Train state: params + optimizer + PRNG, one pytree.
+
+Net-new relative to the reference (its training scripts keep model/optimizer
+as Python objects and never checkpoint — SURVEY.md §5.4); designed so the
+whole state shards under pjit (optimizer state inherits param shardings,
+giving ZeRO-style optimizer sharding for free when params are sharded).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import optax
+from flax.training import train_state
+
+
+class TrainState(train_state.TrainState):
+    """flax TrainState + a carried PRNG key (for MLM noising / dropout)."""
+
+    rng: jax.Array
+
+
+def adam(
+    learning_rate: float = 3e-4,
+    grad_accum_every: int = 1,
+    max_grad_norm: Optional[float] = None,
+) -> optax.GradientTransformation:
+    """The reference's optimizer (Adam 3e-4, grad-accum 16 —
+    train_pre.py:16,58; train_end2end.py:27) as one optax chain;
+    accumulation via MultiSteps instead of a Python loop."""
+    parts = []
+    if max_grad_norm is not None:
+        parts.append(optax.clip_by_global_norm(max_grad_norm))
+    parts.append(optax.adam(learning_rate))
+    tx = optax.chain(*parts)
+    if grad_accum_every > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=grad_accum_every)
+    return tx

@@ -1,0 +1,83 @@
+"""Benchmark: Evoformer training-step time @ 256-res crop (BASELINE.json
+metric), run on whatever jax.devices() provides (the real TPU chip under the
+driver).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
+
+`vs_baseline` is the speedup ratio vs the reference implementation's
+matched-config training step (torch, measured on this host by
+tools/measure_reference_baseline.py into tools/reference_baseline.json —
+the reference publishes no numbers of its own, see BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu import Alphafold2
+from alphafold2_tpu.data.synthetic import synthetic_batch
+from alphafold2_tpu.train import TrainState, adam, make_train_step
+
+DIM = int(os.environ.get("BENCH_DIM", 256))
+DEPTH = int(os.environ.get("BENCH_DEPTH", 2))
+L = int(os.environ.get("BENCH_LEN", 256))
+MSA, B = 5, 1
+WARMUP = max(1, int(os.environ.get("BENCH_WARMUP", 2)))
+ITERS = max(1, int(os.environ.get("BENCH_ITERS", 10)))
+
+
+def main():
+    model = Alphafold2(dim=DIM, depth=DEPTH, heads=8, dim_head=64,
+                       dtype=jnp.bfloat16)
+    batch = synthetic_batch(jax.random.PRNGKey(0), batch=B, seq_len=L,
+                            msa_depth=MSA, with_coords=True)
+    params = model.init(jax.random.PRNGKey(1), batch["seq"],
+                        msa=batch["msa"], mask=batch["mask"],
+                        msa_mask=batch["msa_mask"])
+    state = TrainState.create(apply_fn=model.apply, params=params,
+                              tx=adam(3e-4), rng=jax.random.PRNGKey(2))
+    step = jax.jit(make_train_step(model), donate_argnums=(0,))
+
+    for _ in range(WARMUP):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    ms = (time.perf_counter() - t0) / ITERS * 1e3
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "tools", "reference_baseline.json")
+    vs_baseline = None
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            ref = json.load(f)
+        cfg = ref.get("config", {})
+        # only compare when the measured reference config matches this run
+        if (cfg.get("dim"), cfg.get("depth"), cfg.get("seq_len"),
+                cfg.get("msa_depth"), cfg.get("batch")) == \
+                (DIM, DEPTH, L, MSA, B):
+            vs_baseline = (ref["train_step_seconds"] * 1e3) / ms
+
+    print(json.dumps({
+        "metric": f"evoformer_distogram_train_step@{L}res(dim{DIM},"
+                  f"depth{DEPTH},msa{MSA},b{B})",
+        "value": round(ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(vs_baseline, 3) if vs_baseline else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

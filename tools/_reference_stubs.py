@@ -1,0 +1,70 @@
+"""Minimal stub modules so the reference package imports in this container
+(its heavy native deps — BioPython, sidechainnet, mp_nerf, pytorch3d,
+invariant-point-attention — are not installed). Only what the reference's
+module-level imports touch; enough to run the trunk benchmark."""
+import sys, types
+import torch
+
+AA3 = {"A":"ALA","R":"ARG","N":"ASN","D":"ASP","C":"CYS","Q":"GLN","E":"GLU",
+       "G":"GLY","H":"HIS","I":"ILE","L":"LEU","K":"LYS","M":"MET","F":"PHE",
+       "P":"PRO","S":"SER","T":"THR","W":"TRP","Y":"TYR","V":"VAL"}
+SC_ATOMS = {"ALA":["CB"],"ARG":["CB","CG","CD","NE","CZ","NH1","NH2"],
+ "ASN":["CB","CG","OD1","ND2"],"ASP":["CB","CG","OD1","OD2"],
+ "CYS":["CB","SG"],"GLN":["CB","CG","CD","OE1","NE2"],
+ "GLU":["CB","CG","CD","OE1","OE2"],"GLY":[],
+ "HIS":["CB","CG","ND1","CD2","CE1","NE2"],"ILE":["CB","CG1","CG2","CD1"],
+ "LEU":["CB","CG","CD1","CD2"],"LYS":["CB","CG","CD","CE","NZ"],
+ "MET":["CB","CG","SD","CE"],"PHE":["CB","CG","CD1","CD2","CE1","CE2","CZ"],
+ "PRO":["CB","CG","CD"],"SER":["CB","OG"],"THR":["CB","OG1","CG2"],
+ "TRP":["CB","CG","CD1","CD2","NE1","CE2","CE3","CZ2","CZ3","CH2"],
+ "TYR":["CB","CG","CD1","CD2","CE1","CE2","CZ","OH"],
+ "VAL":["CB","CG1","CG2"]}
+
+def _mod(name):
+    m = types.ModuleType(name); sys.modules[name] = m; return m
+
+# Bio
+bio = _mod("Bio"); bio.SeqIO = _mod("Bio.SeqIO")
+
+# sidechainnet
+scn = _mod("sidechainnet")
+sequ = _mod("sidechainnet.utils"); _mod("sidechainnet.utils.sequence")
+class ProteinVocabulary: pass
+sys.modules["sidechainnet.utils.sequence"].ProteinVocabulary = ProteinVocabulary
+sys.modules["sidechainnet.utils.sequence"].ONE_TO_THREE_LETTER_MAP = AA3
+_mod("sidechainnet.utils.measure").GLOBAL_PAD_CHAR = 0
+bi = _mod("sidechainnet.structure.build_info")
+bi.NUM_COORDS_PER_RES = 14
+bi.BB_BUILD_INFO = {"BONDLENS": {"n-ca": 1.442, "ca-c": 1.498, "c-n": 1.379, "c-o": 1.229}}
+bi.SC_BUILD_INFO = {k: {"atom-names": v} for k, v in SC_ATOMS.items()}
+_mod("sidechainnet.structure")
+_mod("sidechainnet.structure.StructureBuilder")._get_residue_build_iter = lambda *a, **k: iter(())
+
+# mp_nerf
+mp = _mod("mp_nerf"); mp.proteins = _mod("mp_nerf.proteins")
+_mod("mp_nerf.kb_proteins"); _mod("mp_nerf.utils")
+
+# pytorch3d quaternion ops (pure torch)
+p3d = _mod("pytorch3d"); tr = _mod("pytorch3d.transforms")
+def quaternion_multiply(a, b):
+    aw, ax, ay, az = a.unbind(-1); bw, bx, by, bz = b.unbind(-1)
+    return torch.stack([aw*bw-ax*bx-ay*by-az*bz, aw*bx+ax*bw+ay*bz-az*by,
+                        aw*by-ax*bz+ay*bw+az*bx, aw*bz+ax*by-ay*bx+az*bw], -1)
+def quaternion_to_matrix(q):
+    q = q / q.norm(dim=-1, keepdim=True)
+    w, x, y, z = q.unbind(-1)
+    return torch.stack([
+        torch.stack([1-2*(y*y+z*z), 2*(x*y-z*w), 2*(x*z+y*w)], -1),
+        torch.stack([2*(x*y+z*w), 1-2*(x*x+z*z), 2*(y*z-x*w)], -1),
+        torch.stack([2*(x*z-y*w), 2*(y*z+x*w), 1-2*(x*x+y*y)], -1)], -2)
+tr.quaternion_multiply = quaternion_multiply
+tr.quaternion_to_matrix = quaternion_to_matrix
+p3d.transforms = tr
+
+# invariant_point_attention — not exercised by the trunk bench
+ipa = _mod("invariant_point_attention")
+class IPABlock(torch.nn.Module):
+    def __init__(self, *a, **k):
+        super().__init__()
+        self.attn = types.SimpleNamespace(to_out=torch.nn.Linear(1, 1))
+ipa.IPABlock = IPABlock

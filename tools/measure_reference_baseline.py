@@ -1,0 +1,68 @@
+"""Measure the reference implementation's step time on matched configs.
+
+The reference (lucidrains/alphafold2) publishes no numbers (BASELINE.md), so
+the baseline is measured here: its distogram training step (forward + CE
+loss + backward + Adam step) at dim=256, depth=2, 256-res crop, batch 1,
+5-row MSA — torch CPU (the only backend the reference can use in this
+container). Writes tools/reference_baseline.json.
+"""
+import json, os, sys, time
+
+sys.path.insert(0, "/root/reference")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _reference_stubs  # noqa: F401
+import torch
+import torch.nn.functional as F
+
+from alphafold2_pytorch import Alphafold2
+from alphafold2_pytorch.utils import get_bucketed_distance_matrix
+
+torch.manual_seed(0)
+torch.set_num_threads(os.cpu_count())
+DIM, DEPTH, L, MSA, B = 256, 2, 256, 5, 1
+
+model = Alphafold2(dim=DIM, depth=DEPTH, heads=8, dim_head=64)
+opt = torch.optim.Adam(model.parameters(), lr=3e-4)
+
+seq = torch.randint(0, 21, (B, L))
+msa = torch.randint(0, 21, (B, MSA, L))
+mask = torch.ones(B, L).bool()
+msa_mask = torch.ones(B, MSA, L).bool()
+coords = torch.cumsum(torch.randn(B, L, 3), dim=1)
+
+def step():
+    ret = model(seq, msa, mask=mask, msa_mask=msa_mask)
+    target = get_bucketed_distance_matrix(coords, mask)
+    loss = F.cross_entropy(ret.distance.reshape(-1, 37), target.reshape(-1),
+                           ignore_index=-100)
+    if ret.msa_mlm_loss is not None:
+        loss = loss + ret.msa_mlm_loss
+    loss.backward()
+    opt.step(); opt.zero_grad()
+    return float(loss)
+
+# warmup
+step()
+times = []
+for _ in range(3):
+    t0 = time.perf_counter(); step(); times.append(time.perf_counter() - t0)
+
+fwd_times = []
+with torch.no_grad():
+    model.eval()
+    for _ in range(3):
+        t0 = time.perf_counter()
+        model(seq, msa, mask=mask, msa_mask=msa_mask)
+        fwd_times.append(time.perf_counter() - t0)
+
+out = {
+    "config": {"dim": DIM, "depth": DEPTH, "seq_len": L, "msa_depth": MSA,
+               "batch": B, "backend": "torch-cpu",
+               "threads": torch.get_num_threads()},
+    "train_step_seconds": min(times),
+    "forward_seconds": min(fwd_times),
+}
+with open(os.path.join(os.path.dirname(__file__), "reference_baseline.json"),
+          "w") as f:
+    json.dump(out, f, indent=2)
+print(json.dumps(out))
