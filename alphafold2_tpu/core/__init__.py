@@ -1,2 +1,4 @@
-from alphafold2_tpu.core import geometry, quaternion, rigid  # noqa: F401
+from alphafold2_tpu.core import geometry, mds, nerf, quaternion, rigid  # noqa: F401
+from alphafold2_tpu.core.mds import MDSResult, mdscaling  # noqa: F401
+from alphafold2_tpu.core.nerf import nerf_place, sidechain_container  # noqa: F401
 from alphafold2_tpu.core.rigid import Rigid  # noqa: F401

@@ -1,1 +1,19 @@
+from alphafold2_tpu.data import featurize, graph, scn  # noqa: F401
+from alphafold2_tpu.data.featurize import (  # noqa: F401
+    collate,
+    distance_map_targets,
+    subsample_msa,
+    tokenize,
+)
+from alphafold2_tpu.data.graph import (  # noqa: F401
+    mat_input_to_masked,
+    nth_deg_adjacency,
+    prot_covalent_bond,
+)
+from alphafold2_tpu.data.scn import (  # noqa: F401
+    chain2atoms,
+    scn_atom_embedd,
+    scn_backbone_mask,
+    scn_cloud_mask,
+)
 from alphafold2_tpu.data.synthetic import pad_to, synthetic_batch  # noqa: F401

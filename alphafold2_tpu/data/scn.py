@@ -1,0 +1,77 @@
+"""Sidechainnet-format mask/embedding utilities.
+
+Parity with the reference's scn helpers
+(/root/reference/alphafold2_pytorch/utils.py:423-495): per-residue atom
+cloud masks over the 14-slot layout, backbone (N/CA/C) index masks, and
+atom-id token embeddings — reimplemented as dense table lookups
+(constants.CLOUD_MASK_TABLE / ATOM_ID_TABLE) so they are single gathers on
+TPU instead of per-residue Python dict lookups.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from alphafold2_tpu import constants
+
+
+def scn_cloud_mask(
+    seq: jnp.ndarray,
+    coords: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """(b, L) int tokens -> (b, L, 14) occupancy mask. If `coords`
+    ((b, L, 14, 3) or (b, L*14, 3)) is given, derive the mask from nonzero
+    coordinates instead (reference utils.py:423-455 `scn_cloud_mask` with
+    coords)."""
+    if coords is not None:
+        if coords.ndim == 3:
+            coords = coords.reshape(coords.shape[0], -1,
+                                    constants.NUM_COORDS_PER_RES, 3)
+        return (jnp.abs(coords).sum(-1) != 0).astype(jnp.float32)
+    table = jnp.asarray(constants.CLOUD_MASK_TABLE)
+    return table[seq]
+
+
+def scn_backbone_mask(seq: jnp.ndarray, boolean: bool = True):
+    """(b, L) -> masks over the flat (L*14,) atom cloud selecting N, CA, C
+    (slots 0, 1, 2) (reference utils.py:457-477). Returns (n_mask, ca_mask,
+    c_mask), each (b, L*14) bool or index arrays when boolean=False."""
+    b, l = seq.shape
+    k = constants.NUM_COORDS_PER_RES
+    slot = np.tile(np.arange(k), l)
+    n_mask = jnp.asarray(slot == 0)
+    ca_mask = jnp.asarray(slot == 1)
+    c_mask = jnp.asarray(slot == 2)
+    if boolean:
+        tile = lambda m: jnp.broadcast_to(m[None], (b, l * k))
+        return tile(n_mask), tile(ca_mask), tile(c_mask)
+    idx = lambda m: jnp.asarray(np.nonzero(np.asarray(m))[0])
+    return idx(n_mask), idx(ca_mask), idx(c_mask)
+
+
+def backbone_indices(seq_len: int):
+    """Static (L,) index arrays of N/CA/C atoms in the flat L*14 cloud —
+    the form `core.mds.mirror_fix` consumes."""
+    k = constants.NUM_COORDS_PER_RES
+    base = np.arange(seq_len) * k
+    return (jnp.asarray(base), jnp.asarray(base + 1), jnp.asarray(base + 2))
+
+
+def scn_atom_embedd(seq: jnp.ndarray) -> jnp.ndarray:
+    """(b, L) -> (b, L, 14) atom-id tokens (reference utils.py:479-495)."""
+    table = jnp.asarray(constants.ATOM_ID_TABLE)
+    return table[seq]
+
+
+def chain2atoms(x: jnp.ndarray, mask: Optional[jnp.ndarray] = None):
+    """Expand per-residue features to per-atom (reference utils.py:417-421):
+    (b, L, d) -> (b, L, 14, d)."""
+    out = jnp.broadcast_to(
+        x[..., None, :],
+        (*x.shape[:-1], constants.NUM_COORDS_PER_RES, x.shape[-1]))
+    if mask is not None:
+        out = out * mask[..., None]
+    return out

@@ -1,0 +1,158 @@
+"""Pretrained-embedding wrappers: ESM-1b, MSA-Transformer, ProtTrans.
+
+Parity with the reference wrapper layer
+(/root/reference/alphafold2_pytorch/embeds.py:10-103) and its extractor
+helpers (utils.py:255-390): wrap an Alphafold2 model so sequences/MSAs are
+first embedded by a frozen pretrained protein LM, the embeddings projected
+to model dim and injected as `seq_embed` / `msa_embed`.
+
+Host/TPU split (TPU-first design): the frozen torch LMs run host-side on
+CPU out of the XLA graph (they are preprocessing, not training state);
+only the resulting arrays cross to the device. All hub/HF loads are lazy
+and gated — in an offline container construction raises a clear error
+instead of failing at import time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from alphafold2_tpu import constants
+from alphafold2_tpu.data.featurize import detokenize
+
+
+def _lazy_torch():
+    import torch  # local import: torch is host-side only here
+    return torch
+
+
+class _PretrainedWrapper:
+    """Common scaffolding: wraps (model, params) of an Alphafold2 and adds
+    embed injection. Subclasses define _load() and _embed()."""
+
+    def __init__(self, alphafold2, params=None):
+        self.alphafold2 = alphafold2
+        self.params = params
+        self._backend = None
+
+    def _ensure_loaded(self):
+        if self._backend is None:
+            try:
+                self._backend = self._load()
+            except Exception as exc:  # pragma: no cover - env dependent
+                raise RuntimeError(
+                    f"{type(self).__name__} needs its pretrained weights "
+                    f"(download failed or package missing): {exc}") from exc
+        return self._backend
+
+    def embed_batch(self, seq, msa=None):
+        """Returns (seq_embed, msa_embed) numpy arrays at LM dims."""
+        raise NotImplementedError
+
+    def __call__(self, params=None, seq=None, msa=None, **kwargs):
+        if params is None:
+            params = self.params
+        seq_embed, msa_embed = self.embed_batch(seq, msa)
+        return self.alphafold2.apply(params, seq, msa=msa,
+                                     seq_embed=seq_embed,
+                                     msa_embed=msa_embed, **kwargs)
+
+
+class ESMEmbedWrapper(_PretrainedWrapper):
+    """ESM-1b per-token embeddings (reference embeds.py:77-103,
+    utils.py:331-352; layer-33 representations, 1280-d)."""
+
+    REPR_LAYER = 33
+
+    def _load(self):
+        torch = _lazy_torch()
+        model, alphabet = torch.hub.load(*constants.ESM_MODEL_PATH)
+        batch_converter = alphabet.get_batch_converter()
+        model.eval()
+        return model, batch_converter
+
+    def _embed_tokens(self, tokens_2d) -> np.ndarray:
+        torch = _lazy_torch()
+        model, batch_converter = self._ensure_loaded()
+        data = [(f"s{i}", detokenize(row).replace("_", "<pad>"))
+                for i, row in enumerate(np.asarray(tokens_2d))]
+        _, _, toks = batch_converter(data)
+        with torch.no_grad():
+            out = model(toks, repr_layers=[self.REPR_LAYER],
+                        return_contacts=False)
+        reps = out["representations"][self.REPR_LAYER]
+        return reps[:, 1:1 + tokens_2d.shape[-1]].cpu().numpy()
+
+    def embed_batch(self, seq, msa=None):
+        seq_embed = self._embed_tokens(np.asarray(seq))
+        msa_embed = None
+        if msa is not None:
+            m = np.asarray(msa)
+            flat = m.reshape(-1, m.shape[-1])
+            msa_embed = self._embed_tokens(flat).reshape(*m.shape, -1)
+        return seq_embed, msa_embed
+
+
+class MSAEmbedWrapper(_PretrainedWrapper):
+    """MSA-Transformer row embeddings (reference embeds.py:33-75,
+    utils.py:308-329; esm_msa1 layer-12, 768-d)."""
+
+    REPR_LAYER = 12
+
+    def _load(self):
+        torch = _lazy_torch()
+        model, alphabet = torch.hub.load(*constants.MSA_MODEL_PATH)
+        model.eval()
+        return model, alphabet.get_batch_converter()
+
+    def embed_batch(self, seq, msa=None):
+        torch = _lazy_torch()
+        model, batch_converter = self._ensure_loaded()
+        assert msa is not None, "MSAEmbedWrapper needs an MSA"
+        m = np.asarray(msa)
+        embeds = []
+        for b in range(m.shape[0]):
+            data = [(f"r{r}", detokenize(m[b, r]).replace("_", "-"))
+                    for r in range(m.shape[1])]
+            # esm_msa1's MSABatchConverter already returns (1, R, L+1)
+            _, _, toks = batch_converter(data)
+            with torch.no_grad():
+                out = model(toks, repr_layers=[self.REPR_LAYER])
+            reps = out["representations"][self.REPR_LAYER]
+            embeds.append(reps[0, :, 1:1 + m.shape[-1]].cpu().numpy())
+        msa_embed = np.stack(embeds)
+        # first MSA row doubles as the sequence embedding (reference
+        # embeds.py:70-73 passes msa_embed and the model adds the seq row)
+        return msa_embed[:, 0], msa_embed
+
+
+class ProtTranEmbedWrapper(_PretrainedWrapper):
+    """ProtBERT embeddings via HuggingFace (reference embeds.py:10-31,
+    utils.py:295-306; 1024-d)."""
+
+    def _load(self):
+        from transformers import AutoModel, AutoTokenizer
+        name = "Rostlab/prot_bert"
+        return (AutoModel.from_pretrained(name),
+                AutoTokenizer.from_pretrained(name))
+
+    def _embed_tokens(self, tokens_2d) -> np.ndarray:
+        torch = _lazy_torch()
+        model, tokenizer = self._ensure_loaded()
+        texts = [" ".join(detokenize(row).replace("_", "X"))
+                 for row in np.asarray(tokens_2d)]
+        enc = tokenizer(texts, return_tensors="pt", padding=True)
+        with torch.no_grad():
+            out = model(**enc).last_hidden_state
+        return out[:, 1:1 + tokens_2d.shape[-1]].cpu().numpy()
+
+    def embed_batch(self, seq, msa=None):
+        seq_embed = self._embed_tokens(np.asarray(seq))
+        msa_embed = None
+        if msa is not None:
+            m = np.asarray(msa)
+            flat = m.reshape(-1, m.shape[-1])
+            msa_embed = self._embed_tokens(flat).reshape(*m.shape, -1)
+        return seq_embed, msa_embed

@@ -34,6 +34,7 @@ from alphafold2_tpu import constants
 from alphafold2_tpu.model.evoformer import Evoformer, PairwiseAttentionBlock
 from alphafold2_tpu.model.mlm import MLM
 from alphafold2_tpu.model.primitives import Attention, LayerNorm
+from alphafold2_tpu.model.refiners import Refiner
 from alphafold2_tpu.model.structure import StructureModule
 from alphafold2_tpu.parallel.sharding import shard_msa, shard_pair
 
@@ -89,6 +90,13 @@ class Alphafold2(nn.Module):
     structure_module_depth: int = 4
     structure_module_heads: int = 1
     structure_module_dim_head: int = 4
+    # README-era structure-module selection (reference README.md:106-112,
+    # :594-600; the current reference code is IPA-only): 'ipa' runs the
+    # IPA module; 'egnn' / 'en' / 'se3' run the equivariant refiners from
+    # model/refiners.py instead. refinement_iters > 0 additionally refines
+    # the produced coordinates (on top of any module type).
+    structure_module_type: str = "ipa"
+    structure_module_refinement_iters: int = 0
     disable_token_embed: bool = False
     mlm_mask_prob: float = 0.15
     mlm_random_replace_token_prob: float = 0.1
@@ -152,10 +160,25 @@ class Alphafold2(nn.Module):
                 return 0.0
             return token_emb(t).astype(self.dtype)
 
-        # embed main sequence (reference alphafold2.py:676-679)
+        # embed main sequence (reference alphafold2.py:676-679). Pretrained
+        # LM embeddings at foreign dims (= num_embedds) are projected here —
+        # the reference keeps this Linear inside its embed wrappers
+        # (embeds.py:14, :41, :84); model-side keeps the wrappers paramless.
+        # one projector per input width so a single params tree serves any
+        # of the pretrained-LM widths (768/1024/1280 — the reference sizes
+        # each wrapper's Linear from its own constant)
+        def project_embed(e, prefix):
+            e = e.astype(self.dtype)
+            if e.shape[-1] != self.dim:
+                e = nn.Dense(self.dim, param_dtype=jnp.float32,
+                             dtype=self.dtype,
+                             name=f"{prefix}_{e.shape[-1]}")(e)
+            return e
+
         x_single = embed_tokens(seq)
         if seq_embed is not None:
-            x_single = x_single + seq_embed.astype(self.dtype)
+            x_single = x_single + project_embed(seq_embed,
+                                                "seq_embed_project")
 
         # MLM noising for MSA during training (reference alphafold2.py:683-688)
         mlm = MLM(
@@ -182,7 +205,7 @@ class Alphafold2(nn.Module):
         if msa is not None:
             m = embed_tokens(msa)
             if msa_embed is not None:
-                m = m + msa_embed.astype(self.dtype)
+                m = m + project_embed(msa_embed, "msa_embed_project")
             m = m + x_single[:, None, :, :]
             if msa_mask is None:
                 msa_mask = jnp.ones_like(msa, dtype=bool)
@@ -328,6 +351,21 @@ class Alphafold2(nn.Module):
                 # path; create it otherwise
                 nn.Dense(self.dim, param_dtype=jnp.float32, dtype=self.dtype,
                          name="embedd_project")(zf(1, 1, 1, self.num_embedds))
+            # projector coverage for every known pretrained-LM width plus
+            # the configured num_embedds (skip widths this trace created)
+            widths = {constants.MSA_EMBED_DIM, constants.PROTTRAN_EMBED_DIM,
+                      constants.ESM_EMBED_DIM, self.num_embedds} - {self.dim}
+            seq_w = None if seq_embed is None else seq_embed.shape[-1]
+            msa_w = None if msa_embed is None else msa_embed.shape[-1]
+            for w in sorted(widths):
+                if w != seq_w:
+                    nn.Dense(self.dim, param_dtype=jnp.float32,
+                             dtype=self.dtype,
+                             name=f"seq_embed_project_{w}")(zf(1, 1, w))
+                if w != msa_w:
+                    nn.Dense(self.dim, param_dtype=jnp.float32,
+                             dtype=self.dtype,
+                             name=f"msa_embed_project_{w}")(zf(1, 1, 1, w))
             if not (train and original_msa is not None):
                 mlm(zf(1, 1, 1, self.dim), jnp.zeros((1, 1, 1), jnp.int32),
                     jnp.ones((1, 1, 1), bool))
@@ -416,12 +454,32 @@ class Alphafold2(nn.Module):
                                  name="trunk_to_pairwise_repr_dim")(
                                      x.astype(jnp.float32))
 
-        coords, single_out = StructureModule(
-            dim=self.dim,
-            depth=self.structure_module_depth,
-            heads=self.structure_module_heads,
-            name="structure_module",
-        )(single_repr, pairwise_repr, mask=mask)
+        if self.structure_module_type == "ipa":
+            coords, single_out = StructureModule(
+                dim=self.dim,
+                depth=self.structure_module_depth,
+                heads=self.structure_module_heads,
+                name="structure_module",
+            )(single_repr, pairwise_repr, mask=mask)
+        else:
+            # equivariant-refiner structure module: deterministic chain
+            # init (3.8 A CA spacing) breaks translational symmetry, then
+            # iterative E(n)/SE(3) updates driven by single + pair reprs
+            chain = jnp.arange(n, dtype=jnp.float32)[None, :, None] * \
+                jnp.asarray([3.8, 0.0, 0.0])
+            init_coords = jnp.broadcast_to(chain, (b, n, 3))
+            single_out, coords = Refiner(
+                dim=self.dim, kind=self.structure_module_type,
+                iters=self.structure_module_depth,
+                edge_dim=self.dim, name="structure_module_refiner",
+            )(single_repr, init_coords, edges=pairwise_repr, mask=mask)
+
+        if self.structure_module_refinement_iters > 0:
+            single_out, coords = Refiner(
+                dim=self.dim, kind="egnn",
+                iters=self.structure_module_refinement_iters,
+                edge_dim=self.dim, name="coords_refiner",
+            )(single_out, coords, edges=pairwise_repr, mask=mask)
 
         # confidence head always built (cheap Dense(1)) so one params tree
         # serves every return configuration

@@ -1,0 +1,184 @@
+"""Equivariant coordinate refiners: EGNN, En-Transformer-style, SE3-style.
+
+Capability parity with the reference's secondary structure modules — the
+README-era API `structure_module_type = 'se3' | 'egnn' | 'en'` with
+`refinement_iters` (/root/reference/README.md:106-112, :594-600,
+train_end2end.py:83-87) and the EGNN end-to-end notebook
+(notebooks/egnn_esm_end2end.ipynb cells 25-33). The reference outsources
+these to external CUDA-backed packages (egnn-pytorch, En-transformer,
+se3-transformer-pytorch — setup.py:19-34); here they are small pure-JAX
+message-passing layers:
+
+- E(n)-equivariant updates operate on distances and relative vectors only,
+  so rotating/translating inputs rotates/translates outputs exactly;
+- all-pairs messages are dense (b, n, n) tensors — at protein scale the
+  dense form is one MXU matmul, beating sparse gather/scatter on TPU
+  (SURVEY.md §2.4's torch-sparse note);
+- coordinate updates are tanh-clamped for stability (the notebook's NaN
+  debugging, cell 37, is the failure mode this guards).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from alphafold2_tpu.model.primitives import LayerNorm, zeros_init
+
+
+def _safe_norm2(v, eps=1e-8):
+    return jnp.sum(v * v, axis=-1, keepdims=True) + eps
+
+
+class EGNNLayer(nn.Module):
+    """One E(n)-GNN layer (Satorras et al.): invariant messages from
+    (h_i, h_j, ||x_i - x_j||^2, e_ij), equivariant coordinate update along
+    relative vectors."""
+
+    dim: int
+    edge_dim: int = 0
+    hidden: Optional[int] = None
+    coor_clamp: float = 3.0
+
+    @nn.compact
+    def __call__(self, h, x, edges=None, mask=None):
+        """h: (b, n, d) node feats; x: (b, n, 3) coords;
+        edges: (b, n, n, e) optional; mask: (b, n) optional."""
+        hidden = self.hidden or self.dim * 2
+        b, n, _ = h.shape
+
+        rel = x[:, :, None, :] - x[:, None, :, :]           # (b, n, n, 3)
+        dist2 = _safe_norm2(rel)                            # (b, n, n, 1)
+
+        feats = [jnp.broadcast_to(h[:, :, None, :], (b, n, n, h.shape[-1])),
+                 jnp.broadcast_to(h[:, None, :, :], (b, n, n, h.shape[-1])),
+                 dist2]
+        if edges is not None:
+            feats.append(edges)
+        msg_in = jnp.concatenate(feats, axis=-1)
+
+        msg = nn.Dense(hidden, param_dtype=jnp.float32, name="edge_mlp_in")(
+            msg_in)
+        msg = jax.nn.silu(msg)
+        msg = nn.Dense(hidden, param_dtype=jnp.float32, name="edge_mlp_out")(
+            msg)
+        msg = jax.nn.silu(msg)
+
+        if mask is not None:
+            pair_mask = (mask[:, :, None] & mask[:, None, :])[..., None]
+            msg = msg * pair_mask
+        # no self-messages
+        eye = jnp.eye(n, dtype=msg.dtype)[None, :, :, None]
+        msg = msg * (1.0 - eye)
+
+        # equivariant coordinate update, zero-init scale so the layer starts
+        # as identity on coordinates
+        coor_w = nn.Dense(1, param_dtype=jnp.float32, use_bias=False,
+                          kernel_init=zeros_init(), name="coor_mlp")(msg)
+        coor_w = jnp.tanh(coor_w) * self.coor_clamp
+        denom = jnp.maximum(
+            (mask.astype(x.dtype).sum(-1) - 1.0)[:, None, None]
+            if mask is not None else jnp.asarray(float(n - 1)), 1.0)
+        x = x + (rel / jnp.sqrt(dist2) * coor_w).sum(axis=2) / denom
+
+        # invariant feature update
+        agg = msg.sum(axis=2) / denom
+        h_in = jnp.concatenate([h, agg], axis=-1)
+        dh = nn.Dense(hidden, param_dtype=jnp.float32, name="node_mlp_in")(
+            h_in)
+        dh = jax.nn.silu(dh)
+        dh = nn.Dense(self.dim, param_dtype=jnp.float32, name="node_mlp_out")(
+            dh)
+        return h + dh, x
+
+
+class EnAttentionLayer(nn.Module):
+    """En-Transformer-style layer: attention-weighted invariant messages +
+    equivariant coordinate update (attention replaces EGNN's sum pooling;
+    reference capability via the `En-transformer` dependency,
+    setup.py:19-34)."""
+
+    dim: int
+    heads: int = 4
+    dim_head: int = 32
+    edge_dim: int = 0
+    coor_clamp: float = 3.0
+
+    @nn.compact
+    def __call__(self, h, x, edges=None, mask=None):
+        b, n, d = h.shape
+        hd, nh = self.dim_head, self.heads
+        inner = hd * nh
+
+        hn = LayerNorm(name="norm")(h)
+        q = nn.Dense(inner, use_bias=False, param_dtype=jnp.float32,
+                     name="to_q")(hn).reshape(b, n, nh, hd)
+        k = nn.Dense(inner, use_bias=False, param_dtype=jnp.float32,
+                     name="to_k")(hn).reshape(b, n, nh, hd)
+        v = nn.Dense(inner, use_bias=False, param_dtype=jnp.float32,
+                     name="to_v")(hn).reshape(b, n, nh, hd)
+
+        rel = x[:, :, None, :] - x[:, None, :, :]
+        dist2 = _safe_norm2(rel)
+
+        logits = jnp.einsum("bihd,bjhd->bhij", q, k) * (hd ** -0.5)
+        # distance-aware bias (+ optional pair-rep edge bias)
+        dist_bias = nn.Dense(nh, param_dtype=jnp.float32,
+                             name="dist_to_bias")(jnp.log(dist2))
+        logits = logits + dist_bias.transpose(0, 3, 1, 2)
+        if edges is not None:
+            logits = logits + nn.Dense(
+                nh, use_bias=False, param_dtype=jnp.float32,
+                name="edge_to_bias")(edges).transpose(0, 3, 1, 2)
+
+        if mask is not None:
+            pair_mask = mask[:, None, :, None] & mask[:, None, None, :]
+            logits = jnp.where(pair_mask, logits, -1e9)
+
+        attn = jax.nn.softmax(logits, axis=-1)              # (b, h, i, j)
+
+        out = jnp.einsum("bhij,bjhd->bihd", attn, v).reshape(b, n, inner)
+        h = h + nn.Dense(self.dim, param_dtype=jnp.float32,
+                         kernel_init=zeros_init(), bias_init=zeros_init(),
+                         name="to_out")(out)
+
+        # equivariant coordinate update weighted by mean attention
+        coor_w = nn.Dense(1, use_bias=False, param_dtype=jnp.float32,
+                          kernel_init=zeros_init(), name="coor_mlp")(
+                              attn.mean(1)[..., None])
+        coor_w = jnp.tanh(coor_w) * self.coor_clamp
+        x = x + (rel / jnp.sqrt(dist2) * coor_w).sum(axis=2) / max(n - 1, 1)
+        return h, x
+
+
+class Refiner(nn.Module):
+    """Iterative equivariant refinement head (README-era
+    `structure_module_type` + `refinement_iters`). Weight-shared layer
+    applied `iters` times, mirroring the reference's refinement loop."""
+
+    dim: int
+    kind: str = "egnn"        # 'egnn' | 'en' | 'se3'
+    iters: int = 4
+    edge_dim: int = 0
+    heads: int = 4
+
+    @nn.compact
+    def __call__(self, h, x, edges=None, mask=None):
+        if self.kind == "egnn":
+            layer = EGNNLayer(dim=self.dim, edge_dim=self.edge_dim,
+                              name="layer")
+        elif self.kind in ("en", "se3"):
+            # 'se3' maps onto the vector-equivariant attention layer: on
+            # point clouds with scalar features, SE(3) equivariance is
+            # exactly E(3) equivariance of this update
+            layer = EnAttentionLayer(dim=self.dim, heads=self.heads,
+                                     edge_dim=self.edge_dim, name="layer")
+        else:
+            raise ValueError(f"unknown refiner kind {self.kind!r}")
+
+        for _ in range(self.iters):
+            h, x = layer(h, x, edges=edges, mask=mask)
+        return h, x
