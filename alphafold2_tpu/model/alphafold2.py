@@ -97,6 +97,8 @@ class Alphafold2(nn.Module):
     # the produced coordinates (on top of any module type).
     structure_module_type: str = "ipa"
     structure_module_refinement_iters: int = 0
+    # reversible main trunk (README.md:40-era flag): O(1) activation memory
+    reversible: bool = False
     disable_token_embed: bool = False
     mlm_mask_prob: float = 0.15
     mlm_random_replace_token_prob: float = 0.1
@@ -335,7 +337,8 @@ class Alphafold2(nn.Module):
         x, m = Evoformer(
             dim=self.dim, depth=self.depth, heads=self.heads,
             dim_head=self.dim_head, attn_dropout=self.attn_dropout,
-            ff_dropout=self.ff_dropout, dtype=self.dtype, name="net",
+            ff_dropout=self.ff_dropout, dtype=self.dtype,
+            reversible=self.reversible, name="net",
         )(x, m, mask=x_mask, msa_mask=msa_mask, deterministic=deterministic)
 
         # --- init-time coverage of conditional branches -------------------

@@ -146,10 +146,27 @@ class Evoformer(nn.Module):
     global_column_attn: bool = False
     dtype: jnp.dtype = jnp.float32
     use_scan: bool = True
+    # O(1)-activation reversible trunk (model/reversible.py; reference
+    # README.md:40 `reversible=True`, reversible.py)
+    reversible: bool = False
 
     @nn.compact
     def __call__(self, x, m, mask=None, msa_mask=None,
                  deterministic: bool = True):
+        if self.reversible:
+            # the reversible trunk is deterministic by construction (exact
+            # inverse reconstruction); refuse configs that expect dropout
+            # rather than silently ignoring it
+            assert self.attn_dropout == 0.0 and self.ff_dropout == 0.0, \
+                "reversible trunk does not support dropout"
+            from alphafold2_tpu.model.reversible import ReversibleEvoformer
+            return ReversibleEvoformer(
+                dim=self.dim, depth=self.depth, heads=self.heads,
+                dim_head=self.dim_head,
+                global_column_attn=self.global_column_attn,
+                dtype=self.dtype, name="rev")(
+                    x, m, mask=mask, msa_mask=msa_mask)
+
         block_kwargs = dict(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
             attn_dropout=self.attn_dropout, ff_dropout=self.ff_dropout,

@@ -1,0 +1,71 @@
+"""Rotary / fixed sinusoidal positional embeddings.
+
+Parity with the reference's rotary module
+(/root/reference/alphafold2_pytorch/rotary.py — vestigial there, kept for
+README-era API coverage): `rotate_every_two` + `apply_rotary_pos_emb`
+(rotary.py:9-20), sinusoidal `FixedPositionalEmbedding` (rotary.py:35-45),
+and the 2-D `AxialRotaryEmbedding` for pair-map axial attention
+(rotary.py:47-67). Pure functions over explicit lengths — no buffers, no
+device state.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def rotate_every_two(x: jnp.ndarray) -> jnp.ndarray:
+    """(..., 2k) -> pairwise (x1, x2) -> (-x2, x1) interleave."""
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    out = jnp.stack([-x2, x1], axis=-1)
+    return out.reshape(*x.shape)
+
+
+def apply_rotary_pos_emb(x: jnp.ndarray, sinu_pos: Tuple[jnp.ndarray,
+                                                         jnp.ndarray]):
+    """Rotate features by position: x*cos + rotate_every_two(x)*sin.
+    sinu_pos: (sin, cos) each (..., n, d_rot). When d_rot < x's feature
+    dim, only the first d_rot channels rotate and the rest pass through
+    (the reference's partial-rotation behavior, rotary.py:15-20)."""
+    sin, cos = sinu_pos
+    rot_dim = sin.shape[-1]
+    if rot_dim < x.shape[-1]:
+        x_rot, x_pass = x[..., :rot_dim], x[..., rot_dim:]
+        x_rot = x_rot * cos + rotate_every_two(x_rot) * sin
+        return jnp.concatenate([x_rot, x_pass], axis=-1)
+    return x * cos + rotate_every_two(x) * sin
+
+
+def fixed_positional_embedding(seq_len: int, dim: int,
+                               dtype=jnp.float32):
+    """Sinusoidal (sin, cos) tables, each (seq_len, dim) with frequencies
+    duplicated pairwise so they align with rotate_every_two."""
+    inv_freq = 1.0 / (10000 ** (jnp.arange(0, dim, 2, dtype=dtype) / dim))
+    t = jnp.arange(seq_len, dtype=dtype)
+    freqs = jnp.einsum("i,j->ij", t, inv_freq)
+    freqs = jnp.repeat(freqs, 2, axis=-1)
+    return jnp.sin(freqs), jnp.cos(freqs)
+
+
+def axial_rotary_embedding(height: int, width: int, dim: int,
+                           dtype=jnp.float32):
+    """2-D rotary tables for an (i, j) pair map: half the channels encode
+    the row coordinate, half the column (reference rotary.py:47-67).
+    Returns (sin, cos) each (height, width, dim)."""
+    assert dim % 4 == 0, \
+        "axial rotary needs dim % 4 == 0 (two rotary halves of even width)"
+    half = dim // 2
+    sin_h, cos_h = fixed_positional_embedding(height, half, dtype)
+    sin_w, cos_w = fixed_positional_embedding(width, half, dtype)
+    sin = jnp.concatenate([
+        jnp.broadcast_to(sin_h[:, None, :], (height, width, half)),
+        jnp.broadcast_to(sin_w[None, :, :], (height, width, half)),
+    ], axis=-1)
+    cos = jnp.concatenate([
+        jnp.broadcast_to(cos_h[:, None, :], (height, width, half)),
+        jnp.broadcast_to(cos_w[None, :, :], (height, width, half)),
+    ], axis=-1)
+    return sin, cos

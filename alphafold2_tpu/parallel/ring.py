@@ -1,0 +1,137 @@
+"""Ring attention: exact attention over a sequence axis sharded across
+devices, with K/V shards rotated around the mesh ring via `ppermute`.
+
+This is the long-context strategy the reference lacks entirely (SURVEY.md
+§5.7 — its sequence scaling is all single-device tricks: axial
+factorization, sparse/linear attention, checkpointing). On TPU the ring
+maps 1:1 onto ICI neighbors: each step overlaps a blockwise flash-style
+attention update with the neighbor exchange, so memory per device is
+O(L/n_shards) for K/V while the math stays exactly softmax attention
+(online log-sum-exp accumulation, Liu et al. 2023 "Ring Attention with
+Blockwise Transformers").
+
+Use inside `shard_map` over a mesh axis; `ring_attention_sharded` wraps
+that for (b, n, h, d) inputs sharded on n.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_attend(q, k, v, bias, acc, row_max, row_sum):
+    """One blockwise online-softmax update.
+
+    q: (b, h, nq, d); k/v: (b, h, nk, d); bias: (b, h, nq, nk) or None;
+    acc: (b, h, nq, d) running weighted sum; row_max/row_sum: (b, h, nq).
+    Returns updated (acc, row_max, row_sum).
+    """
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+    if bias is not None:
+        logits = logits + bias
+
+    new_max = jnp.maximum(row_max, logits.max(-1))
+    correction = jnp.exp(row_max - new_max)
+    p = jnp.exp(logits - new_max[..., None])
+
+    acc = acc * correction[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    row_sum = row_sum * correction + p.sum(-1)
+    return acc, new_max, row_sum
+
+
+def ring_attention(
+    q: jnp.ndarray,      # (b, h, nq_local, d), pre-scaled
+    k: jnp.ndarray,      # (b, h, nk_local, d)
+    v: jnp.ndarray,      # (b, h, nk_local, d)
+    axis_name: str,
+    bias: Optional[jnp.ndarray] = None,   # (b, h, nq_local, nk_GLOBAL)
+    mask: Optional[jnp.ndarray] = None,   # (b, nk_GLOBAL) key validity
+) -> jnp.ndarray:
+    """Exact attention where each device holds one K/V shard; runs inside
+    shard_map/pmap over `axis_name`. bias/mask carry the GLOBAL key axis
+    (every device already holds its full rows of pair bias)."""
+    n_shards = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    nk = k.shape[-2]
+
+    b, h, nq, d = q.shape
+    acc = jnp.zeros((b, h, nq, d), jnp.float32)
+    row_max = jnp.full((b, h, nq), -jnp.inf, jnp.float32)
+    row_sum = jnp.zeros((b, h, nq), jnp.float32)
+
+    def slice_global(x, shard):
+        start = shard * nk
+        return jax.lax.dynamic_slice_in_dim(x, start, nk, axis=-1)
+
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    def body(step, carry):
+        acc, row_max, row_sum, k_cur, v_cur = carry
+        # which global shard the current K/V block came from
+        shard = (my_idx - step) % n_shards
+
+        blk_bias = None
+        if bias is not None:
+            blk_bias = slice_global(bias, shard).astype(jnp.float32)
+        if mask is not None:
+            key_ok = slice_global(mask, shard)
+            mbias = jnp.where(key_ok[:, None, None, :], 0.0, -1e9)
+            blk_bias = mbias if blk_bias is None else blk_bias + mbias
+
+        acc, row_max, row_sum = _block_attend(
+            q.astype(jnp.float32), k_cur.astype(jnp.float32),
+            v_cur.astype(jnp.float32), blk_bias, acc, row_max, row_sum)
+
+        # rotate K/V to the next device (skippable on the last step, but a
+        # uniform loop keeps the collective schedule static)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return acc, row_max, row_sum, k_nxt, v_nxt
+
+    acc, row_max, row_sum, _, _ = jax.lax.fori_loop(
+        0, n_shards, body, (acc, row_max, row_sum, k, v))
+
+    out = acc / jnp.maximum(row_sum[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jnp.ndarray,      # (b, h, n, d) global
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis: str,
+    bias: Optional[jnp.ndarray] = None,   # (b, h, n, n) global
+    mask: Optional[jnp.ndarray] = None,   # (b, n) global
+) -> jnp.ndarray:
+    """shard_map wrapper: shards q/k/v (and bias rows) over `axis` on the
+    sequence dim and runs the ring. Result comes back sharded the same way.
+    """
+    seq_spec = P(None, None, axis, None)
+    bias_spec = P(None, None, axis, None)
+
+    in_specs = [seq_spec, seq_spec, seq_spec]
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(bias_spec)
+        args.append(bias)
+    if mask is not None:
+        in_specs.append(P(None, None))
+        args.append(mask)
+
+    def kernel(*xs):
+        qi, ki, vi = xs[0], xs[1], xs[2]
+        rest = list(xs[3:])
+        bi = rest.pop(0) if bias is not None else None
+        mi = rest.pop(0) if mask is not None else None
+        return ring_attention(qi, ki, vi, axis, bias=bi, mask=mi)
+
+    fn = jax.shard_map(
+        kernel, mesh=mesh, in_specs=tuple(in_specs), out_specs=seq_spec,
+        check_vma=False)
+    return fn(*args)
