@@ -1,0 +1,117 @@
+"""One typed configuration tree for model, data, mesh, and training.
+
+The reference spreads configuration over three uncoordinated mechanisms
+(SURVEY.md §5.6: constructor kwargs, script-level module constants, argparse
+in one DataModule). Here a single dataclass tree drives everything;
+`Experiment.build()` materializes the model, optimizer, mesh, and train
+step from it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+
+@dataclass
+class ModelConfig:
+    dim: int = 256
+    depth: int = 6
+    heads: int = 8
+    dim_head: int = 64
+    max_rel_dist: int = 32
+    predict_angles: bool = False
+    symmetrize_omega: bool = False
+    predict_coords: bool = False
+    structure_module_depth: int = 4
+    structure_module_heads: int = 1
+    structure_module_type: str = "ipa"
+    structure_module_refinement_iters: int = 0
+    reversible: bool = False
+    extra_msa_evoformer_layers: int = 4
+    attn_dropout: float = 0.0
+    ff_dropout: float = 0.0
+    bfloat16: bool = True
+
+    def build(self):
+        from alphafold2_tpu import Alphafold2
+        kwargs = dataclasses.asdict(self)
+        use_bf16 = kwargs.pop("bfloat16")
+        return Alphafold2(
+            **kwargs, dtype=jnp.bfloat16 if use_bf16 else jnp.float32)
+
+
+@dataclass
+class DataConfig:
+    crop_len: int = 128
+    msa_depth: int = 5
+    batch_size: int = 1
+    root: Optional[str] = None        # trrosetta-style data dir; None=synthetic
+
+
+@dataclass
+class MeshConfig:
+    data: int = 1
+    i: int = 1
+    j: int = 1
+
+    def build(self):
+        from alphafold2_tpu.parallel import make_mesh
+        if self.data * self.i * self.j == 1:
+            return None
+        return make_mesh(self.data, self.i, self.j)
+
+
+@dataclass
+class TrainConfig:
+    learning_rate: float = 3e-4
+    grad_accum_every: int = 16        # reference train_pre.py:16
+    max_grad_norm: Optional[float] = None
+    num_steps: int = 1000
+    log_every: int = 10
+    checkpoint_dir: Optional[str] = None
+    seed: int = 0
+
+
+@dataclass
+class Experiment:
+    model: ModelConfig = field(default_factory=ModelConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+
+    # --- serialization -----------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Experiment":
+        return cls(
+            model=ModelConfig(**d.get("model", {})),
+            data=DataConfig(**d.get("data", {})),
+            mesh=MeshConfig(**d.get("mesh", {})),
+            train=TrainConfig(**d.get("train", {})),
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "Experiment":
+        return cls.from_dict(json.loads(text))
+
+    # --- materialization ---------------------------------------------------
+
+    def build(self):
+        """Returns (model, tx, mesh)."""
+        from alphafold2_tpu.train import adam
+        model = self.model.build()
+        tx = adam(self.train.learning_rate, self.train.grad_accum_every,
+                  self.train.max_grad_norm)
+        mesh = self.mesh.build()
+        return model, tx, mesh

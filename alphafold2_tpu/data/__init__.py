@@ -1,4 +1,11 @@
-from alphafold2_tpu.data import featurize, graph, scn  # noqa: F401
+from alphafold2_tpu.data import (  # noqa: F401
+    featurize,
+    graph,
+    native,
+    pdb_io,
+    scn,
+    trrosetta,
+)
 from alphafold2_tpu.data.featurize import (  # noqa: F401
     collate,
     distance_map_targets,

@@ -125,6 +125,39 @@ class Attention(nn.Module):
 
         q = q * (dh ** -0.5)
 
+        if mask is not None:
+            if has_context:
+                cmask = context_mask if context_mask is not None else \
+                    jnp.ones(k.shape[:1] + k.shape[-2:-1], dtype=bool)
+            else:
+                cmask = mask
+            pair_mask = mask[:, None, :, None] & cmask[:, None, None, :]
+        else:
+            pair_mask = None
+
+        # optional Pallas fused path (bias+softmax+AV in one VMEM-resident
+        # kernel; alphafold2_tpu/ops/attention.py). Tie-dim (global-query)
+        # and dropout-active traces fall back to the XLA path. Both
+        # backends share the gating/projection tail below.
+        from alphafold2_tpu.ops.attention import (
+            fused_attention, pallas_attention_enabled)
+        if pallas_attention_enabled() and tie_dim is None and \
+                (self.dropout == 0.0 or deterministic):
+            b_all = q.shape[0]
+            n_q, n_k = q.shape[-2], k.shape[-2]
+            bias_full = jnp.zeros((b_all, h, n_q, n_k), jnp.float32)
+            if attn_bias is not None:
+                bias_full = bias_full + attn_bias.astype(jnp.float32)
+            if pair_mask is not None:
+                bias_full = jnp.where(pair_mask, bias_full, MASK_VALUE)
+            out = fused_attention(
+                q.reshape(b_all * h, n_q, dh),
+                k.reshape(b_all * h, n_k, dh),
+                v.reshape(b_all * h, n_k, dh),
+                bias_full.reshape(b_all * h, n_q, n_k))
+            out = out.reshape(b_all, h, n_q, dh)
+            return self._finish(out, x, inner, dense)
+
         if tie_dim is not None:
             # global-query attention: average queries across the tied rows
             # (the paper's MSAColumnGlobalAttention; reference
@@ -140,32 +173,27 @@ class Attention(nn.Module):
         if attn_bias is not None:
             dots = dots + attn_bias.astype(dots.dtype)
 
-        if mask is not None:
-            if has_context:
-                cmask = context_mask if context_mask is not None else \
-                    jnp.ones(k.shape[:1] + k.shape[-2:-1], dtype=bool)
-            else:
-                cmask = mask
-            pair_mask = mask[:, None, :, None] & cmask[:, None, None, :]
+        if pair_mask is not None:
             dots = jnp.where(pair_mask, dots, MASK_VALUE)
 
         attn = jnn.softmax(dots, axis=-1)
         attn = nn.Dropout(self.dropout, deterministic=deterministic)(attn)
 
         out = jnp.einsum("bhij,bhjd->bhid", attn, v)
-        out = out.swapaxes(-2, -3).reshape(*x.shape[:-1], inner)
+        return self._finish(out, x, inner, dense)
 
+    def _finish(self, out, x, inner, dense):
+        """Shared tail of both attention backends: merge heads, sigmoid
+        gate from the input (init pass-through, reference
+        alphafold2.py:118-120), zero-init output projection
+        (alphafold2.py:123)."""
+        out = out.swapaxes(-2, -3).reshape(*x.shape[:-1], inner)
         if self.gating:
-            # sigmoid gate from the input, initialized to pass-through
-            # (reference alphafold2.py:118-120)
             gates = dense(inner, "gating", kernel_init=zeros_init(),
                           bias_init=ones_init())(x)
             out = out * jnn.sigmoid(gates)
-
-        # zero-init output projection (reference alphafold2.py:123)
-        out = dense(self.dim, "to_out", kernel_init=zeros_init(),
-                    bias_init=zeros_init())(out)
-        return out
+        return dense(self.dim, "to_out", kernel_init=zeros_init(),
+                     bias_init=zeros_init())(out)
 
 
 class AxialAttention(nn.Module):

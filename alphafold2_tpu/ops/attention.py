@@ -1,0 +1,117 @@
+"""Fused attention Pallas kernel for the Evoformer's axial attention.
+
+The hot loop of the trunk is gated axial attention over rows/columns of
+length <= crop (128-384) with an additive pair bias
+(SURVEY.md §3.1; reference Attention at alphafold2.py:98-190). XLA already
+fuses bias+softmax well, but it materializes the (B*L, H, N, N) logits in
+HBM between the two matmuls; this kernel keeps the whole row block
+resident in VMEM (crop-sized N fits comfortably: 384*64*4B per head-block)
+and writes only the (N, D) output — one HBM round-trip instead of three.
+
+Shapes are the post-folding axial layout: q/k/v (B, N, D) with heads folded
+into B, bias (B, N, N) already containing mask fill. Softmax runs in fp32
+regardless of input dtype.
+
+Selection: `use_pallas_attention(True)` flips the backend globally (the
+flax modules read the flag at trace time); it requires a TPU backend —
+under CPU tests the kernel runs in interpreter mode only inside its own
+unit tests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas import is TPU/CPU-safe; guard for exotic builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    HAS_PALLAS = False
+
+_BACKEND = {"pallas": False}
+
+
+def use_pallas_attention(enabled: bool = True):
+    """Globally select the Pallas fused-attention path."""
+    _BACKEND["pallas"] = enabled and HAS_PALLAS
+
+
+def pallas_attention_enabled() -> bool:
+    return _BACKEND["pallas"]
+
+
+@contextlib.contextmanager
+def pallas_attention(enabled: bool = True):
+    prev = _BACKEND["pallas"]
+    use_pallas_attention(enabled)
+    try:
+        yield
+    finally:
+        _BACKEND["pallas"] = prev
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale):
+    q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+    k = k_ref[0].astype(jnp.float32)                  # (n, d)
+    v = v_ref[0].astype(jnp.float32)                  # (n, d)
+    bias = bias_ref[0].astype(jnp.float32)            # (bq, n)
+
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) + bias    # (bq, n)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    out = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) / denom
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def fused_attention(
+    q: jnp.ndarray,        # (B, N, D)
+    k: jnp.ndarray,        # (B, N, D)
+    v: jnp.ndarray,        # (B, N, D)
+    bias: jnp.ndarray,     # (B, N, N) additive (mask already folded in)
+    block_q: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused bias+softmax+matmul attention. N and D should be multiples of
+    the TPU lane/sublane tiling (128 / 8); callers pad crops accordingly."""
+    b, n, d = q.shape
+    nk = k.shape[1]
+    # largest power-of-two block <= block_q that divides n, so any sequence
+    # length works (crops are normally multiples of 8 anyway)
+    bq = min(block_q, n)
+    while bq > 1 and n % bq != 0:
+        bq //= 2
+    block_q = bq if n % bq == 0 else 1
+    scale = 1.0  # caller pre-scales q (matches model convention)
+
+    grid = (b, n // block_q)
+    return pl.pallas_call(
+        functools.partial(_attn_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((b, n, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, nk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, nk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, nk), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(q, k, v, bias)
+
+
+def attention_reference(q, k, v, bias):
+    """XLA reference of the same contract (used for tests and fallback)."""
+    logits = jnp.einsum("bnd,bmd->bnm", q, k).astype(jnp.float32) + \
+        bias.astype(jnp.float32)
+    attn = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bnm,bmd->bnd", attn.astype(q.dtype), v)

@@ -1,0 +1,2 @@
+from alphafold2_tpu.utils.logging import MetricsLogger  # noqa: F401
+from alphafold2_tpu.utils.profiling import StepTimer, annotate, trace  # noqa: F401

@@ -1,0 +1,52 @@
+"""Metrics logging.
+
+Net-new vs the reference's `print('loss:', ...)` (SURVEY.md §5.5;
+train_pre.py:93): structured scalar logging to stdout and/or a JSONL file,
+compatible with `train.fit(logger=...)`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import IO, Optional
+
+
+class MetricsLogger:
+    """log(step=..., **scalars) -> one JSONL record (+ pretty stdout)."""
+
+    def __init__(self, path: Optional[str] = None, stdout: bool = True):
+        self.stdout = stdout
+        self._fh: Optional[IO] = None
+        if path is not None:
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            self._fh = open(path, "a")
+        self._t0 = time.time()
+
+    def log(self, step: int, **scalars):
+        record = {"step": int(step),
+                  "wall_s": round(time.time() - self._t0, 3)}
+        record.update({k: float(v) for k, v in scalars.items()})
+        if self._fh is not None:
+            self._fh.write(json.dumps(record) + "\n")
+            self._fh.flush()
+        if self.stdout:
+            parts = " ".join(f"{k}={v:.4g}" for k, v in record.items()
+                             if k not in ("step", "wall_s"))
+            print(f"[step {record['step']:>6}] {parts}", file=sys.stdout,
+                  flush=True)
+        return record
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

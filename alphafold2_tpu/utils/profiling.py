@@ -1,0 +1,81 @@
+"""Tracing / profiling utilities.
+
+Net-new vs the reference, which has no profiler hooks at all (SURVEY.md
+§5.1 — ad-hoc time.time() in a notebook is all it offers). Step time IS
+the benchmark metric (BASELINE.json), so the timer is first-class:
+
+- `StepTimer`: wall-clock accumulator with mean/p50/min stats, used by
+  `train.fit(step_timer=...)` and bench.py;
+- `trace`: context manager around `jax.profiler` emitting a TensorBoard-
+  loadable trace directory;
+- `annotate`: named-scope annotation that shows up in profiler timelines.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import List, Optional
+
+import jax
+
+
+class StepTimer:
+    """Accumulates wall-clock step durations (seconds)."""
+
+    def __init__(self):
+        self.durations: List[float] = []
+        self._start: Optional[float] = None
+
+    def start(self):
+        self._start = time.perf_counter()
+
+    def stop(self):
+        if self._start is None:
+            raise RuntimeError("StepTimer.stop() without start()")
+        self.durations.append(time.perf_counter() - self._start)
+        self._start = None
+
+    @contextlib.contextmanager
+    def measure(self):
+        self.start()
+        try:
+            yield
+        finally:
+            self.stop()
+
+    @property
+    def count(self) -> int:
+        return len(self.durations)
+
+    @property
+    def mean(self) -> float:
+        return sum(self.durations) / max(len(self.durations), 1)
+
+    @property
+    def p50(self) -> float:
+        if not self.durations:
+            return 0.0
+        s = sorted(self.durations)
+        return s[len(s) // 2]
+
+    @property
+    def best(self) -> float:
+        return min(self.durations) if self.durations else 0.0
+
+    def summary(self) -> dict:
+        return {"count": self.count, "mean_s": self.mean,
+                "p50_s": self.p50, "best_s": self.best}
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """jax.profiler trace scope; view with TensorBoard or xprof."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+annotate = jax.profiler.TraceAnnotation

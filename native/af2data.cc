@@ -1,0 +1,331 @@
+// Native host-side data loader for alphafold2-tpu.
+//
+// The reference's data path leans on native cores hidden inside Python
+// dependencies (BioPython/proDy/mdtraj/sidechainnet — SURVEY.md §2.4);
+// this library is the framework's own native equivalent for the hot
+// host-side work that feeds the TPU: MSA (a3m/FASTA) parsing +
+// tokenization and PDB parsing into the 14-slot sidechainnet atom layout.
+// Exposed as a C ABI consumed via ctypes (alphafold2_tpu/data/native.py);
+// no Python objects cross the boundary — only flat buffers.
+//
+// Build: see native/Makefile (g++ -O3 -shared -fPIC).
+
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// Tokenization must match alphafold2_tpu.constants.AA_ALPHABET
+// ("ARNDCQEGHILKMFPSTWYV_"): index 20 ('_') is padding/unknown.
+constexpr char kAlphabet[] = "ARNDCQEGHILKMFPSTWYV_";
+constexpr int kPad = 20;
+constexpr int kSlots = 14;  // NUM_COORDS_PER_RES
+
+int8_t TokenTable(unsigned char c) {
+  static int8_t table[256];
+  static bool init = false;
+  if (!init) {
+    memset(table, kPad, sizeof(table));
+    for (int i = 0; kAlphabet[i]; ++i) {
+      table[static_cast<unsigned char>(kAlphabet[i])] = i;
+      table[static_cast<unsigned char>(tolower(kAlphabet[i]))] = i;
+    }
+    init = true;
+  }
+  return table[c];
+}
+
+// sidechainnet slot order per residue: N CA C O then sidechain atoms.
+const std::unordered_map<std::string, std::unordered_map<std::string, int>>&
+SlotMap() {
+  static const auto* m = [] {
+    auto* mp = new std::unordered_map<std::string,
+                                      std::unordered_map<std::string, int>>;
+    struct Row { const char* res; const char* atoms; };
+    // atoms beyond the backbone, space separated, slot 4 onwards
+    static const Row rows[] = {
+        {"ALA", "CB"},
+        {"ARG", "CB CG CD NE CZ NH1 NH2"},
+        {"ASN", "CB CG OD1 ND2"},
+        {"ASP", "CB CG OD1 OD2"},
+        {"CYS", "CB SG"},
+        {"GLN", "CB CG CD OE1 NE2"},
+        {"GLU", "CB CG CD OE1 OE2"},
+        {"GLY", ""},
+        {"HIS", "CB CG ND1 CD2 CE1 NE2"},
+        {"ILE", "CB CG1 CG2 CD1"},
+        {"LEU", "CB CG CD1 CD2"},
+        {"LYS", "CB CG CD CE NZ"},
+        {"MET", "CB CG SD CE"},
+        {"PHE", "CB CG CD1 CD2 CE1 CE2 CZ"},
+        {"PRO", "CB CG CD"},
+        {"SER", "CB OG"},
+        {"THR", "CB OG1 CG2"},
+        {"TRP", "CB CG CD1 CD2 NE1 CE2 CE3 CZ2 CZ3 CH2"},
+        {"TYR", "CB CG CD1 CD2 CE1 CE2 CZ OH"},
+        {"VAL", "CB CG1 CG2"},
+    };
+    for (const auto& row : rows) {
+      auto& slots = (*mp)[row.res];
+      slots["N"] = 0;
+      slots["CA"] = 1;
+      slots["C"] = 2;
+      slots["O"] = 3;
+      int slot = 4;
+      std::string atoms(row.atoms);
+      size_t pos = 0;
+      while (pos < atoms.size()) {
+        size_t next = atoms.find(' ', pos);
+        if (next == std::string::npos) next = atoms.size();
+        if (next > pos) slots[atoms.substr(pos, next - pos)] = slot++;
+        pos = next + 1;
+      }
+    }
+    return mp;
+  }();
+  return *m;
+}
+
+const std::unordered_map<std::string, char>& ThreeToOne() {
+  static const auto* m = [] {
+    auto* mp = new std::unordered_map<std::string, char>{
+        {"ALA", 'A'}, {"ARG", 'R'}, {"ASN", 'N'}, {"ASP", 'D'},
+        {"CYS", 'C'}, {"GLN", 'Q'}, {"GLU", 'E'}, {"GLY", 'G'},
+        {"HIS", 'H'}, {"ILE", 'I'}, {"LEU", 'L'}, {"LYS", 'K'},
+        {"MET", 'M'}, {"PHE", 'F'}, {"PRO", 'P'}, {"SER", 'S'},
+        {"THR", 'T'}, {"TRP", 'W'}, {"TYR", 'Y'}, {"VAL", 'V'}};
+    return mp;
+  }();
+  return *m;
+}
+
+std::string Strip(const std::string& s) {
+  size_t a = s.find_first_not_of(" \t\r\n");
+  if (a == std::string::npos) return "";
+  size_t b = s.find_last_not_of(" \t\r\n");
+  return s.substr(a, b - a + 1);
+}
+
+}  // namespace
+
+extern "C" {
+
+// --- a3m / FASTA MSA parsing ---------------------------------------------
+//
+// Two-pass C ABI: msa_parse_a3m_size() reports (rows, cols) for the given
+// text; msa_parse_a3m() fills a preallocated int8 row-major (rows, cols)
+// token buffer. Insertions (lowercase letters and '.') are removed — the
+// ESM-style convention (reference utils.py:241-252); '-' maps to padding.
+// Returns 0 on success, negative on malformed input or width mismatch.
+
+int msa_parse_a3m_size(const char* text, int64_t len, int64_t* rows,
+                       int64_t* cols) {
+  *rows = 0;
+  *cols = 0;
+  std::string cur;
+  bool in_seq = false;
+  auto flush = [&]() -> int {
+    if (!in_seq) return 0;
+    int64_t width = 0;
+    for (char c : cur) {
+      if (c == '.' || (isalpha(static_cast<unsigned char>(c)) &&
+                       islower(static_cast<unsigned char>(c)))) {
+        continue;  // insertion
+      }
+      ++width;
+    }
+    if (*rows == 0) {
+      *cols = width;
+    } else if (width != *cols) {
+      return -2;  // ragged alignment
+    }
+    ++(*rows);
+    cur.clear();
+    return 0;
+  };
+
+  std::string line;
+  for (int64_t i = 0; i <= len; ++i) {
+    if (i == len || text[i] == '\n') {
+      std::string s = Strip(line);
+      line.clear();
+      if (!s.empty() && s[0] == '>') {
+        int rc = flush();
+        if (rc) return rc;
+        in_seq = true;
+        cur.clear();
+      } else if (!s.empty()) {
+        if (!in_seq && *rows == 0 && cur.empty()) in_seq = true;  // raw seqs
+        cur += s;
+      }
+    } else {
+      line += text[i];
+    }
+  }
+  return flush();
+}
+
+int msa_parse_a3m(const char* text, int64_t len, int8_t* out, int64_t rows,
+                  int64_t cols) {
+  int64_t row = 0;
+  std::string cur;
+  bool in_seq = false;
+  auto flush = [&]() -> int {
+    if (!in_seq) return 0;
+    if (row >= rows) return -3;
+    int64_t col = 0;
+    for (char c : cur) {
+      unsigned char u = static_cast<unsigned char>(c);
+      if (c == '.' || (isalpha(u) && islower(u))) continue;
+      if (col >= cols) return -2;
+      out[row * cols + col] = (c == '-') ? kPad : TokenTable(u);
+      ++col;
+    }
+    if (col != cols) return -2;
+    ++row;
+    cur.clear();
+    return 0;
+  };
+
+  std::string line;
+  for (int64_t i = 0; i <= len; ++i) {
+    if (i == len || text[i] == '\n') {
+      std::string s = Strip(line);
+      line.clear();
+      if (!s.empty() && s[0] == '>') {
+        int rc = flush();
+        if (rc) return rc;
+        in_seq = true;
+        cur.clear();
+      } else if (!s.empty()) {
+        if (!in_seq && row == 0 && cur.empty()) in_seq = true;
+        cur += s;
+      }
+    } else {
+      line += text[i];
+    }
+  }
+  int rc = flush();
+  if (rc) return rc;
+  return row == rows ? 0 : -3;
+}
+
+// --- PDB parsing into the 14-slot layout ---------------------------------
+//
+// pdb_parse_size(): number of residues (chain-filtered, first model).
+// pdb_parse(): fills seq tokens (int8, L), coords (float32, L*14*3) and
+// atom mask (int8, L*14). chain = '\0' accepts the first chain found.
+
+int pdb_parse_size(const char* text, int64_t len, char chain,
+                   int64_t* n_res) {
+  *n_res = 0;
+  char active_chain = chain;
+  int last_res = INT32_MIN;
+  char last_icode = 0;
+  std::string line;
+  for (int64_t i = 0; i <= len; ++i) {
+    if (i != len && text[i] != '\n') {
+      line += text[i];
+      continue;
+    }
+    if (line.rfind("ENDMDL", 0) == 0) break;
+    if (line.rfind("ATOM", 0) == 0 && line.size() >= 54) {
+      char ch = line[21];
+      if (active_chain == '\0') active_chain = ch;
+      // altloc filter must match pdb_parse or sizes diverge
+      char altloc = line[16];
+      if (ch == active_chain && (altloc == ' ' || altloc == 'A')) {
+        int resseq = atoi(line.substr(22, 4).c_str());
+        char icode = line[26];
+        if (resseq != last_res || icode != last_icode) {
+          ++(*n_res);
+          last_res = resseq;
+          last_icode = icode;
+        }
+      }
+    }
+    line.clear();
+  }
+  return 0;
+}
+
+int pdb_parse(const char* text, int64_t len, char chain, int8_t* seq,
+              float* coords, int8_t* mask, int64_t n_res) {
+  const auto& slot_map = SlotMap();
+  const auto& three_to_one = ThreeToOne();
+  char active_chain = chain;
+  int last_res = INT32_MIN;
+  char last_icode = 0;
+  int64_t idx = -1;
+  std::string line;
+  memset(mask, 0, n_res * kSlots);
+  memset(seq, kPad, n_res);
+
+  for (int64_t i = 0; i <= len; ++i) {
+    if (i != len && text[i] != '\n') {
+      line += text[i];
+      continue;
+    }
+    if (line.rfind("ENDMDL", 0) == 0) break;
+    if (line.rfind("ATOM", 0) == 0 && line.size() >= 54) {
+      char ch = line[21];
+      if (active_chain == '\0') active_chain = ch;
+      if (ch == active_chain) {
+        // altloc: accept ' ' or 'A' only
+        char altloc = line[16];
+        if (altloc == ' ' || altloc == 'A') {
+          int resseq = atoi(line.substr(22, 4).c_str());
+          char icode = line[26];
+          if (resseq != last_res || icode != last_icode) {
+            ++idx;
+            if (idx >= n_res) return -3;
+            last_res = resseq;
+            last_icode = icode;
+            std::string resname = Strip(line.substr(17, 3));
+            auto it = three_to_one.find(resname);
+            if (it != three_to_one.end()) {
+              seq[idx] = TokenTable(
+                  static_cast<unsigned char>(it->second));
+            }
+          }
+          std::string resname = Strip(line.substr(17, 3));
+          std::string atom = Strip(line.substr(12, 4));
+          auto res_it = slot_map.find(resname);
+          if (res_it != slot_map.end()) {
+            auto at_it = res_it->second.find(atom);
+            if (at_it != res_it->second.end()) {
+              int slot = at_it->second;
+              float x = atof(line.substr(30, 8).c_str());
+              float y = atof(line.substr(38, 8).c_str());
+              float z = atof(line.substr(46, 8).c_str());
+              float* dst = coords + (idx * kSlots + slot) * 3;
+              dst[0] = x;
+              dst[1] = y;
+              dst[2] = z;
+              mask[idx * kSlots + slot] = 1;
+            }
+          }
+        }
+      }
+    }
+    line.clear();
+  }
+  return 0;
+}
+
+// --- tokenization --------------------------------------------------------
+
+void tokenize_seq(const char* seq, int64_t len, int8_t* out) {
+  for (int64_t i = 0; i < len; ++i) {
+    char c = seq[i];
+    out[i] = (c == '-' || c == '.')
+                 ? kPad
+                 : TokenTable(static_cast<unsigned char>(c));
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,96 @@
+"""Distogram pretraining entry point.
+
+The reference's train_pre.py (sidechainnet loader + Adam loop,
+train_pre.py:37-96) as a config-driven jitted pipeline: synthetic batches
+by default, a trrosetta-style on-disk dataset when --data points at a
+directory of .a3m/.pdb pairs.
+
+Usage:
+    python scripts/train_distogram.py [--config cfg.json] [--steps N]
+        [--data DIR] [--mesh data,i,j]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from alphafold2_tpu.config import Experiment
+from alphafold2_tpu.data.synthetic import synthetic_batch
+from alphafold2_tpu.parallel import use_mesh
+from alphafold2_tpu.train import CheckpointManager, TrainState, fit
+from alphafold2_tpu.utils import MetricsLogger, StepTimer
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default=None)
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--data", default=None)
+    ap.add_argument("--mesh", default=None, help="data,i,j")
+    ap.add_argument("--log", default=None, help="metrics JSONL path")
+    args = ap.parse_args(argv)
+
+    if args.config:
+        with open(args.config) as f:
+            exp = Experiment.from_json(f.read())
+    else:
+        exp = Experiment()
+        exp.model.dim, exp.model.depth = 128, 2
+    if args.steps is not None:
+        exp.train.num_steps = args.steps
+    if args.data is not None:
+        exp.data.root = args.data
+    if args.mesh is not None:
+        d, i, j = (int(v) for v in args.mesh.split(","))
+        exp.mesh.data, exp.mesh.i, exp.mesh.j = d, i, j
+
+    model, tx, mesh = exp.build()
+
+    if exp.data.root:
+        from alphafold2_tpu.data.trrosetta import TrRosettaDataModule
+        dm = TrRosettaDataModule(exp.data.root, crop_len=exp.data.crop_len,
+                                 batch_size=exp.data.batch_size,
+                                 max_msa_rows=exp.data.msa_depth)
+        batches = dm.train_batches()
+    else:
+        def synthetic_stream():
+            i = 0
+            while True:
+                yield synthetic_batch(
+                    jax.random.PRNGKey(i), batch=exp.data.batch_size,
+                    seq_len=exp.data.crop_len,
+                    msa_depth=exp.data.msa_depth)
+                i += 1
+        batches = synthetic_stream()
+
+    first = next(batches)
+    rng = jax.random.PRNGKey(exp.train.seed)
+
+    with use_mesh(mesh):
+        params = model.init(
+            {"params": rng, "mlm": jax.random.fold_in(rng, 1)},
+            first["seq"], msa=first.get("msa"), mask=first.get("mask"),
+            msa_mask=first.get("msa_mask"), train=True)
+        state = TrainState.create(apply_fn=model.apply, params=params,
+                                  tx=tx, rng=jax.random.fold_in(rng, 2))
+
+        timer = StepTimer()
+        logger = MetricsLogger(args.log)
+        state, history = fit(model, state, batches, exp.train.num_steps,
+                             log_every=exp.train.log_every, logger=logger,
+                             step_timer=timer)
+
+    print("step time:", timer.summary())
+    if exp.train.checkpoint_dir:
+        CheckpointManager(exp.train.checkpoint_dir).save(state)
+    return history
+
+
+if __name__ == "__main__":
+    main()
