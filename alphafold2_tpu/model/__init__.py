@@ -9,7 +9,15 @@ from alphafold2_tpu.model.evoformer import (  # noqa: F401
     MsaAttentionBlock,
     PairwiseAttentionBlock,
 )
+from alphafold2_tpu.model.attention_variants import (  # noqa: F401
+    BlockSparseAttention,
+    KroneckerAttention,
+    LinearAttention,
+    MemoryCompressedAttention,
+)
 from alphafold2_tpu.model.mlm import MLM  # noqa: F401
+from alphafold2_tpu.model.refiners import EGNNLayer, EnAttentionLayer, Refiner  # noqa: F401
+from alphafold2_tpu.model.reversible import ReversibleEvoformer  # noqa: F401
 from alphafold2_tpu.model.primitives import (  # noqa: F401
     Attention,
     AxialAttention,

@@ -86,6 +86,20 @@ class FeedForward(nn.Module):
         return x
 
 
+def attention_output_tail(dense, out, x, inner, gating, dim):
+    """Shared attention tail (used by Attention and the efficient
+    variants): merge heads, sigmoid gate from the input (init
+    pass-through, reference alphafold2.py:118-120), zero-init output
+    projection (alphafold2.py:123). out: (b, h, n, dh)."""
+    out = out.swapaxes(-2, -3).reshape(*x.shape[:-1], inner)
+    if gating:
+        gates = dense(inner, "gating", kernel_init=zeros_init(),
+                      bias_init=ones_init())(x)
+        out = out * jnn.sigmoid(gates)
+    return dense(dim, "to_out", kernel_init=zeros_init(),
+                 bias_init=zeros_init())(out)
+
+
 class Attention(nn.Module):
     """Gated multi-head attention (reference alphafold2.py:98-190)."""
 
@@ -183,17 +197,8 @@ class Attention(nn.Module):
         return self._finish(out, x, inner, dense)
 
     def _finish(self, out, x, inner, dense):
-        """Shared tail of both attention backends: merge heads, sigmoid
-        gate from the input (init pass-through, reference
-        alphafold2.py:118-120), zero-init output projection
-        (alphafold2.py:123)."""
-        out = out.swapaxes(-2, -3).reshape(*x.shape[:-1], inner)
-        if self.gating:
-            gates = dense(inner, "gating", kernel_init=zeros_init(),
-                          bias_init=ones_init())(x)
-            out = out * jnn.sigmoid(gates)
-        return dense(self.dim, "to_out", kernel_init=zeros_init(),
-                     bias_init=zeros_init())(out)
+        return attention_output_tail(dense, out, x, inner, self.gating,
+                                     self.dim)
 
 
 class AxialAttention(nn.Module):

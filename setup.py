@@ -1,0 +1,23 @@
+from setuptools import find_packages, setup
+
+setup(
+    name="alphafold2-tpu",
+    version="0.1.0",
+    description=(
+        "TPU-native (JAX/XLA/Pallas/pjit) protein-structure framework with "
+        "the capabilities of lucidrains/alphafold2"
+    ),
+    packages=find_packages(exclude=("tests", "native", "scripts", "tools")),
+    python_requires=">=3.10",
+    install_requires=[
+        "jax",
+        "flax",
+        "optax",
+        "orbax-checkpoint",
+        "numpy",
+    ],
+    extras_require={
+        "embeds": ["torch", "transformers"],
+        "test": ["pytest"],
+    },
+)
