@@ -1,0 +1,64 @@
+"""Multi-host (pod-scale) initialization and data placement.
+
+The distributed communication backend of this framework is XLA's GSPMD
+collectives over ICI within a slice and DCN across slices — the TPU-native
+replacement for the reference's aspirational NCCL-through-DeepSpeed path
+(SURVEY.md §5.8, reference training_scripts/*.py are empty stubs). This
+module holds the host-side glue:
+
+- `initialize()`: `jax.distributed.initialize` wrapper (no-op when
+  single-process, e.g. local runs and tests);
+- `global_mesh()`: build the (data, i, j) mesh over ALL processes'
+  devices;
+- `host_local_batch_to_global()`: assemble a globally-sharded array from
+  per-host shards (`jax.make_array_from_process_local_data`) so each host
+  feeds only its slice of the batch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from alphafold2_tpu.parallel.mesh import AXIS_NAMES, DATA_AXIS
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """Initialize the multi-process runtime; returns True if distributed.
+    Safe to call unconditionally — single-process runs skip it."""
+    if num_processes is None or num_processes <= 1:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    return True
+
+
+def global_mesh(data: int = 1, i: int = 1, j: int = 1) -> Mesh:
+    """Mesh over all processes' devices (jax.devices() is global)."""
+    devices = jax.devices()
+    need = data * i * j
+    if need != len(devices):
+        raise ValueError(f"mesh {data}x{i}x{j}={need} != global device "
+                         f"count {len(devices)}")
+    return Mesh(np.asarray(devices).reshape(data, i, j), AXIS_NAMES)
+
+
+def host_local_batch_to_global(batch, mesh: Mesh):
+    """Per-host batch shards -> one global jax.Array per leaf, sharded on
+    the data axis. Each process passes only its local portion."""
+
+    def place(x):
+        spec = [None] * x.ndim
+        if x.ndim >= 1:
+            spec[0] = DATA_AXIS
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P(*spec)), np.asarray(x))
+
+    return jax.tree.map(place, batch)

@@ -1,0 +1,79 @@
+"""Failure detection and recovery for training.
+
+Net-new vs the reference, which trains in bare infinite loops with no
+try/except, no NaN handling, no checkpoint-on-failure (SURVEY.md §5.3):
+
+- `guarded_train_step`: wraps a train step so a non-finite loss or
+  gradient skips the update (params unchanged, a `skipped` flag and the
+  bad-metric snapshot returned) instead of poisoning the state — all
+  inside jit via `lax.cond`-style `where` selects;
+- `AutoCheckpointer`: periodic + on-failure checkpointing around the host
+  loop, resuming from the latest checkpoint after a crash.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu.train.checkpoint import CheckpointManager
+from alphafold2_tpu.train.state import TrainState
+
+
+def all_finite(tree) -> jnp.ndarray:
+    leaves = [jnp.isfinite(x).all() for x in jax.tree.leaves(tree)
+              if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)]
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack(leaves).all()
+
+
+def guarded_train_step(train_step: Callable) -> Callable:
+    """state, batch -> state, metrics with metrics['skipped'] = 1.0 when a
+    non-finite loss/grad update was rejected (state passes through)."""
+
+    def step(state: TrainState, batch):
+        new_state, metrics = train_step(state, batch)
+        ok = all_finite(metrics["loss"]) & all_finite(new_state.params)
+
+        def pick(new, old):
+            return jax.tree.map(
+                lambda a, b: jnp.where(ok, a, b) if hasattr(a, "dtype")
+                else a, new, old)
+
+        # keep the PRNG/step advance so a skipped batch is not replayed
+        # with the same randomness forever
+        safe_state = pick(new_state, state.replace(
+            step=new_state.step, rng=new_state.rng))
+        metrics = dict(metrics)
+        metrics["skipped"] = 1.0 - ok.astype(jnp.float32)
+        return safe_state, metrics
+
+    return step
+
+
+class AutoCheckpointer:
+    """Host-loop companion: save every `every` steps and on failure."""
+
+    def __init__(self, directory: str, every: int = 100, max_to_keep: int = 3):
+        self.manager = CheckpointManager(directory, max_to_keep=max_to_keep)
+        self.every = every
+
+    def maybe_save(self, state: TrainState, step: Optional[int] = None):
+        step = int(state.step) if step is None else step
+        if step > 0 and step % self.every == 0:
+            self.manager.save(state, step)
+
+    def resume_or(self, state: TrainState) -> TrainState:
+        """Restore the latest checkpoint if one exists, else return state."""
+        if self.manager.latest_step() is None:
+            return state
+        return self.manager.restore(state)
+
+    def on_failure(self, state: TrainState):
+        try:
+            self.manager.save(state, int(state.step))
+        except Exception:  # pragma: no cover - best effort
+            pass

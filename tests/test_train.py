@@ -137,3 +137,79 @@ class TestCheckpoint:
         # restored state trains on
         restored, metrics = step(restored, batch)
         assert np.isfinite(float(metrics["loss"]))
+
+
+class TestGuard:
+    def test_guarded_step_skips_nonfinite(self):
+        from alphafold2_tpu.train.guard import all_finite, guarded_train_step
+
+        # toy model: loss = sum(w * x); a NaN batch poisons loss + grads
+        tx = adam(1e-2)
+        params = {"w": jnp.ones((4,))}
+        state = TrainState.create(
+            apply_fn=lambda *a: None, params=params, tx=tx,
+            rng=jax.random.PRNGKey(0))
+
+        def raw_step(state, batch):
+            new_rng = jax.random.split(state.rng)[1]
+
+            def loss_fn(p):
+                loss = (p["w"] * batch).sum()
+                return loss, {"loss": loss}
+
+            grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+            return (state.apply_gradients(grads=grads).replace(rng=new_rng),
+                    metrics)
+
+        step = jax.jit(guarded_train_step(raw_step))
+
+        state1, metrics = step(state, jnp.ones((4,)))
+        assert float(metrics["skipped"]) == 0.0
+        assert not np.allclose(np.asarray(state1.params["w"]),
+                               np.asarray(params["w"]))
+
+        state2, metrics2 = step(state1, jnp.full((4,), jnp.nan))
+        assert float(metrics2["skipped"]) == 1.0
+        assert np.array_equal(np.asarray(state2.params["w"]),
+                              np.asarray(state1.params["w"]))
+        # optimizer state must also be reverted, not just params
+        assert bool(all_finite(state2.opt_state))
+        # step/rng still advance so the schedule moves on
+        assert int(state2.step) == int(state1.step) + 1
+        assert not np.array_equal(np.asarray(state2.rng),
+                                  np.asarray(state1.rng))
+
+        # recovery: the next clean step trains on without contamination
+        state3, metrics3 = step(state2, jnp.ones((4,)))
+        assert float(metrics3["skipped"]) == 0.0
+        assert bool(all_finite(state3.params))
+
+    def test_autocheckpointer(self, tmp_path):
+        from alphafold2_tpu.train.guard import AutoCheckpointer
+
+        model = small_model()
+        batch = synthetic_batch(jax.random.PRNGKey(5), batch=1, seq_len=12,
+                                msa_depth=3)
+        state = init_state(model, batch)
+        ck = AutoCheckpointer(str(tmp_path / "auto"), every=2)
+
+        # no checkpoint yet: resume_or falls back to the given state
+        fallback = ck.resume_or(state)
+        assert fallback is state
+
+        # off-cadence steps are skipped
+        ck.maybe_save(state.replace(step=jnp.asarray(1)))
+        assert ck.manager.latest_step() is None
+        ck.maybe_save(state.replace(step=jnp.asarray(0)))
+        assert ck.manager.latest_step() is None
+
+        # on-cadence save + resume
+        state = state.replace(step=jnp.asarray(2))
+        ck.maybe_save(state)
+        assert ck.manager.latest_step() == 2
+        resumed = ck.resume_or(init_state(model, batch))
+        assert int(resumed.step) == 2
+
+        # failure-path save overwrites/creates at the current step
+        ck.on_failure(state.replace(step=jnp.asarray(3)))
+        assert ck.manager.latest_step() == 3
