@@ -1,0 +1,88 @@
+"""Inference API: fold sequences with recycling and confidence.
+
+The reference leaves the recycling loop to user code (its tests do two
+manual passes, test_attention.py:344-385) and has no inference entry
+point at all. `fold()` packages it: N recycling iterations under one jit
+(`lax.scan` over the recycle axis — static, compile-once), returning
+coordinates, per-residue confidence, and the trunk outputs.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu.model.alphafold2 import Recyclables
+
+
+class FoldResult(NamedTuple):
+    coords: jnp.ndarray          # (b, n, 3)
+    confidence: jnp.ndarray      # (b, n) in [0, 1]
+    distogram: jnp.ndarray       # (b, n, n, buckets)
+    recyclables: Recyclables
+
+
+def fold(
+    model,
+    params,
+    seq: jnp.ndarray,
+    msa: Optional[jnp.ndarray] = None,
+    mask: Optional[jnp.ndarray] = None,
+    msa_mask: Optional[jnp.ndarray] = None,
+    num_recycles: int = 3,
+    **extra,
+) -> FoldResult:
+    """Run the model with `num_recycles` recycling iterations.
+
+    `model` must be constructed with predict_coords=True. Jit-safe: wrap
+    in jax.jit(partial(fold, model), static_argnames='num_recycles') or
+    call under jit via a closure.
+    """
+    assert model.predict_coords, "fold() needs predict_coords=True"
+
+    def one_pass(recyclables):
+        coords, ret = model.apply(
+            params, seq, msa=msa, mask=mask, msa_mask=msa_mask,
+            recyclables=recyclables, return_aux_logits=True,
+            return_recyclables=True, **extra)
+        return coords, ret
+
+    # first pass has no recyclables (params cover both traces via the
+    # init-time branch coverage)
+    coords, ret = one_pass(None)
+
+    if num_recycles > 0:
+        # carry the latest outputs instead of stacking per-iteration ys:
+        # keeps one copy of the O(n^2) distogram live, not num_recycles
+        def body(carry, _):
+            recyclables, *_ = carry
+            coords, ret = one_pass(recyclables)
+            return (ret.recyclables, coords, ret.distance,
+                    ret.confidence), None
+
+        (recyclables, coords, distance, confidence), _ = jax.lax.scan(
+            body, (ret.recyclables, coords, ret.distance, ret.confidence),
+            None, length=num_recycles)
+    else:
+        distance = ret.distance
+        confidence = ret.confidence
+        recyclables = ret.recyclables
+
+    conf = jax.nn.sigmoid(confidence[..., 0].astype(jnp.float32))
+    return FoldResult(coords, conf, distance, recyclables)
+
+
+def fold_and_write(model, params, seq, out_path: str, **kwargs) -> str:
+    """fold() + PDB output of the CA trace (data/pdb_io.coords2pdb).
+    Single-structure only; fold batches yourself and write per element."""
+    import numpy as np
+
+    from alphafold2_tpu.data.pdb_io import coords2pdb
+
+    assert seq.shape[0] == 1, \
+        "fold_and_write writes one structure; pass a batch of 1"
+    result = fold(model, params, seq, **kwargs)
+    return coords2pdb(np.asarray(seq[0]), np.asarray(result.coords[0]),
+                      name=out_path)

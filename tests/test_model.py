@@ -292,3 +292,57 @@ class TestMasking:
             msa_mask=jnp.ones((1, 3, n_real), dtype=bool))
         assert np.allclose(ret_pad.distance[:, :n_real, :n_real],
                            ret_real.distance, atol=2e-3)
+
+
+class TestPredict:
+    def test_fold_with_recycling(self):
+        from alphafold2_tpu.predict import fold
+
+        model = small_model(predict_coords=True, structure_module_depth=1)
+        inp = make_inputs(b=1, n=8, m=3)
+        params = model.init(jax.random.PRNGKey(1), **inp)
+        result = fold(model, params, inp["seq"], msa=inp["msa"],
+                      mask=inp["mask"], msa_mask=inp["msa_mask"],
+                      num_recycles=2)
+        assert result.coords.shape == (1, 8, 3)
+        assert result.confidence.shape == (1, 8)
+        assert ((result.confidence >= 0) & (result.confidence <= 1)).all()
+        assert result.distogram.shape == (1, 8, 8, 37)
+        assert bool(jnp.isfinite(result.coords).all())
+
+    def test_fold_zero_recycles(self):
+        from alphafold2_tpu.predict import fold
+
+        model = small_model(predict_coords=True, structure_module_depth=1)
+        inp = make_inputs(b=1, n=8, m=3)
+        params = model.init(jax.random.PRNGKey(1), **inp)
+        result = fold(model, params, inp["seq"], msa=inp["msa"],
+                      mask=inp["mask"], msa_mask=inp["msa_mask"],
+                      num_recycles=0)
+        assert result.coords.shape == (1, 8, 3)
+
+    def test_fold_under_jit(self):
+        from alphafold2_tpu.predict import fold
+
+        model = small_model(predict_coords=True, structure_module_depth=1)
+        inp = make_inputs(b=1, n=8, m=3)
+        params = model.init(jax.random.PRNGKey(1), **inp)
+        jfold = jax.jit(lambda p: fold(model, p, inp["seq"], msa=inp["msa"],
+                                       mask=inp["mask"],
+                                       msa_mask=inp["msa_mask"],
+                                       num_recycles=1))
+        result = jfold(params)
+        assert result.coords.shape == (1, 8, 3)
+
+    def test_fold_and_write(self, tmp_path):
+        from alphafold2_tpu.predict import fold_and_write
+
+        model = small_model(predict_coords=True, structure_module_depth=1)
+        inp = make_inputs(b=1, n=8, m=3)
+        params = model.init(jax.random.PRNGKey(1), **inp)
+        path = fold_and_write(model, params, inp["seq"],
+                              out_path=str(tmp_path / "pred.pdb"),
+                              msa=inp["msa"], mask=inp["mask"],
+                              msa_mask=inp["msa_mask"], num_recycles=1)
+        text = open(path).read()
+        assert text.startswith("ATOM")
