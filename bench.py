@@ -16,16 +16,12 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax
-import jax.numpy as jnp
-
-from alphafold2_tpu import Alphafold2
-from alphafold2_tpu.data.synthetic import synthetic_batch
-from alphafold2_tpu.train import TrainState, adam, make_train_step
+_DONE = threading.Event()
 
 DIM = int(os.environ.get("BENCH_DIM", 256))
 DEPTH = int(os.environ.get("BENCH_DEPTH", 2))
@@ -34,8 +30,47 @@ MSA, B = 5, 1
 WARMUP = max(1, int(os.environ.get("BENCH_WARMUP", 2)))
 ITERS = max(1, int(os.environ.get("BENCH_ITERS", 10)))
 
+METRIC = (f"evoformer_distogram_train_step@{L}res(dim{DIM},"
+          f"depth{DEPTH},msa{MSA},b{B})")
+
+
+def _watchdog(seconds: int):
+    """If the TPU tunnel is wedged, fail loudly with a JSON line instead
+    of hanging the driver. A daemon thread (not SIGALRM): the hang sits
+    inside a blocking C call during jax plugin discovery, so Python-level
+    signal handlers would never run."""
+
+    def waiter():
+        if not _DONE.wait(seconds):
+            print(json.dumps({
+                "metric": METRIC,
+                "value": None, "unit": "ms", "vs_baseline": None,
+                "error": f"bench timed out after {seconds}s "
+                         "(device backend unreachable?)"}), flush=True)
+            os._exit(2)
+
+    threading.Thread(target=waiter, daemon=True).start()
+
+
+_watchdog(int(os.environ.get("BENCH_TIMEOUT_S", 1500)))
+
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu import Alphafold2
+from alphafold2_tpu.data.synthetic import synthetic_batch
+from alphafold2_tpu.train import TrainState, adam, make_train_step
+
 
 def main():
+    backend = "xla"
+    if os.environ.get("BENCH_PALLAS") == "1":
+        from alphafold2_tpu.ops import (pallas_attention_enabled,
+                                        use_pallas_attention)
+        use_pallas_attention(True)
+        if not pallas_attention_enabled():
+            raise RuntimeError("BENCH_PALLAS=1 but pallas is unavailable")
+        backend = "pallas"
     model = Alphafold2(dim=DIM, depth=DEPTH, heads=8, dim_head=64,
                        dtype=jnp.bfloat16)
     batch = synthetic_batch(jax.random.PRNGKey(0), batch=B, seq_len=L,
@@ -56,6 +91,7 @@ def main():
         state, metrics = step(state, batch)
     jax.block_until_ready(metrics["loss"])
     ms = (time.perf_counter() - t0) / ITERS * 1e3
+    _DONE.set()  # measurement done; only local file IO remains
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "tools", "reference_baseline.json")
@@ -71,11 +107,11 @@ def main():
             vs_baseline = (ref["train_step_seconds"] * 1e3) / ms
 
     print(json.dumps({
-        "metric": f"evoformer_distogram_train_step@{L}res(dim{DIM},"
-                  f"depth{DEPTH},msa{MSA},b{B})",
+        "metric": METRIC,
         "value": round(ms, 3),
         "unit": "ms",
         "vs_baseline": round(vs_baseline, 3) if vs_baseline else None,
+        "backend": backend,
     }))
 
 
