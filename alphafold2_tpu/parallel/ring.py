@@ -100,6 +100,86 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+def pair_row_attention_sharded(
+    q: jnp.ndarray,      # (b, h, I, J, d) global, pre-scaled
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: jnp.ndarray,   # (b, h, J, J) edge bias between column positions
+    mesh: Mesh,
+    i_axis: str = "i",
+    j_axis: str = "j",
+    mask: Optional[jnp.ndarray] = None,   # (b, J) column validity
+) -> jnp.ndarray:
+    """Triangle row attention over the J axis of a 2-D-sharded pair
+    tensor, ring-parallel (SURVEY.md §5.7 hard-part #1).
+
+    Layout: q/k/v are per-cell projections of the pair map, sharded
+    P(-, -, i, j, -); within each row i, cells attend along J with the
+    edge bias bias[j_query, j_key] (the reference's edges_to_attn_bias
+    semantics, alphafold2.py:214-217, :246-248 — the same (J, J) bias for
+    every row). The bias enters the shard_map sharded over its QUERY axis
+    by the j mesh axis with the key axis kept whole (one J_local x J
+    panel per device — a 1/n_j slice, resharded from the pair layout by
+    one GSPMD all-to-all at the boundary); the ring then slices the
+    matching key block each step. Output returns with the input sharding.
+    """
+    spec = P(None, None, i_axis, j_axis, None)
+    bias_spec = P(None, None, j_axis, None)   # query rows local, keys whole
+
+    args = [q, k, v, bias]
+    in_specs = [spec, spec, spec, bias_spec]
+    if mask is not None:
+        args.append(mask)
+        in_specs.append(P(None, None))        # column mask replicated
+
+    def kernel(qi, ki, vi, bi, *rest):
+        mi = rest[0] if rest else None
+        b, h, il, jl, d = qi.shape
+        n_shards = jax.lax.axis_size(j_axis)
+        my_idx = jax.lax.axis_index(j_axis)
+        perm = [(s, (s + 1) % n_shards) for s in range(n_shards)]
+
+        qf = qi.astype(jnp.float32)
+        acc = jnp.zeros((b, h, il, jl, d), jnp.float32)
+        row_max = jnp.full((b, h, il, jl), -jnp.inf, jnp.float32)
+        row_sum = jnp.zeros((b, h, il, jl), jnp.float32)
+
+        # bias stays ONE (b, h, jl, J) panel; the per-step (jl, jl) slice
+        # broadcasts over the il row axis inside the logits add
+        def body(step, carry):
+            acc, row_max, row_sum, k_cur, v_cur = carry
+            shard = (my_idx - step) % n_shards
+            blk_bias = jax.lax.dynamic_slice_in_dim(
+                bi, shard * jl, jl, axis=-1).astype(jnp.float32)
+            logits = jnp.einsum(
+                "bhiqd,bhikd->bhiqk", qf, k_cur.astype(jnp.float32))
+            logits = logits + blk_bias[:, :, None]
+            if mi is not None:
+                key_ok = jax.lax.dynamic_slice_in_dim(
+                    mi, shard * jl, jl, axis=-1)
+                logits = jnp.where(key_ok[:, None, None, None, :],
+                                   logits, -1e9)
+
+            new_max = jnp.maximum(row_max, logits.max(-1))
+            corr = jnp.exp(row_max - new_max)
+            p = jnp.exp(logits - new_max[..., None])
+            acc2 = acc * corr[..., None] + jnp.einsum(
+                "bhiqk,bhikd->bhiqd", p, v_cur.astype(jnp.float32))
+            sum2 = row_sum * corr + p.sum(-1)
+            return (acc2, new_max, sum2,
+                    jax.lax.ppermute(k_cur, j_axis, perm),
+                    jax.lax.ppermute(v_cur, j_axis, perm))
+
+        acc, row_max, row_sum, _, _ = jax.lax.fori_loop(
+            0, n_shards, body, (acc, row_max, row_sum, ki, vi))
+        out = acc / jnp.maximum(row_sum[..., None], 1e-30)
+        return out.astype(qi.dtype)
+
+    fn = jax.shard_map(kernel, mesh=mesh, in_specs=tuple(in_specs),
+                       out_specs=spec, check_vma=False)
+    return fn(*args)
+
+
 def ring_attention_sharded(
     q: jnp.ndarray,      # (b, h, n, d) global
     k: jnp.ndarray,

@@ -123,3 +123,71 @@ class TestRotary:
         from alphafold2_tpu.model.rotary import axial_rotary_embedding
         sin, cos = axial_rotary_embedding(6, 8, 16)
         assert sin.shape == (6, 8, 16) and cos.shape == (6, 8, 16)
+
+
+class TestPairRowRing:
+    def test_matches_dense_row_attention(self):
+        from alphafold2_tpu.parallel.ring import pair_row_attention_sharded
+        b, h, I, J, d = 1, 2, 8, 16, 8
+        ks = jax.random.split(jax.random.PRNGKey(30), 4)
+        q = jax.random.normal(ks[0], (b, h, I, J, d)) * 0.5
+        k = jax.random.normal(ks[1], (b, h, I, J, d)) * 0.5
+        v = jax.random.normal(ks[2], (b, h, I, J, d))
+        bias = jax.random.normal(ks[3], (b, h, J, J))
+
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("i", "j"))
+        out = pair_row_attention_sharded(q, k, v, bias, mesh)
+
+        # dense reference: per-row attention along J with shared (J,J) bias
+        logits = jnp.einsum("bhiqd,bhikd->bhiqk", q, k) + bias[:, :, None]
+        ref = jnp.einsum("bhiqk,bhikd->bhiqd",
+                         jax.nn.softmax(logits, -1), v)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_with_column_mask(self):
+        from alphafold2_tpu.parallel.ring import pair_row_attention_sharded
+        b, h, I, J, d = 1, 2, 4, 8, 8
+        ks = jax.random.split(jax.random.PRNGKey(31), 4)
+        q = jax.random.normal(ks[0], (b, h, I, J, d)) * 0.5
+        k = jax.random.normal(ks[1], (b, h, I, J, d)) * 0.5
+        v = jax.random.normal(ks[2], (b, h, I, J, d))
+        bias = jax.random.normal(ks[3], (b, h, J, J))
+        mask = jnp.ones((b, J), dtype=bool).at[:, 6:].set(False)
+
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("i", "j"))
+        out = pair_row_attention_sharded(q, k, v, bias, mesh, mask=mask)
+
+        logits = jnp.einsum("bhiqd,bhikd->bhiqk", q, k) + bias[:, :, None]
+        logits = jnp.where(mask[:, None, None, None, :], logits, -1e9)
+        ref = jnp.einsum("bhiqk,bhikd->bhiqd",
+                         jax.nn.softmax(logits, -1), v)
+        assert np.allclose(np.asarray(out)[:, :, :, :6],
+                           np.asarray(ref)[:, :, :, :6], atol=1e-5)
+
+    def test_gradients_match_dense(self):
+        from alphafold2_tpu.parallel.ring import pair_row_attention_sharded
+        b, h, I, J, d = 1, 2, 4, 8, 8
+        ks = jax.random.split(jax.random.PRNGKey(32), 4)
+        q = jax.random.normal(ks[0], (b, h, I, J, d)) * 0.5
+        k = jax.random.normal(ks[1], (b, h, I, J, d)) * 0.5
+        v = jax.random.normal(ks[2], (b, h, I, J, d))
+        bias = jax.random.normal(ks[3], (b, h, J, J))
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("i", "j"))
+
+        def loss_ring(args):
+            q, k, v, bias = args
+            return (pair_row_attention_sharded(q, k, v, bias, mesh) ** 2
+                    ).sum()
+
+        def loss_dense(args):
+            q, k, v, bias = args
+            logits = jnp.einsum("bhiqd,bhikd->bhiqk", q, k) + \
+                bias[:, :, None]
+            out = jnp.einsum("bhiqk,bhikd->bhiqd",
+                             jax.nn.softmax(logits, -1), v)
+            return (out ** 2).sum()
+
+        g_ring = jax.grad(loss_ring)((q, k, v, bias))
+        g_dense = jax.grad(loss_dense)((q, k, v, bias))
+        for a, b_ in zip(g_ring, g_dense):
+            assert np.allclose(np.asarray(a), np.asarray(b_), atol=1e-4)
