@@ -172,8 +172,25 @@ def _parse_pdb_py(text: str, chain: Optional[str] = None):
                 constants.SIDECHAIN_ATOMS[constants.ONE_TO_THREE[aa]])}
         for aa in constants.ONE_TO_THREE
     }
+    def atoi(s: str) -> int:
+        # C atoi semantics (af2data.cc pdb_parse uses atoi on cols 22-26):
+        # leading whitespace skipped, parse signed digits, 0 on garbage
+        s = s.strip()
+        n = 0
+        while n < len(s) and (s[n].isdigit() or (n == 0 and s[n] in "+-")):
+            n += 1
+        try:
+            return int(s[:n])
+        except ValueError:
+            return 0
+
+    # residue identity is *sequential* (resseq, icode) change-detection,
+    # matching the native parser (af2data.cc pdb_parse): a residue id seen
+    # again after an intervening one starts a NEW residue rather than
+    # merging atoms into the earlier record, so both backends produce the
+    # same length/sequence on interleaved or duplicated residue records
     residues = []
-    index = {}
+    last_key = None
     active = chain
     for line in text.splitlines():
         if line.startswith("ENDMDL"):
@@ -185,13 +202,13 @@ def _parse_pdb_py(text: str, chain: Optional[str] = None):
             active = ch
         if ch != active or line[16] not in (" ", "A"):
             continue
-        key = (line[22:26], line[26])
-        if key not in index:
-            index[key] = len(residues)
+        key = (atoi(line[22:26]), line[26])
+        if key != last_key:
+            last_key = key
             resname = line[17:20].strip()
             residues.append({"name": resname, "atoms": {}})
         atom = line[12:16].strip()
-        residues[index[key]]["atoms"][atom] = (
+        residues[-1]["atoms"][atom] = (
             float(line[30:38]), float(line[38:46]), float(line[46:54]))
 
     l = len(residues)

@@ -36,7 +36,13 @@ def guarded_train_step(train_step: Callable) -> Callable:
 
     def step(state: TrainState, batch):
         new_state, metrics = train_step(state, batch)
-        ok = all_finite(metrics["loss"]) & all_finite(new_state.params)
+        # opt_state finiteness matters independently of params/loss: with
+        # optax.MultiSteps accumulation a non-finite micro-step gradient
+        # can poison the accumulator while params and loss stay finite,
+        # and later rejected updates would roll back *onto* the poisoned
+        # accumulator, wedging training permanently
+        ok = (all_finite(metrics["loss"]) & all_finite(new_state.params)
+              & all_finite(new_state.opt_state))
 
         def pick(new, old):
             return jax.tree.map(

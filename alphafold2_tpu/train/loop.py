@@ -59,6 +59,14 @@ def compute_loss(model, params, batch, rng, train: bool = True):
                 ret.confidence, coords, batch["coords"], mask)
             metrics["confidence_loss"] = c_loss
             loss = loss + c_loss
+    elif model.predict_coords:
+        # coords model but the batch has no coords target: still request
+        # aux logits so `ret` is a ReturnValues, not a bare coords array
+        # (only the MLM/angle terms below can contribute here — the
+        # distogram term requires a coords target)
+        _, ret = model.apply(params, batch["seq"], **kwargs,
+                             return_aux_logits=True, rngs=rngs)
+        loss = jnp.zeros((), jnp.float32)
     else:
         ret = model.apply(params, batch["seq"], **kwargs, rngs=rngs)
         loss = jnp.zeros((), jnp.float32)

@@ -96,6 +96,31 @@ class TestPDB:
             assert np.allclose(np.asarray(x, np.float64),
                                np.asarray(y, np.float64))
 
+    def test_interleaved_residues_native_python_agree(self, has_native):
+        # residue identity is sequential (resseq, icode) change-detection
+        # in BOTH backends: residue 1 reappearing after residue 2 starts a
+        # third residue instead of merging into the first
+        interleaved = "\n".join([
+            "ATOM      1  N   ALA A   1      1.000   0.000   0.000"
+            "  1.00  0.00           N",
+            "ATOM      2  CA  ALA A   1      2.000   0.000   0.000"
+            "  1.00  0.00           C",
+            "ATOM      3  N   GLY A   2      3.000   0.000   0.000"
+            "  1.00  0.00           N",
+            "ATOM      4  CA  ALA A   1      4.000   0.000   0.000"
+            "  1.00  0.00           C",
+            "END",
+        ]) + "\n"
+        b = native._parse_pdb_py(interleaved)
+        assert b[0].shape == (3,)          # ALA, GLY, ALA — not merged
+        assert np.isclose(b[1][0, 1, 0], 2.0)   # first ALA CA untouched
+        assert np.isclose(b[1][2, 1, 0], 4.0)   # revisited ALA is residue 3
+        if has_native:
+            a = native.parse_pdb(interleaved)
+            for x, y in zip(a, b):
+                assert np.allclose(np.asarray(x, np.float64),
+                                   np.asarray(y, np.float64))
+
     def test_roundtrip_with_featurize(self):
         seq, coords, mask = native.parse_pdb(PDB)
         # feeds straight into the distance-target path

@@ -94,6 +94,24 @@ class TestTrainStep:
         assert np.isfinite(float(metrics["loss"]))
         assert "coords_loss" in metrics
 
+    def test_coords_model_without_coords_target(self):
+        # a coords model trained on a batch with no coords target must
+        # still get a ReturnValues (not bare coords) so the distogram/MLM
+        # terms remain trainable (regression: ADVICE.md round 1)
+        from alphafold2_tpu.train.loop import compute_loss
+
+        model = small_model(predict_coords=True, structure_module_depth=1)
+        batch = synthetic_batch(jax.random.PRNGKey(4), batch=1, seq_len=12,
+                                msa_depth=3, with_coords=False)
+        state = init_state(model, batch)
+        loss, metrics = compute_loss(model, state.params, batch,
+                                     jax.random.PRNGKey(7), train=True)
+        assert np.isfinite(float(loss))
+        assert "coords_loss" not in metrics
+        step = jax.jit(make_train_step(model))
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+
     def test_grad_accum_matches_big_batch_direction(self):
         # with MultiSteps(k), params change only every k micro-steps
         model = small_model()
@@ -183,6 +201,48 @@ class TestGuard:
         state3, metrics3 = step(state2, jnp.ones((4,)))
         assert float(metrics3["skipped"]) == 0.0
         assert bool(all_finite(state3.params))
+
+    def test_guard_rejects_poisoned_accumulator(self):
+        # with MultiSteps accumulation, a micro-step can have a FINITE
+        # loss and FINITE params (no apply yet) while the gradient is
+        # non-finite — poisoning only the accumulator. The guard must gate
+        # on opt_state finiteness or training wedges permanently
+        # (regression: ADVICE.md round 1)
+        from alphafold2_tpu.train.guard import all_finite, guarded_train_step
+
+        params = {"w": jnp.ones((4,))}
+        state = TrainState.create(
+            apply_fn=lambda *a: None, params=params,
+            tx=adam(1e-2, grad_accum_every=2), rng=jax.random.PRNGKey(0))
+
+        def raw_step(state, batch):
+            new_rng = jax.random.split(state.rng)[1]
+
+            def loss_fn(p):
+                # sqrt at 0: value 0 (finite), gradient inf (poison)
+                loss = jnp.sqrt((p["w"] * batch).sum())
+                return loss, {"loss": loss}
+
+            grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+            return (state.apply_gradients(grads=grads).replace(rng=new_rng),
+                    metrics)
+
+        step = jax.jit(guarded_train_step(raw_step))
+
+        # poison micro-step: loss finite, params untouched, grads inf
+        state1, metrics1 = step(state, jnp.zeros((4,)))
+        assert np.isfinite(float(metrics1["loss"]))
+        assert bool(all_finite(state1.params))
+        assert float(metrics1["skipped"]) == 1.0
+        # the accumulator was rolled back, not kept poisoned
+        assert bool(all_finite(state1.opt_state))
+
+        # training continues cleanly through a full accumulation window
+        state2, m2 = step(state1, jnp.ones((4,)))
+        state3, m3 = step(state2, jnp.ones((4,)))
+        assert float(m2["skipped"]) == 0.0 and float(m3["skipped"]) == 0.0
+        assert bool(all_finite(state3.params))
+        assert bool(all_finite(state3.opt_state))
 
     def test_autocheckpointer(self, tmp_path):
         from alphafold2_tpu.train.guard import AutoCheckpointer
