@@ -32,6 +32,7 @@ class ModelConfig:
     structure_module_type: str = "ipa"
     structure_module_refinement_iters: int = 0
     reversible: bool = False
+    ring_attention: bool = False
     extra_msa_evoformer_layers: int = 4
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0

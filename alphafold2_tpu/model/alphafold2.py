@@ -99,6 +99,10 @@ class Alphafold2(nn.Module):
     structure_module_refinement_iters: int = 0
     # reversible main trunk (README.md:40-era flag): O(1) activation memory
     reversible: bool = False
+    # ring-parallel triangle attention over the 2-D-sharded pair tensor
+    # (parallel/ring.py): exact long-context mode, active only when the
+    # mesh actually shards the pair axes; no-op otherwise
+    ring_attention: bool = False
     disable_token_embed: bool = False
     mlm_mask_prob: float = 0.15
     mlm_random_replace_token_prob: float = 0.1
@@ -328,7 +332,8 @@ class Alphafold2(nn.Module):
                 dim=self.dim, depth=self.extra_msa_evoformer_layers,
                 heads=self.heads, dim_head=self.dim_head,
                 attn_dropout=self.attn_dropout, ff_dropout=self.ff_dropout,
-                global_column_attn=True, dtype=self.dtype,
+                global_column_attn=True,
+                ring_attention=self.ring_attention, dtype=self.dtype,
                 name="extra_msa_evoformer",
             )(x, extra_m, mask=x_mask, msa_mask=extra_msa_mask,
               deterministic=deterministic)
@@ -337,7 +342,8 @@ class Alphafold2(nn.Module):
         x, m = Evoformer(
             dim=self.dim, depth=self.depth, heads=self.heads,
             dim_head=self.dim_head, attn_dropout=self.attn_dropout,
-            ff_dropout=self.ff_dropout, dtype=self.dtype,
+            ff_dropout=self.ff_dropout,
+            ring_attention=self.ring_attention, dtype=self.dtype,
             reversible=self.reversible, name="net",
         )(x, m, mask=x_mask, msa_mask=msa_mask, deterministic=deterministic)
 

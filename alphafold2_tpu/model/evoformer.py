@@ -27,22 +27,31 @@ from alphafold2_tpu.model.primitives import (
     OuterMean,
     TriangleMultiplicativeModule,
 )
+from alphafold2_tpu.parallel.mesh import PAIR_I_AXIS, PAIR_J_AXIS
 from alphafold2_tpu.parallel.sharding import shard_msa, shard_pair
 
 
 class PairwiseAttentionBlock(nn.Module):
-    """Pair-track block (reference alphafold2.py:353-385)."""
+    """Pair-track block (reference alphafold2.py:353-385).
+
+    `ring_attention=True` runs the two triangle attentions ring-parallel
+    over the sharded pair axes when an active mesh shards them
+    (AxialAttention.ring_axes; parallel/ring.py) — the long-context mode.
+    """
 
     dim: int
     heads: int
     dim_head: int = 64
     dropout: float = 0.0
     global_column_attn: bool = False
+    ring_attention: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, mask=None, msa_repr=None, msa_mask=None,
                  deterministic: bool = True):
+        ring_axes = (PAIR_I_AXIS, PAIR_J_AXIS) if self.ring_attention \
+            else None
         if msa_repr is not None:
             x = x + OuterMean(dim=self.dim, dtype=self.dtype,
                               name="outer_mean")(msa_repr, mask=msa_mask)
@@ -58,12 +67,14 @@ class PairwiseAttentionBlock(nn.Module):
         x = AxialAttention(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
             row_attn=True, col_attn=False, accept_edges=True,
+            ring_axes=ring_axes,
             dtype=self.dtype, name="triangle_attention_outgoing",
         )(x, edges=x, mask=mask, deterministic=deterministic) + x
         x = AxialAttention(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
             row_attn=False, col_attn=True, accept_edges=True,
             global_query_attn=self.global_column_attn,
+            ring_axes=ring_axes,
             dtype=self.dtype, name="triangle_attention_ingoing",
         )(x, edges=x, mask=mask, deterministic=deterministic) + x
         return shard_pair(x)
@@ -103,6 +114,7 @@ class EvoformerBlock(nn.Module):
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0
     global_column_attn: bool = False
+    ring_attention: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -122,6 +134,7 @@ class EvoformerBlock(nn.Module):
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
             dropout=self.attn_dropout,
             global_column_attn=self.global_column_attn,
+            ring_attention=self.ring_attention,
             dtype=self.dtype, name="attn",
         )(x, mask=mask, msa_repr=m, msa_mask=msa_mask,
           deterministic=deterministic)
@@ -144,6 +157,7 @@ class Evoformer(nn.Module):
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0
     global_column_attn: bool = False
+    ring_attention: bool = False
     dtype: jnp.dtype = jnp.float32
     use_scan: bool = True
     # O(1)-activation reversible trunk (model/reversible.py; reference
@@ -159,6 +173,10 @@ class Evoformer(nn.Module):
             # rather than silently ignoring it
             assert self.attn_dropout == 0.0 and self.ff_dropout == 0.0, \
                 "reversible trunk does not support dropout"
+            # likewise refuse (rather than silently drop) ring attention:
+            # the reversible blocks run their own dense attention path
+            assert not self.ring_attention, \
+                "reversible trunk does not support ring attention yet"
             from alphafold2_tpu.model.reversible import ReversibleEvoformer
             return ReversibleEvoformer(
                 dim=self.dim, depth=self.depth, heads=self.heads,
@@ -170,7 +188,8 @@ class Evoformer(nn.Module):
         block_kwargs = dict(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
             attn_dropout=self.attn_dropout, ff_dropout=self.ff_dropout,
-            global_column_attn=self.global_column_attn, dtype=self.dtype,
+            global_column_attn=self.global_column_attn,
+            ring_attention=self.ring_attention, dtype=self.dtype,
         )
 
         if self.use_scan and self.depth > 1:

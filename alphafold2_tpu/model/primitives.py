@@ -101,7 +101,13 @@ def attention_output_tail(dense, out, x, inner, gating, dim):
 
 
 class Attention(nn.Module):
-    """Gated multi-head attention (reference alphafold2.py:98-190)."""
+    """Gated multi-head attention (reference alphafold2.py:98-190).
+
+    setup-based (not @nn.compact) so `project_qkv` / `finish` are callable
+    from a parent module as well as from `__call__` — the ring-attention
+    path in AxialAttention reuses exactly these projections, keeping one
+    params tree for the dense and ring backends.
+    """
 
     dim: int
     heads: int = 8
@@ -110,34 +116,64 @@ class Attention(nn.Module):
     gating: bool = True
     dtype: jnp.dtype = jnp.float32
 
-    @nn.compact
+    def setup(self):
+        inner = self.heads * self.dim_head
+        dense = lambda features, name, use_bias=True, **kw: nn.Dense(
+            features, use_bias=use_bias, dtype=self.dtype,
+            param_dtype=jnp.float32, name=name, **kw)
+        self._to_q = dense(inner, "to_q", use_bias=False)
+        self._to_kv = dense(inner * 2, "to_kv", use_bias=False)
+        if self.gating:
+            self._gating = dense(inner, "gating", kernel_init=zeros_init(),
+                                 bias_init=ones_init())
+        self._to_out = dense(self.dim, "to_out", kernel_init=zeros_init(),
+                             bias_init=zeros_init())
+        self._drop = nn.Dropout(self.dropout)
+
+    def project_qkv(self, x, kv_input=None):
+        """QKV projections with heads split out and q pre-scaled.
+
+        x: (..., n, d) -> q/k/v (..., h, n, dh). Rank-agnostic: the ring
+        path passes the unfolded (b, I, J, d) pair tensor.
+        """
+        h, dh = self.heads, self.dim_head
+        kv_input = x if kv_input is None else kv_input
+        q = self._to_q(x)
+        k, v = jnp.split(self._to_kv(kv_input), 2, axis=-1)
+
+        def split_heads(t):
+            t = t.reshape(*t.shape[:-1], h, dh)
+            return jnp.moveaxis(t, -2, 1)  # heads to axis 1
+
+        q, k, v = map(split_heads, (q, k, v))
+        return q * (dh ** -0.5), k, v
+
+    def finish(self, out, x):
+        """Shared output tail: merge heads, sigmoid gate from the *input*
+        (init pass-through, reference alphafold2.py:118-120), zero-init
+        output projection. out: heads at axis 1 (project_qkv's layout),
+        i.e. (b, h, ..., n, dh); x: the attention input."""
+        out = jnp.moveaxis(out, 1, -2).reshape(
+            *x.shape[:-1], self.heads * self.dim_head)
+        if self.gating:
+            out = out * jnn.sigmoid(self._gating(x))
+        return self._to_out(out)
+
     def __call__(
         self,
         x,                       # (b, n, d)
         mask=None,               # (b, n) bool
-        attn_bias=None,          # (b, heads, n, m)
+        attn_bias=None,          # (b // attn_bias_repeat, heads, n, m)
         context=None,            # (b, m, d)
         context_mask=None,       # (b, m) bool
         tie_dim: Optional[int] = None,
+        attn_bias_repeat: int = 1,
         deterministic: bool = True,
     ):
         h, dh = self.heads, self.dim_head
-        inner = h * dh
         has_context = context is not None
-        kv_input = x if context is None else context
 
-        dense = lambda features, name, use_bias=True, **kw: nn.Dense(
-            features, use_bias=use_bias, dtype=self.dtype,
-            param_dtype=jnp.float32, name=name, **kw)
-
-        q = dense(inner, "to_q", use_bias=False)(x)
-        kv = dense(inner * 2, "to_kv", use_bias=False)(kv_input)
-        k, v = jnp.split(kv, 2, axis=-1)
-
-        split_heads = lambda t: t.reshape(*t.shape[:-1], h, dh).swapaxes(-2, -3)
-        q, k, v = map(split_heads, (q, k, v))  # (b, h, n, dh)
-
-        q = q * (dh ** -0.5)
+        q, k, v = self.project_qkv(x, kv_input=context)  # (b, h, n, dh)
 
         if mask is not None:
             if has_context:
@@ -145,32 +181,49 @@ class Attention(nn.Module):
                     jnp.ones(k.shape[:1] + k.shape[-2:-1], dtype=bool)
             else:
                 cmask = mask
-            pair_mask = mask[:, None, :, None] & cmask[:, None, None, :]
         else:
-            pair_mask = None
+            cmask = None
 
-        # optional Pallas fused path (bias+softmax+AV in one VMEM-resident
-        # kernel; alphafold2_tpu/ops/attention.py). Tie-dim (global-query)
-        # and dropout-active traces fall back to the XLA path. Both
-        # backends share the gating/projection tail below.
+        # optional Pallas fused path (bias+mask+softmax+AV in one
+        # VMEM-resident kernel; alphafold2_tpu/ops/attention.py). Bias
+        # stays *unrepeated* (replayed over the folded axial axis by the
+        # kernel's index map) and masks stay (b, n) vectors — no O(N^2)
+        # HBM bias/mask tensor is ever built on this path. Tie-dim
+        # (global-query) and dropout-active traces fall back to the XLA
+        # path. Both backends share the gating/projection tail below.
         from alphafold2_tpu.ops.attention import (
             fused_attention, pallas_attention_enabled)
         if pallas_attention_enabled() and tie_dim is None and \
                 (self.dropout == 0.0 or deterministic):
             b_all = q.shape[0]
             n_q, n_k = q.shape[-2], k.shape[-2]
-            bias_full = jnp.zeros((b_all, h, n_q, n_k), jnp.float32)
             if attn_bias is not None:
-                bias_full = bias_full + attn_bias.astype(jnp.float32)
-            if pair_mask is not None:
-                bias_full = jnp.where(pair_mask, bias_full, MASK_VALUE)
+                # callers may pass broadcast-shaped bias, e.g. (1,1,n,n)
+                # from BlockSparseAttention; the kernel's index map needs
+                # the full (b, heads) leading shape
+                attn_bias = jnp.broadcast_to(
+                    attn_bias.astype(jnp.float32),
+                    (b_all // attn_bias_repeat, h, n_q, n_k))
             out = fused_attention(
                 q.reshape(b_all * h, n_q, dh),
                 k.reshape(b_all * h, n_k, dh),
                 v.reshape(b_all * h, n_k, dh),
-                bias_full.reshape(b_all * h, n_q, n_k))
-            out = out.reshape(b_all, h, n_q, dh)
-            return self._finish(out, x, inner, dense)
+                bias=None if attn_bias is None else
+                attn_bias.reshape(-1, n_q, n_k),
+                q_mask=mask,
+                k_mask=cmask,
+                heads=h,
+                bias_repeat=attn_bias_repeat)
+            return self.finish(out.reshape(b_all, h, n_q, dh), x)
+
+        pair_mask = None if mask is None else \
+            mask[:, None, :, None] & cmask[:, None, None, :]
+
+        if attn_bias is not None and attn_bias_repeat != 1:
+            # replay the (b, h, n, m) bias across the folded axial axis
+            # (reference alphafold2.py:246-248); only the XLA path needs
+            # the materialized repeat
+            attn_bias = jnp.repeat(attn_bias, attn_bias_repeat, axis=0)
 
         if tie_dim is not None:
             # global-query attention: average queries across the tied rows
@@ -191,14 +244,10 @@ class Attention(nn.Module):
             dots = jnp.where(pair_mask, dots, MASK_VALUE)
 
         attn = jnn.softmax(dots, axis=-1)
-        attn = nn.Dropout(self.dropout, deterministic=deterministic)(attn)
+        attn = self._drop(attn, deterministic=deterministic)
 
         out = jnp.einsum("bhij,bhjd->bhid", attn, v)
-        return self._finish(out, x, inner, dense)
-
-    def _finish(self, out, x, inner, dense):
-        return attention_output_tail(dense, out, x, inner, self.gating,
-                                     self.dim)
+        return self.finish(out, x)
 
 
 class AxialAttention(nn.Module):
@@ -208,6 +257,17 @@ class AxialAttention(nn.Module):
     `col_attn` attends along H for each of the W columns. Exactly one of the
     two must be set. `accept_edges` projects a pair representation
     (b, I, J, d) into per-head attention bias.
+
+    Long-context mode: when `ring_axes=(axis_H, axis_W)` names the mesh
+    axes sharding x's two spatial dims and the attended axis is actually
+    sharded (>1 devices) under the active mesh, the attention dispatches
+    to `parallel.ring.pair_row_attention_sharded` — exact blockwise
+    softmax with K/V shards rotating around the mesh ring over ICI —
+    instead of letting GSPMD all-gather the full attended axis
+    (SURVEY.md §5.7 hard-part #1). Same params either way (the ring path
+    reuses the inner Attention's projections), so the flag is purely an
+    execution-strategy switch. Falls back to the dense path for
+    global-query (tie_dim) attention and dropout-active traces.
     """
 
     dim: int
@@ -218,7 +278,76 @@ class AxialAttention(nn.Module):
     accept_edges: bool = False
     global_query_attn: bool = False
     dropout: float = 0.0
+    ring_axes: Optional[tuple] = None   # (mesh axis of H, mesh axis of W)
     dtype: jnp.dtype = jnp.float32
+
+    def _ring_mesh(self, height, width):
+        """The active mesh if the ring path applies, else None."""
+        from alphafold2_tpu.parallel.sharding import active_mesh
+
+        if self.ring_axes is None or self.global_query_attn:
+            return None
+        mesh = active_mesh()
+        if mesh is None:
+            return None
+        ax_h, ax_w = self.ring_axes
+        if not {ax_h, ax_w} <= set(mesh.axis_names):
+            return None
+        attended = mesh.shape[ax_w] if self.row_attn else mesh.shape[ax_h]
+        if attended <= 1:
+            return None
+        # both spatial dims must tile over their mesh axes
+        if height % mesh.shape[ax_h] or width % mesh.shape[ax_w]:
+            return None
+        return mesh
+
+    def _ring_forward(self, x, edges, mask, mesh):
+        """Ring-parallel axial attention over the sharded attended axis.
+
+        Reuses the inner Attention's projections/tail so the params tree
+        is identical to the dense path; outputs match the dense path at
+        all valid (unmasked-query) positions — masked-query cells carry
+        unspecified values on both paths (dense: uniform average; ring:
+        average over valid keys).
+
+        Mask contract: the (b, H, W) mask must be SEPARABLE — an outer
+        product of per-axis validity vectors (what the model produces:
+        pair mask = seq_mask x seq_mask, alphafold2.py x_mask). The ring
+        carries key validity as a per-axis vector (`mask.any(...)`), so a
+        mask that forbids specific (i, j) pairs while both positions are
+        otherwise valid would be silently relaxed here; the dense path is
+        the one that honors arbitrary pair masks.
+        """
+        from alphafold2_tpu.parallel.ring import pair_row_attention_sharded
+
+        attn = Attention(
+            dim=self.dim, heads=self.heads, dim_head=self.dim_head,
+            dropout=self.dropout, dtype=self.dtype, name="attn")
+        q, k, v = attn.project_qkv(x)  # (b, h, H, W, dh), q pre-scaled
+
+        bias = None
+        if self.accept_edges and edges is not None:
+            bias = nn.Dense(self.heads, use_bias=False, dtype=self.dtype,
+                            param_dtype=jnp.float32,
+                            name="edges_to_attn_bias")(edges)
+            bias = bias.transpose(0, 3, 1, 2)  # (b, heads, i, j)
+
+        ax_h, ax_w = self.ring_axes
+        if self.row_attn:
+            # keys are W positions; their validity is column validity
+            key_mask = None if mask is None else mask.any(axis=1)  # (b, W)
+            out = pair_row_attention_sharded(
+                q, k, v, bias, mesh, i_axis=ax_h, j_axis=ax_w,
+                mask=key_mask)
+        else:
+            key_mask = None if mask is None else mask.any(axis=2)  # (b, H)
+            swap = lambda t: t.swapaxes(2, 3)  # (b, h, W, H, dh)
+            out = pair_row_attention_sharded(
+                swap(q), swap(k), swap(v), bias, mesh,
+                i_axis=ax_w, j_axis=ax_h, mask=key_mask)
+            out = out.swapaxes(2, 3)
+
+        return attn.finish(out, x)
 
     @nn.compact
     def __call__(self, x, edges=None, mask=None, deterministic: bool = True):
@@ -227,6 +356,12 @@ class AxialAttention(nn.Module):
 
         b, height, width, d = x.shape
         x = LayerNorm(dtype=self.dtype)(x)
+
+        ring_mesh = None
+        if self.dropout == 0.0 or deterministic:
+            ring_mesh = self._ring_mesh(height, width)
+        if ring_mesh is not None:
+            return self._ring_forward(x, edges, mask, ring_mesh)
 
         if self.col_attn:
             axial_dim = width
@@ -245,8 +380,7 @@ class AxialAttention(nn.Module):
             bias = nn.Dense(self.heads, use_bias=False, dtype=self.dtype,
                             param_dtype=jnp.float32,
                             name="edges_to_attn_bias")(edges)
-            bias = bias.transpose(0, 3, 1, 2)
-            attn_bias = jnp.repeat(bias, axial_dim, axis=0)
+            attn_bias = bias.transpose(0, 3, 1, 2)  # (b, heads, i, j)
 
         tie_dim = axial_dim if self.global_query_attn else None
 
@@ -254,6 +388,7 @@ class AxialAttention(nn.Module):
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
             dropout=self.dropout, dtype=self.dtype, name="attn",
         )(x_fold, mask=mask_fold, attn_bias=attn_bias, tie_dim=tie_dim,
+          attn_bias_repeat=axial_dim if attn_bias is not None else 1,
           deterministic=deterministic)
 
         if self.col_attn:

@@ -8,9 +8,21 @@ HBM between the two matmuls; this kernel keeps the whole row block
 resident in VMEM (crop-sized N fits comfortably: 384*64*4B per head-block)
 and writes only the (N, D) output — one HBM round-trip instead of three.
 
-Shapes are the post-folding axial layout: q/k/v (B, N, D) with heads folded
-into B, bias (B, N, N) already containing mask fill. Softmax runs in fp32
-regardless of input dtype.
+Bias and masks are OPTIONAL and never materialized at full batch size in
+HBM (round-1 ADVICE/VERDICT finding: the old contract forced callers to
+allocate a dense fp32 (B, Nq, Nk) bias of zeros even with no bias/mask,
+re-introducing exactly the O(N^2) HBM traffic the kernel exists to avoid):
+- `bias` may be passed *unrepeated* — shape (Bb, Nq, Nk) with
+  B == Bb//heads * bias_repeat * heads — and the BlockSpec index map
+  replays it across the folded axial axis, so the axial row/col edge bias
+  (b, h, N, N) is read as-is instead of being `jnp.repeat`-ed to
+  (b*L, h, N, N);
+- `q_mask`/`k_mask` are (B//heads, N) vectors; the (Nq, Nk) fill is
+  computed inside the kernel in VMEM.
+
+Shapes are the post-folding axial layout: q/k/v (B, N, D) with heads
+folded innermost into B (B = batch*heads, head fastest). Softmax runs in
+fp32 regardless of input dtype.
 
 Selection: `use_pallas_attention(True)` flips the backend globally (the
 flax modules read the flag at trace time); it requires a TPU backend —
@@ -32,6 +44,9 @@ try:  # pallas import is TPU/CPU-safe; guard for exotic builds
     HAS_PALLAS = True
 except Exception:  # pragma: no cover
     HAS_PALLAS = False
+
+# Large-negative fill for masked logits (matches model/primitives.py).
+MASK_VALUE = -1e9
 
 _BACKEND = {"pallas": False}
 
@@ -55,15 +70,37 @@ def pallas_attention(enabled: bool = True):
         _BACKEND["pallas"] = prev
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale):
+def _attn_kernel(*refs, scale, has_bias, has_qm, has_km):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    idx = 3
+    bias_ref = refs[idx] if has_bias else None
+    idx += int(has_bias)
+    qm_ref = refs[idx] if has_qm else None
+    idx += int(has_qm)
+    km_ref = refs[idx] if has_km else None
+    idx += int(has_km)
+    o_ref = refs[idx]
+
     q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
     k = k_ref[0].astype(jnp.float32)                  # (n, d)
     v = v_ref[0].astype(jnp.float32)                  # (n, d)
-    bias = bias_ref[0].astype(jnp.float32)            # (bq, n)
 
     logits = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) + bias    # (bq, n)
+        preferred_element_type=jnp.float32)           # (bq, n)
+    if has_bias:
+        logits = logits + bias_ref[0].astype(jnp.float32)
+    if has_qm or has_km:
+        # masks arrive as (1, len) f32 rows; the (bq, n) fill pattern is
+        # their outer AND, built here in VMEM rather than in HBM upstream
+        valid = jnp.ones(logits.shape, dtype=bool)
+        if has_qm:
+            valid &= (qm_ref[0] > 0).reshape(-1, 1)   # (bq, 1)
+        if has_km:
+            valid &= (km_ref[0] > 0).reshape(1, -1)   # (1, n)
+        logits = jnp.where(valid, logits, MASK_VALUE)
+
     m = jnp.max(logits, axis=-1, keepdims=True)
     p = jnp.exp(logits - m)
     denom = jnp.sum(p, axis=-1, keepdims=True)
@@ -74,15 +111,27 @@ def _attn_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale):
 
 
 def fused_attention(
-    q: jnp.ndarray,        # (B, N, D)
-    k: jnp.ndarray,        # (B, N, D)
-    v: jnp.ndarray,        # (B, N, D)
-    bias: jnp.ndarray,     # (B, N, N) additive (mask already folded in)
+    q: jnp.ndarray,              # (B, Nq, D)
+    k: jnp.ndarray,              # (B, Nk, D)
+    v: jnp.ndarray,              # (B, Nk, D)
+    bias=None,                   # (Bb, Nq, Nk) additive, optional
+    q_mask=None,                 # (B // heads, Nq) bool/0-1, optional
+    k_mask=None,                 # (B // heads, Nk) bool/0-1, optional
+    *,
+    heads: int = 1,
+    bias_repeat: int = 1,
     block_q: int = 128,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Fused bias+softmax+matmul attention. N and D should be multiples of
-    the TPU lane/sublane tiling (128 / 8); callers pad crops accordingly."""
+    """Fused bias+mask+softmax+matmul attention.
+
+    Batch layout: B = batch * bias_repeat * heads with head fastest, i.e.
+    flat index i = (batch * bias_repeat + fold) * heads + head. `bias`
+    covers (batch, heads) and is replayed over the folded middle axis via
+    the index map; masks cover (batch * bias_repeat) and are shared
+    across heads. N and D should be multiples of the TPU lane/sublane
+    tiling (128 / 8); callers pad crops accordingly.
+    """
     b, n, d = q.shape
     nk = k.shape[1]
     # largest power-of-two block <= block_q that divides n, so any sequence
@@ -94,24 +143,63 @@ def fused_attention(
     scale = 1.0  # caller pre-scales q (matches model convention)
 
     grid = (b, n // block_q)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, nk, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, nk, d), lambda i, j: (i, 0, 0)),
+    ]
+    args = [q, k, v]
+
+    if bias is not None:
+        assert bias.shape[0] * bias_repeat == b, (bias.shape, bias_repeat, b)
+        rh = bias_repeat * heads
+        in_specs.append(pl.BlockSpec(
+            (1, block_q, nk),
+            lambda i, j: ((i // rh) * heads + i % heads, j, 0)))
+        args.append(bias)
+    if q_mask is not None:
+        assert q_mask.shape == (b // heads, n), (q_mask.shape, b, heads, n)
+        in_specs.append(pl.BlockSpec(
+            (1, 1, block_q), lambda i, j: (i // heads, 0, j)))
+        args.append(q_mask.astype(jnp.float32).reshape(b // heads, 1, n))
+    if k_mask is not None:
+        assert k_mask.shape == (b // heads, nk), (k_mask.shape, b, heads, nk)
+        in_specs.append(pl.BlockSpec(
+            (1, 1, nk), lambda i, j: (i // heads, 0, 0)))
+        args.append(k_mask.astype(jnp.float32).reshape(b // heads, 1, nk))
+
+    kernel = functools.partial(
+        _attn_kernel, scale=scale, has_bias=bias is not None,
+        has_qm=q_mask is not None, has_km=k_mask is not None)
     return pl.pallas_call(
-        functools.partial(_attn_kernel, scale=scale),
+        kernel,
         out_shape=jax.ShapeDtypeStruct((b, n, d), q.dtype),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, nk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, nk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_q, nk), lambda i, j: (i, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         interpret=interpret,
-    )(q, k, v, bias)
+    )(*args)
 
 
-def attention_reference(q, k, v, bias):
+def attention_reference(q, k, v, bias=None, q_mask=None, k_mask=None,
+                        *, heads=1, bias_repeat=1):
     """XLA reference of the same contract (used for tests and fallback)."""
-    logits = jnp.einsum("bnd,bmd->bnm", q, k).astype(jnp.float32) + \
-        bias.astype(jnp.float32)
+    logits = jnp.einsum("bnd,bmd->bnm", q, k).astype(jnp.float32)
+    if bias is not None:
+        logits = logits + jnp.repeat(
+            bias.astype(jnp.float32).reshape(
+                -1, heads, *bias.shape[1:]),
+            bias_repeat, axis=0).reshape(logits.shape)
+    valid = None
+    if q_mask is not None:
+        valid = (q_mask > 0)[:, :, None]
+    if k_mask is not None:
+        km = (k_mask > 0)[:, None, :]
+        valid = km if valid is None else valid & km
+    if valid is not None:
+        valid = jnp.broadcast_to(
+            valid, (valid.shape[0],) + logits.shape[1:])
+        valid = jnp.repeat(valid, heads, axis=0)
+        logits = jnp.where(valid, logits, MASK_VALUE)
     attn = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bnm,bmd->bnd", attn.astype(q.dtype), v)

@@ -104,8 +104,8 @@ def pair_row_attention_sharded(
     q: jnp.ndarray,      # (b, h, I, J, d) global, pre-scaled
     k: jnp.ndarray,
     v: jnp.ndarray,
-    bias: jnp.ndarray,   # (b, h, J, J) edge bias between column positions
-    mesh: Mesh,
+    bias: Optional[jnp.ndarray],  # (b, h, J, J) edge bias between column
+    mesh: Mesh,                   # positions, or None
     i_axis: str = "i",
     j_axis: str = "j",
     mask: Optional[jnp.ndarray] = None,   # (b, J) column validity
@@ -125,15 +125,21 @@ def pair_row_attention_sharded(
     """
     spec = P(None, None, i_axis, j_axis, None)
     bias_spec = P(None, None, j_axis, None)   # query rows local, keys whole
+    has_bias = bias is not None
 
-    args = [q, k, v, bias]
-    in_specs = [spec, spec, spec, bias_spec]
+    args = [q, k, v]
+    in_specs = [spec, spec, spec]
+    if has_bias:
+        args.append(bias)
+        in_specs.append(bias_spec)
     if mask is not None:
         args.append(mask)
         in_specs.append(P(None, None))        # column mask replicated
 
-    def kernel(qi, ki, vi, bi, *rest):
-        mi = rest[0] if rest else None
+    def kernel(qi, ki, vi, *rest):
+        rest = list(rest)
+        bi = rest.pop(0) if has_bias else None
+        mi = rest.pop(0) if rest else None
         b, h, il, jl, d = qi.shape
         n_shards = jax.lax.axis_size(j_axis)
         my_idx = jax.lax.axis_index(j_axis)
@@ -149,11 +155,12 @@ def pair_row_attention_sharded(
         def body(step, carry):
             acc, row_max, row_sum, k_cur, v_cur = carry
             shard = (my_idx - step) % n_shards
-            blk_bias = jax.lax.dynamic_slice_in_dim(
-                bi, shard * jl, jl, axis=-1).astype(jnp.float32)
             logits = jnp.einsum(
                 "bhiqd,bhikd->bhiqk", qf, k_cur.astype(jnp.float32))
-            logits = logits + blk_bias[:, :, None]
+            if bi is not None:
+                blk_bias = jax.lax.dynamic_slice_in_dim(
+                    bi, shard * jl, jl, axis=-1).astype(jnp.float32)
+                logits = logits + blk_bias[:, :, None]
             if mi is not None:
                 key_ok = jax.lax.dynamic_slice_in_dim(
                     mi, shard * jl, jl, axis=-1)

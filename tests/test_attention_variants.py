@@ -125,3 +125,22 @@ class TestBlockSparse:
         out = mod.apply(params, x, mask=mask)
         assert out.shape == x.shape
         assert bool(jnp.isfinite(out).all())
+
+    def test_pallas_path_broadcast_bias(self, monkeypatch):
+        # BlockSparseAttention passes a (1, 1, n, n) broadcast bias; the
+        # fused path must expand it to the kernel's (b, heads) contract
+        # (regression: round-2 review finding)
+        import functools
+
+        from alphafold2_tpu.ops import attention as ops_attn
+
+        monkeypatch.setattr(
+            ops_attn, "fused_attention",
+            functools.partial(ops_attn.fused_attention, interpret=True))
+        x, mask = x_mask(jax.random.PRNGKey(16), n=64)
+        mod = BlockSparseAttention(dim=16, heads=2, dim_head=8, block=16)
+        params = mod.init(jax.random.PRNGKey(17), x, mask=mask)
+        ref = mod.apply(params, x, mask=mask)
+        with ops_attn.pallas_attention(True):
+            out = mod.apply(params, x, mask=mask)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)

@@ -90,6 +90,103 @@ class TestRingAttention:
         assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
 
+class TestRingInTrunk:
+    """Ring attention wired into the Evoformer (VERDICT round-1 item #3):
+    with `ring_attention=True` and a mesh sharding the pair axes, the two
+    triangle attentions run via parallel/ring.py; outputs and parameter
+    gradients must match the dense path at all valid positions (masked
+    cells carry unspecified values on both paths)."""
+
+    def _inputs(self, key, b=2, n=16, m=3, d=32):
+        ks = jax.random.split(key, 4)
+        x = jax.random.normal(ks[0], (b, n, n, d)) * 0.5
+        msa = jax.random.normal(ks[1], (b, m, n, d)) * 0.5
+        seq_mask = jnp.ones((b, n), dtype=bool).at[:, -4:].set(False)
+        pmask = seq_mask[:, :, None] & seq_mask[:, None, :]
+        msa_mask = jnp.ones((b, m, n), dtype=bool) & seq_mask[:, None, :]
+        return x, msa, pmask, msa_mask
+
+    def _blocks(self):
+        from alphafold2_tpu.model.evoformer import EvoformerBlock
+        kw = dict(dim=32, heads=2, dim_head=16)
+        return (EvoformerBlock(**kw, ring_attention=False),
+                EvoformerBlock(**kw, ring_attention=True))
+
+    def test_evoformer_block_ring_matches_dense(self):
+        from alphafold2_tpu.parallel import make_mesh, use_mesh
+
+        x, msa, pmask, msa_mask = self._inputs(jax.random.PRNGKey(10))
+        dense, ring = self._blocks()
+        params = dense.init(jax.random.PRNGKey(11), x, msa,
+                            mask=pmask, msa_mask=msa_mask)
+
+        xd, md = dense.apply(params, x, msa, mask=pmask, msa_mask=msa_mask)
+        mesh = make_mesh(2, 2, 2)
+        with use_mesh(mesh):
+            xr, mr = jax.jit(lambda p, *a: ring.apply(
+                p, *a, mask=pmask, msa_mask=msa_mask))(params, x, msa)
+
+        valid = np.asarray(pmask)[..., None]
+        assert np.allclose(np.asarray(xr) * valid, np.asarray(xd) * valid,
+                           atol=2e-5)
+        # the MSA track is untouched by the ring switch
+        assert np.allclose(np.asarray(mr), np.asarray(md), atol=2e-5)
+
+    def test_evoformer_block_ring_grads_match_dense(self):
+        from alphafold2_tpu.parallel import make_mesh, use_mesh
+
+        x, msa, pmask, msa_mask = self._inputs(jax.random.PRNGKey(12))
+        dense, ring = self._blocks()
+        params = dense.init(jax.random.PRNGKey(13), x, msa,
+                            mask=pmask, msa_mask=msa_mask)
+
+        def masked_loss(block):
+            def loss(p):
+                xo, mo = block.apply(p, x, msa, mask=pmask,
+                                     msa_mask=msa_mask)
+                return ((xo * pmask[..., None]) ** 2).sum() + \
+                    ((mo * msa_mask[..., None]) ** 2).sum()
+            return loss
+
+        g_dense = jax.grad(masked_loss(dense))(params)
+        mesh = make_mesh(2, 2, 2)
+        with use_mesh(mesh):
+            g_ring = jax.jit(jax.grad(masked_loss(ring)))(params)
+
+        flat_d, _ = jax.tree_util.tree_flatten(g_dense)
+        flat_r, _ = jax.tree_util.tree_flatten(g_ring)
+        for a, b in zip(flat_r, flat_d):
+            # float-reassociation noise from the ring's blockwise
+            # accumulation: observed ~2e-4 absolute on grads of |.|~1e2
+            # under a sum-of-squares loss (~1e-9 of the loss scale)
+            assert np.allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-3), \
+                float(jnp.abs(a - b).max())
+
+    def test_evoformer_stack_ring_smoke(self):
+        # depth-2 scanned stack with ring enabled compiles and runs under
+        # the mesh; outputs match the dense stack at valid positions
+        from alphafold2_tpu.model.evoformer import Evoformer
+        from alphafold2_tpu.parallel import make_mesh, use_mesh
+
+        x, msa, pmask, msa_mask = self._inputs(jax.random.PRNGKey(14))
+        kw = dict(dim=32, depth=2, heads=2, dim_head=16)
+        dense = Evoformer(**kw, ring_attention=False)
+        ring = Evoformer(**kw, ring_attention=True)
+        params = dense.init(jax.random.PRNGKey(15), x, msa,
+                            mask=pmask, msa_mask=msa_mask)
+
+        xd, _ = dense.apply(params, x, msa, mask=pmask, msa_mask=msa_mask)
+        mesh = make_mesh(2, 2, 2)
+        with use_mesh(mesh):
+            xr, _ = jax.jit(lambda p: ring.apply(
+                p, x, msa, mask=pmask, msa_mask=msa_mask))(params)
+
+        valid = np.asarray(pmask)[..., None]
+        assert np.allclose(np.asarray(xr) * valid, np.asarray(xd) * valid,
+                           atol=5e-5)
+
+
 class TestRotary:
     def test_rotate_every_two(self):
         from alphafold2_tpu.model.rotary import rotate_every_two
