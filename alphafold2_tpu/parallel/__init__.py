@@ -10,9 +10,12 @@ from alphafold2_tpu.parallel.sharding import (  # noqa: F401
     active_mesh,
     msa_spec,
     pair_spec,
+    pytree_bytes_per_device,
     seq_spec,
     shard_msa,
     shard_pair,
+    shard_pytree_zero,
     shard_seq,
     use_mesh,
+    zero_param_specs,
 )

@@ -93,3 +93,69 @@ def shard_msa(x):
 def shard_seq(x):
     """(b, n, d) single-track activations: data-parallel only."""
     return _constraint(x, seq_spec())
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-style parameter / optimizer-state sharding
+# ---------------------------------------------------------------------------
+#
+# The reference gestures at this with an empty DeepSpeed stub
+# (training_scripts/deepspeed.py, 0 LoC). The GSPMD equivalent needs no
+# runtime machinery: give each parameter leaf a sharded placement over the
+# data axis and the optimizer state (same-shaped moments) inherits it, so
+# per-device optimizer bytes drop ~n_data-fold. XLA re-gathers shards where
+# the computation needs full parameters.
+
+
+def zero_param_specs(params, mesh: Mesh, axis: str = DATA_AXIS):
+    """PartitionSpec tree for ZeRO-style sharding: each leaf's largest
+    mesh-divisible dimension is sharded over `axis`; leaves with no
+    divisible dimension (scalars, odd shapes) stay replicated."""
+    n = mesh.shape[axis]
+
+    def spec_for(leaf):
+        shape = getattr(leaf, "shape", ())
+        best = None
+        for d, s in enumerate(shape):
+            if s % n == 0 and s >= n and (best is None or s > shape[best]):
+                best = d
+        if best is None or n <= 1:
+            return P()
+        spec = [None] * len(shape)
+        spec[best] = axis
+        return P(*spec)
+
+    return jax.tree.map(spec_for, params)
+
+
+def shard_pytree_zero(tree, mesh: Mesh, axis: str = DATA_AXIS):
+    """Place a pytree (params, opt_state, or a whole TrainState) with
+    ZeRO sharding: array leaves get `zero_param_specs` placements. The
+    shape-based rule lands optimizer moments on exactly their parameter's
+    sharding (same shapes -> same spec). One batched device_put for the
+    whole tree, not a transfer per leaf."""
+    shardings = jax.tree.map(
+        lambda leaf: NamedSharding(mesh, zero_param_specs(leaf, mesh, axis))
+        if hasattr(leaf, "shape") else None,
+        tree)
+    placed = jax.device_put(
+        [l for l in jax.tree.leaves(tree) if hasattr(l, "shape")],
+        [s for s in jax.tree.leaves(shardings) if s is not None])
+    it = iter(placed)
+    return jax.tree.map(
+        lambda leaf: next(it) if hasattr(leaf, "shape") else leaf, tree)
+
+
+def pytree_bytes_per_device(tree) -> int:
+    """Max per-device bytes across the addressable shards of `tree`'s
+    array leaves (replicated leaves count fully on every device)."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        if not hasattr(leaf, "sharding"):
+            continue
+        shard_shape = leaf.sharding.shard_shape(leaf.shape)
+        n = 1
+        for s in shard_shape:
+            n *= s
+        total += n * leaf.dtype.itemsize
+    return total

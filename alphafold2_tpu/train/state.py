@@ -1,9 +1,12 @@
 """Train state: params + optimizer + PRNG, one pytree.
 
 Net-new relative to the reference (its training scripts keep model/optimizer
-as Python objects and never checkpoint — SURVEY.md §5.4); designed so the
-whole state shards under pjit (optimizer state inherits param shardings,
-giving ZeRO-style optimizer sharding for free when params are sharded).
+as Python objects and never checkpoint — SURVEY.md §5.4); the whole state
+shards under pjit: `parallel.shard_pytree_zero` places params AND the adam
+moments over the data axis (ZeRO-style), exercised end-to-end by
+tests/test_sharding.py::TestZeroSharding (per-device optimizer bytes
+measured ~1/n_data of replicated, numerics equal to the replicated step)
+and by __graft_entry__.dryrun_multichip.
 """
 
 from __future__ import annotations
