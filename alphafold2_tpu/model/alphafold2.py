@@ -103,6 +103,12 @@ class Alphafold2(nn.Module):
     # (parallel/ring.py): exact long-context mode, active only when the
     # mesh actually shards the pair axes; no-op otherwise
     ring_attention: bool = False
+    # reproduce the reference's masked-OuterMean double division
+    # (alphafold2.py:347 + the always-synthesized msa_mask at :703);
+    # required for exact parity with reference-trained checkpoints
+    # (tools/port_weights.py), off by default in favor of the correct
+    # masked mean
+    outer_mean_reference_scale: bool = False
     disable_token_embed: bool = False
     mlm_mask_prob: float = 0.15
     mlm_random_replace_token_prob: float = 0.1
@@ -333,7 +339,9 @@ class Alphafold2(nn.Module):
                 heads=self.heads, dim_head=self.dim_head,
                 attn_dropout=self.attn_dropout, ff_dropout=self.ff_dropout,
                 global_column_attn=True,
-                ring_attention=self.ring_attention, dtype=self.dtype,
+                ring_attention=self.ring_attention,
+                outer_mean_reference_scale=self.outer_mean_reference_scale,
+                dtype=self.dtype,
                 name="extra_msa_evoformer",
             )(x, extra_m, mask=x_mask, msa_mask=extra_msa_mask,
               deterministic=deterministic)
@@ -343,7 +351,9 @@ class Alphafold2(nn.Module):
             dim=self.dim, depth=self.depth, heads=self.heads,
             dim_head=self.dim_head, attn_dropout=self.attn_dropout,
             ff_dropout=self.ff_dropout,
-            ring_attention=self.ring_attention, dtype=self.dtype,
+            ring_attention=self.ring_attention,
+            outer_mean_reference_scale=self.outer_mean_reference_scale,
+            dtype=self.dtype,
             reversible=self.reversible, name="net",
         )(x, m, mask=x_mask, msa_mask=msa_mask, deterministic=deterministic)
 

@@ -45,6 +45,7 @@ class PairwiseAttentionBlock(nn.Module):
     dropout: float = 0.0
     global_column_attn: bool = False
     ring_attention: bool = False
+    outer_mean_reference_scale: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -54,6 +55,7 @@ class PairwiseAttentionBlock(nn.Module):
             else None
         if msa_repr is not None:
             x = x + OuterMean(dim=self.dim, dtype=self.dtype,
+                              reference_scale=self.outer_mean_reference_scale,
                               name="outer_mean")(msa_repr, mask=msa_mask)
             x = shard_pair(x)
 
@@ -115,6 +117,7 @@ class EvoformerBlock(nn.Module):
     ff_dropout: float = 0.0
     global_column_attn: bool = False
     ring_attention: bool = False
+    outer_mean_reference_scale: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -135,6 +138,7 @@ class EvoformerBlock(nn.Module):
             dropout=self.attn_dropout,
             global_column_attn=self.global_column_attn,
             ring_attention=self.ring_attention,
+            outer_mean_reference_scale=self.outer_mean_reference_scale,
             dtype=self.dtype, name="attn",
         )(x, mask=mask, msa_repr=m, msa_mask=msa_mask,
           deterministic=deterministic)
@@ -158,6 +162,7 @@ class Evoformer(nn.Module):
     ff_dropout: float = 0.0
     global_column_attn: bool = False
     ring_attention: bool = False
+    outer_mean_reference_scale: bool = False
     dtype: jnp.dtype = jnp.float32
     use_scan: bool = True
     # O(1)-activation reversible trunk (model/reversible.py; reference
@@ -173,10 +178,15 @@ class Evoformer(nn.Module):
             # rather than silently ignoring it
             assert self.attn_dropout == 0.0 and self.ff_dropout == 0.0, \
                 "reversible trunk does not support dropout"
-            # likewise refuse (rather than silently drop) ring attention:
-            # the reversible blocks run their own dense attention path
+            # likewise refuse (rather than silently drop) ring attention
+            # and the OuterMean reference-scaling flag: the reversible
+            # blocks construct their own PairwiseAttentionBlock without
+            # either option
             assert not self.ring_attention, \
                 "reversible trunk does not support ring attention yet"
+            assert not self.outer_mean_reference_scale, \
+                "reversible trunk does not support " \
+                "outer_mean_reference_scale yet"
             from alphafold2_tpu.model.reversible import ReversibleEvoformer
             return ReversibleEvoformer(
                 dim=self.dim, depth=self.depth, heads=self.heads,
@@ -189,7 +199,9 @@ class Evoformer(nn.Module):
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
             attn_dropout=self.attn_dropout, ff_dropout=self.ff_dropout,
             global_column_attn=self.global_column_attn,
-            ring_attention=self.ring_attention, dtype=self.dtype,
+            ring_attention=self.ring_attention,
+            outer_mean_reference_scale=self.outer_mean_reference_scale,
+            dtype=self.dtype,
         )
 
         if self.use_scan and self.depth > 1:

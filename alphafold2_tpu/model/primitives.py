@@ -458,12 +458,17 @@ class OuterMean(nn.Module):
     Note: the reference's masked branch divides by the row count twice
     (`.mean(dim=1) / (mask.sum(dim=1)+eps)`, alphafold2.py:347); we use the
     standard masked mean (sum / count) — the trailing projection absorbs the
-    scale and this behaves correctly for ragged MSAs.
+    scale and this behaves correctly for ragged MSAs. Set
+    `reference_scale=True` to reproduce the reference's double-division
+    exactly — required when running checkpoints trained with the reference
+    (the reference synthesizes an all-ones msa_mask at alphafold2.py:703,
+    so its masked branch is effectively always active).
     """
 
     dim: int
     hidden_dim: Optional[int] = None
     eps: float = 1e-5
+    reference_scale: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -482,7 +487,11 @@ class OuterMean(nn.Module):
             # einsum over the MSA-row axis: (b,m,i,d),(b,m,j,d)->(b,i,j,d)
             outer = jnp.einsum("bmid,bmjd->bijd", left, right)
             counts = jnp.einsum("bmi,bmj->bij", m, m)[..., None]
-            outer = outer / (counts + self.eps)
+            if self.reference_scale:
+                # reference alphafold2.py:347: .mean(dim=1) then /(count+eps)
+                outer = outer / x.shape[1] / (counts + self.eps)
+            else:
+                outer = outer / (counts + self.eps)
         else:
             outer = jnp.einsum("bmid,bmjd->bijd", left, right)
             outer = outer / x.shape[1]

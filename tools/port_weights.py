@@ -1,0 +1,346 @@
+"""Whole-model torch -> flax weight porting for reference checkpoints.
+
+Promotes the per-module translation rules proven by tests/test_parity.py
+to the full `Alphafold2` tree (VERDICT round-1 item #5), so a checkpoint
+trained with the reference implementation
+(/root/reference/alphafold2_pytorch/alphafold2.py:469-905) runs in this
+framework. The Evoformer stacks are scanned here (params carry a leading
+depth axis), so per-layer torch trees are stacked along axis 0.
+
+Usage (API):
+
+    from tools.port_weights import port_alphafold2
+    params = flax_model.init(...)                 # template tree
+    params, unported = port_alphafold2(torch_model, params)
+
+Usage (CLI): convert a saved reference state into an orbax/msgpack blob:
+
+    python tools/port_weights.py --torch-ckpt ref.pt \
+        --model-kwargs '{"dim": 256, "depth": 6}' --out params.msgpack
+
+Known limits (each documented where it bites):
+- the IPA structure module is NOT ported: the reference outsources it to
+  the external `invariant-point-attention` package (alphafold2.py:608),
+  which is not installed here (tools/_reference_stubs.py substitutes a
+  dummy), so there is no ground truth to translate; our from-scratch IPA
+  (model/structure.py) keeps its init. The surrounding projections
+  (msa_to_single_repr_dim, trunk_to_pairwise_repr_dim,
+  to_quaternion_update, to_points, lddt_linear) ARE ported.
+- build the flax model with `outer_mean_reference_scale=True` when
+  running ported reference checkpoints: the reference synthesizes an
+  all-ones msa_mask (alphafold2.py:703) and its masked OuterMean
+  double-divides (alphafold2.py:347), so that flag is required for exact
+  output parity (TestWholeModelParity exercises it). Without the flag the
+  model uses the corrected masked mean and pair activations differ by a
+  factor of the MSA row count per OuterMean.
+- framework-only leaves (seq/msa embed projection banks used by
+  embeds.py) have no reference counterpart and keep their init.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Tuple
+
+import numpy as np
+
+
+# --------------------------------------------------------------------------
+# leaf-level translators (the rules from tests/test_parity.py:44-63)
+# --------------------------------------------------------------------------
+
+
+def t2n(t) -> np.ndarray:
+    return np.asarray(t.detach().cpu().numpy())
+
+
+def linear(tl) -> dict:
+    """torch nn.Linear -> flax Dense params (weight is transposed)."""
+    out = {"kernel": t2n(tl.weight).T}
+    if tl.bias is not None:
+        out["bias"] = t2n(tl.bias)
+    return out
+
+
+def embedding(te) -> dict:
+    return {"embedding": t2n(te.weight)}
+
+
+def layernorm(tln) -> dict:
+    """torch nn.LayerNorm -> our LayerNorm wrapper (model/primitives.py
+    LayerNorm nests a flax LayerNorm under 'LayerNorm_0')."""
+    return {"LayerNorm_0": {"scale": t2n(tln.weight), "bias": t2n(tln.bias)}}
+
+
+# --------------------------------------------------------------------------
+# module-level translators (reference module attrs -> our param subtrees)
+# --------------------------------------------------------------------------
+
+
+def attention(ta) -> dict:
+    """reference Attention (alphafold2.py:98-123)."""
+    return {
+        "to_q": linear(ta.to_q),
+        "to_kv": linear(ta.to_kv),
+        "to_out": linear(ta.to_out),
+        "gating": linear(ta.gating),
+    }
+
+
+def axial_attention(ta) -> dict:
+    """reference AxialAttention (alphafold2.py:192-217)."""
+    out = {
+        "LayerNorm_0": layernorm(ta.norm),
+        "attn": attention(ta.attn),
+    }
+    # accept_edges=True -> nn.Sequential(Linear, Rearrange); otherwise an
+    # Always(None) placeholder (alphafold2.py:214-217)
+    ebias = getattr(ta, "edges_to_attn_bias", None)
+    try:
+        first = ebias[0]
+    except (TypeError, IndexError, KeyError):
+        first = None
+    if first is not None and hasattr(first, "weight"):
+        out["edges_to_attn_bias"] = linear(first)
+    return out
+
+
+def triangle_multiplicative(tm) -> dict:
+    """reference TriangleMultiplicativeModule (alphafold2.py:257-317)."""
+    return {
+        "LayerNorm_0": layernorm(tm.norm),
+        "left_proj": linear(tm.left_proj),
+        "right_proj": linear(tm.right_proj),
+        "left_gate": linear(tm.left_gate),
+        "right_gate": linear(tm.right_gate),
+        "out_gate": linear(tm.out_gate),
+        "LayerNorm_1": layernorm(tm.to_out_norm),
+        "to_out": linear(tm.to_out),
+    }
+
+
+def outer_mean(to) -> dict:
+    """reference OuterMean (alphafold2.py:321-351)."""
+    return {
+        "LayerNorm_0": layernorm(to.norm),
+        "left_proj": linear(to.left_proj),
+        "right_proj": linear(to.right_proj),
+        "proj_out": linear(to.proj_out),
+    }
+
+
+def feed_forward(tf) -> dict:
+    """reference FeedForward (alphafold2.py:74-94): net[0]/net[3] are the
+    two Linears around GEGLU/Dropout."""
+    return {
+        "LayerNorm_0": layernorm(tf.norm),
+        "Dense_0": linear(tf.net[0]),
+        "Dense_1": linear(tf.net[3]),
+    }
+
+
+def pairwise_block(tb, include_outer_mean: bool = True) -> dict:
+    """reference PairwiseAttentionBlock (alphafold2.py:353-385).
+
+    `include_outer_mean=False` for the template embedder: the reference
+    calls it without msa_repr (alphafold2.py:755), so our lazily-built
+    tree has no outer_mean there while torch carries unused weights.
+    """
+    out = {
+        "triangle_attention_outgoing":
+            axial_attention(tb.triangle_attention_outgoing),
+        "triangle_attention_ingoing":
+            axial_attention(tb.triangle_attention_ingoing),
+        "triangle_multiply_outgoing":
+            triangle_multiplicative(tb.triangle_multiply_outgoing),
+        "triangle_multiply_ingoing":
+            triangle_multiplicative(tb.triangle_multiply_ingoing),
+    }
+    if include_outer_mean:
+        out["outer_mean"] = outer_mean(tb.outer_mean)
+    return out
+
+
+def msa_block(tb) -> dict:
+    """reference MsaAttentionBlock (alphafold2.py:387-408)."""
+    return {
+        "row_attn": axial_attention(tb.row_attn),
+        "col_attn": axial_attention(tb.col_attn),
+    }
+
+
+def evoformer_block(teb) -> dict:
+    """reference EvoformerBlock (alphafold2.py:412-446): layer ModuleList
+    order is [pairwise, pair-ff, msa-attn, msa-ff]."""
+    pair, ff, msa_attn, msa_ff = teb.layer
+    return {
+        "attn": pairwise_block(pair),
+        "ff": feed_forward(ff),
+        "msa_attn": msa_block(msa_attn),
+        "msa_ff": feed_forward(msa_ff),
+    }
+
+
+def _stack_trees(trees):
+    """Stack a list of identical-structure trees along a new leading axis
+    (the scanned-depth axis of our Evoformer params)."""
+    if isinstance(trees[0], dict):
+        return {k: _stack_trees([t[k] for t in trees]) for k in trees[0]}
+    return np.stack(trees, axis=0)
+
+
+def evoformer(tev, scanned: bool) -> dict:
+    """reference Evoformer (alphafold2.py:448-467) -> our scan layout
+    ('layers/block' with a leading depth axis, model/evoformer.py) or the
+    unrolled 'layers_i' layout for depth-1 / use_scan=False models."""
+    blocks = [evoformer_block(b) for b in tev.layers]
+    if scanned and len(blocks) > 1:
+        return {"layers": {"block": _stack_trees(blocks)}}
+    return {f"layers_{i}": b for i, b in enumerate(blocks)}
+
+
+# --------------------------------------------------------------------------
+# whole model
+# --------------------------------------------------------------------------
+
+
+def port_alphafold2(tmodel, template_params) -> Tuple[dict, list]:
+    """Port a reference `Alphafold2` torch module into a flax params tree.
+
+    `template_params` must come from our `Alphafold2.init(...)` at the
+    matching configuration; ported subtrees replace the template's leaves
+    (with shape checks), everything else keeps its init. Returns
+    (params, unported_top_level_keys).
+    """
+    ported = {
+        "token_emb": embedding(tmodel.token_emb),
+        "to_pairwise_repr": linear(tmodel.to_pairwise_repr),
+        "pos_emb": embedding(tmodel.pos_emb),
+        "embedd_project": linear(tmodel.embedd_project),
+        "extra_msa_evoformer": evoformer(tmodel.extra_msa_evoformer,
+                                         scanned=True),
+        "net": evoformer(tmodel.net, scanned=True),
+        "mlm": {"to_logits": linear(tmodel.mlm.to_logits)},
+        "template_pairwise_embedder":
+            pairwise_block(tmodel.template_pairwise_embedder,
+                           include_outer_mean=False),
+        "template_pointwise_attn":
+            attention(tmodel.template_pointwise_attn),
+        "to_template_embed": linear(tmodel.to_template_embed),
+        "template_angle_mlp_in": linear(tmodel.template_angle_mlp[0]),
+        "template_angle_mlp_out": linear(tmodel.template_angle_mlp[2]),
+        "distogram_norm": {"LayerNorm_0": layernorm(
+            tmodel.to_distogram_logits[0])["LayerNorm_0"]},
+        "to_distogram_logits": linear(tmodel.to_distogram_logits[1]),
+        "msa_to_single_repr_dim": linear(tmodel.msa_to_single_repr_dim),
+        "trunk_to_pairwise_repr_dim":
+            linear(tmodel.trunk_to_pairwise_repr_dim),
+        "lddt_linear": linear(tmodel.lddt_linear),
+        "recycling_msa_norm": {"LayerNorm_0": layernorm(
+            tmodel.recycling_msa_norm)["LayerNorm_0"]},
+        "recycling_pairwise_norm": {"LayerNorm_0": layernorm(
+            tmodel.recycling_pairwise_norm)["LayerNorm_0"]},
+        "recycling_distance_embed":
+            embedding(tmodel.recycling_distance_embed),
+    }
+    if getattr(tmodel, "predict_angles", False):
+        ported["to_prob_theta"] = linear(tmodel.to_prob_theta)
+        ported["to_prob_phi"] = linear(tmodel.to_prob_phi)
+        ported["to_prob_omega"] = linear(tmodel.to_prob_omega)
+    if hasattr(tmodel, "to_quaternion_update"):
+        # structure-module surroundings (the IPA block itself is not
+        # portable — see module docstring)
+        ported["structure_module"] = {
+            "to_quaternion_update": linear(tmodel.to_quaternion_update),
+            "to_points": linear(tmodel.to_points),
+        }
+
+    def merge(template, new, path=""):
+        if not isinstance(template, dict):
+            arr = np.asarray(new)
+            t_arr = np.asarray(template)
+            if arr.shape != t_arr.shape:
+                raise ValueError(
+                    f"shape mismatch at {path}: ported {arr.shape} vs "
+                    f"template {t_arr.shape}")
+            return arr.astype(t_arr.dtype)
+        out = dict(template)
+        for k, v in new.items():
+            if k not in template:
+                raise KeyError(f"ported key {path}/{k} not in template — "
+                               "config mismatch?")
+            out[k] = merge(template[k], v, f"{path}/{k}")
+        return out
+
+    # present in the torch model regardless of config (torch builds every
+    # module in __init__) but present in our lazily-built tree only when
+    # the config exercises them
+    config_dependent = {
+        "msa_to_single_repr_dim", "trunk_to_pairwise_repr_dim",
+        "lddt_linear", "structure_module",
+        "to_prob_theta", "to_prob_phi", "to_prob_omega",
+    }
+
+    params = dict(template_params)
+    top = dict(params["params"])
+    unported = [k for k in top if k not in ported]
+    for k, sub in ported.items():
+        if k not in top:
+            if k in config_dependent:
+                continue
+            raise KeyError(
+                f"ported top-level {k!r} missing from template; build the "
+                "template with the matching Alphafold2 configuration")
+        top[k] = merge(top[k], sub, k)
+    params["params"] = top
+    return params, unported
+
+
+def main():  # pragma: no cover - thin CLI around port_alphafold2
+    import argparse
+    import os
+    import sys
+
+    # same import surface as tests/test_parity.py: the repo root (for
+    # alphafold2_tpu), this dir (for _reference_stubs) and the reference
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(here))
+    sys.path.insert(0, here)
+    if os.path.isdir("/root/reference"):
+        sys.path.insert(0, "/root/reference")
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--torch-ckpt", required=True,
+                        help="torch .pt file with a reference state_dict")
+    parser.add_argument("--model-kwargs", default="{}",
+                        help="JSON kwargs shared by both model constructors")
+    parser.add_argument("--out", required=True,
+                        help="output .msgpack of the flax params")
+    args = parser.parse_args()
+
+    import torch
+
+    import _reference_stubs  # noqa: F401 (fills reference native deps)
+    from alphafold2_pytorch import Alphafold2 as RefAlphafold2
+
+    import jax
+    from flax import serialization
+
+    from alphafold2_tpu import Alphafold2
+
+    kwargs = json.loads(args.model_kwargs)
+    tmodel = RefAlphafold2(**kwargs)
+    tmodel.load_state_dict(torch.load(args.torch_ckpt, map_location="cpu"))
+    tmodel.eval()
+
+    model = Alphafold2(**kwargs)
+    seq = jax.numpy.zeros((1, 8), dtype=jax.numpy.int32)
+    template = model.init(jax.random.PRNGKey(0), seq)
+    params, unported = port_alphafold2(tmodel, template)
+    with open(args.out, "wb") as f:
+        f.write(serialization.to_bytes(params))
+    print(f"wrote {args.out}; unported top-level keys: {unported}")
+
+
+if __name__ == "__main__":
+    main()
