@@ -47,9 +47,24 @@ class _PretrainedWrapper:
                     f"(download failed or package missing): {exc}") from exc
         return self._backend
 
-    def embed_batch(self, seq, msa=None):
-        """Returns (seq_embed, msa_embed) numpy arrays at LM dims."""
+    def _embed_tokens(self, tokens_2d) -> np.ndarray:
+        """(rows, L) int tokens -> (rows, L, lm_dim) embeddings."""
         raise NotImplementedError
+
+    def embed_batch(self, seq, msa=None):
+        """Returns (seq_embed, msa_embed) numpy arrays at LM dims.
+
+        Default: embed the sequence directly and the MSA row-by-row
+        (flattened through `_embed_tokens`); MSAEmbedWrapper overrides
+        this wholesale because the MSA transformer embeds the whole
+        alignment jointly."""
+        seq_embed = self._embed_tokens(np.asarray(seq))
+        msa_embed = None
+        if msa is not None:
+            m = np.asarray(msa)
+            flat = m.reshape(-1, m.shape[-1])
+            msa_embed = self._embed_tokens(flat).reshape(*m.shape, -1)
+        return seq_embed, msa_embed
 
     def __call__(self, params=None, seq=None, msa=None, **kwargs):
         if params is None:
@@ -85,15 +100,6 @@ class ESMEmbedWrapper(_PretrainedWrapper):
         reps = out["representations"][self.REPR_LAYER]
         return reps[:, 1:1 + tokens_2d.shape[-1]].cpu().numpy()
 
-    def embed_batch(self, seq, msa=None):
-        seq_embed = self._embed_tokens(np.asarray(seq))
-        msa_embed = None
-        if msa is not None:
-            m = np.asarray(msa)
-            flat = m.reshape(-1, m.shape[-1])
-            msa_embed = self._embed_tokens(flat).reshape(*m.shape, -1)
-        return seq_embed, msa_embed
-
 
 class MSAEmbedWrapper(_PretrainedWrapper):
     """MSA-Transformer row embeddings (reference embeds.py:33-75,
@@ -128,6 +134,35 @@ class MSAEmbedWrapper(_PretrainedWrapper):
         return msa_embed[:, 0], msa_embed
 
 
+class ProtT5EmbedWrapper(_PretrainedWrapper):
+    """ProtT5-XL-U50 embeddings via HuggingFace (reference
+    utils.py:355-390 get_t5_embedd; 1024-d = constants.NUM_EMBEDDS_T5).
+
+    Unlike BERT-style models there is no leading CLS token: the encoder
+    output aligns with residue 0 directly and only the trailing ``</s>``
+    must be dropped (the reference's ``shift_left, shift_right = 0, -1``).
+    """
+
+    def _load(self):
+        from transformers import T5EncoderModel, T5Tokenizer
+        name = "Rostlab/prot_t5_xl_uniref50"
+        return (T5EncoderModel.from_pretrained(name),
+                T5Tokenizer.from_pretrained(name, do_lower_case=False))
+
+    def _embed_tokens(self, tokens_2d) -> np.ndarray:
+        torch = _lazy_torch()
+        model, tokenizer = self._ensure_loaded()
+        texts = [" ".join(detokenize(row).replace("_", "X"))
+                 for row in np.asarray(tokens_2d)]
+        enc = tokenizer.batch_encode_plus(texts, add_special_tokens=True,
+                                          padding=True, return_tensors="pt")
+        with torch.no_grad():
+            out = model(input_ids=enc["input_ids"],
+                        attention_mask=enc["attention_mask"])
+        reps = out.last_hidden_state
+        return reps[:, :tokens_2d.shape[-1]].float().cpu().numpy()
+
+
 class ProtTranEmbedWrapper(_PretrainedWrapper):
     """ProtBERT embeddings via HuggingFace (reference embeds.py:10-31,
     utils.py:295-306; 1024-d)."""
@@ -147,12 +182,3 @@ class ProtTranEmbedWrapper(_PretrainedWrapper):
         with torch.no_grad():
             out = model(**enc).last_hidden_state
         return out[:, 1:1 + tokens_2d.shape[-1]].cpu().numpy()
-
-    def embed_batch(self, seq, msa=None):
-        seq_embed = self._embed_tokens(np.asarray(seq))
-        msa_embed = None
-        if msa is not None:
-            m = np.asarray(msa)
-            flat = m.reshape(-1, m.shape[-1])
-            msa_embed = self._embed_tokens(flat).reshape(*m.shape, -1)
-        return seq_embed, msa_embed
