@@ -146,6 +146,52 @@ def shard_pytree_zero(tree, mesh: Mesh, axis: str = DATA_AXIS):
         lambda leaf: next(it) if hasattr(leaf, "shape") else leaf, tree)
 
 
+def tp_param_specs(params, mesh: Mesh, axis: str = PAIR_J_AXIS):
+    """Megatron-style tensor-parallel PartitionSpecs for the model's
+    param tree, keyed by layer-name suffix (SURVEY §2.5 "tensor/model
+    parallel"; the reference has no TP at all).
+
+    Column-parallel (shard the output features): attention to_q/to_kv/
+    gating, the first FF projection, triangle left/right projections —
+    each head's / hidden unit's compute lands whole on one device.
+    Row-parallel (shard the input features): to_out, the second FF
+    projection, triangle proj_out — XLA inserts the one all-reduce at the
+    block boundary. Under GSPMD these specs are placement policy only;
+    outputs are bit-identical to the replicated run (tests/
+    test_sharding.py::TestTensorParallel asserts both).
+    """
+    n = mesh.shape[axis]
+
+    COL = ("to_q/kernel", "to_kv/kernel", "gating/kernel",
+           "left_proj/kernel", "right_proj/kernel", "Dense_0/kernel")
+    ROW = ("to_out/kernel", "proj_out/kernel", "Dense_1/kernel")
+    COL_BIAS = ("gating/bias", "left_proj/bias", "right_proj/bias",
+                "Dense_0/bias")
+
+    def spec_for(path, leaf):
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        shape = getattr(leaf, "shape", ())
+        if n > 1 and shape:
+            if name.endswith(COL) and shape[-1] % n == 0:
+                return P(*([None] * (len(shape) - 1) + [axis]))
+            if name.endswith(ROW) and len(shape) >= 2 and \
+                    shape[-2] % n == 0:
+                return P(*([None] * (len(shape) - 2) + [axis, None]))
+            if name.endswith(COL_BIAS) and shape[-1] % n == 0:
+                return P(*([None] * (len(shape) - 1) + [axis]))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def shard_pytree_tp(params, mesh: Mesh, axis: str = PAIR_J_AXIS):
+    """device_put the param tree with `tp_param_specs` placements."""
+    specs = tp_param_specs(params, mesh, axis)
+    return jax.device_put(
+        params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P)))
+
+
 def pytree_bytes_per_device(tree) -> int:
     """Max per-device bytes across the addressable shards of `tree`'s
     array leaves (replicated leaves count fully on every device)."""

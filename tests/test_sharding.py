@@ -180,3 +180,60 @@ def test_graft_entry_contracts():
     assert out.shape[-1] == 3
 
     graft.dryrun_multichip(8)
+
+
+class TestTensorParallel:
+    """Megatron-style TP via tp_param_specs (SURVEY §2.5): outputs match
+    the replicated run and per-device param bytes actually shrink."""
+
+    def test_tp_forward_matches_and_shards_bytes(self):
+        from alphafold2_tpu.parallel.sharding import (
+            pytree_bytes_per_device, shard_pytree_tp, tp_param_specs)
+
+        model = Alphafold2(dim=32, depth=2, heads=4, dim_head=8)
+        batch = synthetic_batch(jax.random.PRNGKey(3), batch=2, seq_len=16,
+                                msa_depth=3, with_coords=False)
+        args = (batch["seq"],)
+        kwargs = dict(msa=batch["msa"], mask=batch["mask"],
+                      msa_mask=batch["msa_mask"])
+        params = model.init(jax.random.PRNGKey(4), *args, **kwargs)
+
+        ret_single = jax.jit(lambda p: model.apply(p, *args, **kwargs))(
+            params)
+
+        mesh = make_mesh(1, 1, 8)  # all devices on the TP axis
+        with use_mesh(mesh):
+            params_tp = shard_pytree_tp(params, mesh, axis="j")
+            ret_tp = jax.jit(lambda p: model.apply(p, *args, **kwargs))(
+                params_tp)
+        assert np.allclose(ret_single.distance, ret_tp.distance, atol=2e-4)
+
+        replicated = jax.device_put(
+            params, NamedSharding(mesh, P()))
+        full = pytree_bytes_per_device(replicated)
+        tp = pytree_bytes_per_device(params_tp)
+        # the big projection kernels dominate; per-device bytes must drop
+        # substantially (not 8x: embeddings/norms stay replicated)
+        assert tp < 0.55 * full, (tp, full)
+
+    def test_tp_specs_hit_attention_and_ff(self):
+        from alphafold2_tpu.parallel.sharding import tp_param_specs
+
+        model = Alphafold2(dim=32, depth=2, heads=4, dim_head=8)
+        batch = synthetic_batch(jax.random.PRNGKey(5), batch=1, seq_len=8,
+                                msa_depth=2, with_coords=False)
+        params = model.init(jax.random.PRNGKey(6), batch["seq"],
+                            msa=batch["msa"], mask=batch["mask"],
+                            msa_mask=batch["msa_mask"])
+        mesh = make_mesh(1, 1, 8)
+        specs = tp_param_specs(params, mesh, axis="j")
+        flat = jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P))[0]
+        named = {"/".join(str(getattr(k, "key", k)) for k in path): spec
+                 for path, spec in flat}
+        sharded = [n for n, s in named.items() if s != P()]
+        assert any("to_q/kernel" in n for n in sharded)
+        assert any("to_out/kernel" in n for n in sharded)
+        assert any("Dense_0/kernel" in n for n in sharded)
+        # norms and embeddings stay replicated
+        assert all("norm" not in n.lower() for n in sharded)
