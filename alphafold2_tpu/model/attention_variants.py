@@ -190,8 +190,10 @@ def block_sparse_mask(n: int, block: int = 32, num_global: int = 1,
 class BlockSparseAttention(nn.Module):
     """Self-attention restricted to a fixed block-sparse pattern (the
     DeepSpeed sparse-attention analog). Dense compute + additive mask —
-    correct semantics at any size; a Pallas kernel can skip masked blocks
-    using the same pattern when profiling demands."""
+    correct semantics at any size. The true block-skipping TPU path is
+    `ops.block_sparse.block_sparse_attention` (splash-style Pallas
+    kernel, FLOPs ∝ nnz blocks; exactness-tested against this module's
+    semantics in tests/test_ops.py::TestBlockSparseKernel)."""
 
     dim: int
     heads: int = 8

@@ -1,0 +1,147 @@
+"""True block-sparse attention Pallas kernel (splash-style block skipping).
+
+Round-1 VERDICT (§2.4 "DeepSpeed sparse attn"): the model-level
+`BlockSparseAttention` is dense compute + additive mask — correct
+semantics, zero FLOP savings. This kernel does the real thing, the TPU
+way: the sparsity pattern is compressed host-side into a per-q-block
+column list, the grid's innermost dimension runs only to the max live
+block count T (<< n_blocks for banded/global patterns), and a scalar-
+prefetched index map steers each step's k/v DMA straight to the t-th
+live block. FLOPs and HBM traffic both scale with nnz blocks, not N².
+
+Softmax is the online (flash) recurrence over visited blocks — running
+row max / denominator in VMEM scratch, output written on the last step.
+Equivalent to dense attention with the pattern applied as a -1e9
+additive bias (tests/test_ops.py::TestBlockSparseKernel asserts this
+against `attention_reference`).
+
+No torch/CUDA counterpart is being translated here: DeepSpeed's sparse
+attention is a Triton kernel stack; this is an independent Pallas
+design following the public splash-attention pattern (scalar prefetch +
+compressed column index).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    HAS_PALLAS = False
+
+NEG_INF = float("-inf")
+
+
+def plan_block_pattern(pattern: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Compress a (nqb, nkb) boolean block pattern into a padded column
+    plan: cols[i, t] = index of the t-th live k-block of q-block i,
+    valid[i, t] = 1 where the slot is real. Every q-block must keep at
+    least one live k-block (softmax over an empty row is undefined)."""
+    pattern = np.asarray(pattern, dtype=bool)
+    counts = pattern.sum(axis=1)
+    if counts.min() < 1:
+        raise ValueError("every q block needs >= 1 live k block")
+    t_max = int(counts.max())
+    nqb = pattern.shape[0]
+    cols = np.zeros((nqb, t_max), np.int32)
+    valid = np.zeros((nqb, t_max), np.int32)
+    for i in range(nqb):
+        live = np.nonzero(pattern[i])[0]
+        cols[i, :live.size] = live
+        valid[i, :live.size] = 1
+    return cols, valid
+
+
+def _kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
+            acc_ref, m_ref, l_ref, *, t_total):
+    qb = pl.program_id(1)
+    t = pl.program_id(2)
+
+    @pl.when(t == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(valid_ref[qb, t] == 1)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)          # (bq, d)
+        k = k_ref[0].astype(jnp.float32)          # (bk, d)
+        v = v_ref[0].astype(jnp.float32)          # (bk, d)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)   # (bq, bk)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, logits.max(axis=-1, keepdims=True))
+        # exp(-inf - m_new) == 0 covers the first live step cleanly
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(logits - m_new)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        l_ref[...] = l_ref[...] * alpha + p.sum(axis=-1, keepdims=True)
+        m_ref[...] = m_new
+
+    @pl.when(t == t_total - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def block_sparse_attention(
+    q: jnp.ndarray,                # (B, N, D), pre-scaled
+    k: jnp.ndarray,                # (B, N, D)
+    v: jnp.ndarray,                # (B, N, D)
+    pattern: np.ndarray,           # (nqb, nkb) bool, STATIC
+    *,
+    block: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Attention restricted to `pattern` with true block skipping."""
+    if not HAS_PALLAS:
+        raise RuntimeError("block_sparse_attention needs jax.experimental"
+                           ".pallas, which failed to import in this build")
+    b, n, d = q.shape
+    assert n % block == 0, (n, block)
+    nqb = n // block
+    assert pattern.shape == (nqb, nqb), (pattern.shape, nqb)
+    cols, valid = plan_block_pattern(pattern)
+    t_total = cols.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nqb, t_total),
+        in_specs=[
+            pl.BlockSpec((1, block, d),
+                         lambda bi, qb, t, cols, valid: (bi, qb, 0)),
+            pl.BlockSpec((1, block, d),
+                         lambda bi, qb, t, cols, valid:
+                         (bi, cols[qb, t], 0)),
+            pl.BlockSpec((1, block, d),
+                         lambda bi, qb, t, cols, valid:
+                         (bi, cols[qb, t], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block, d),
+                               lambda bi, qb, t, cols, valid: (bi, qb, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block, d), jnp.float32),   # acc
+            pltpu.VMEM((block, 1), jnp.float32),   # running max
+            pltpu.VMEM((block, 1), jnp.float32),   # denominator
+        ],
+    )
+    kernel = functools.partial(_kernel, t_total=t_total)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n, d), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(jnp.asarray(cols), jnp.asarray(valid), q, k, v)
