@@ -99,6 +99,10 @@ class Alphafold2(nn.Module):
     structure_module_refinement_iters: int = 0
     # reversible main trunk (README.md:40-era flag): O(1) activation memory
     reversible: bool = False
+    # scan+remat over trunk depth (Evoformer.use_scan); False unrolls the
+    # stack with full activation storage — the linear-memory comparison
+    # point for tools/memory_probe.py
+    use_scan: bool = True
     # ring-parallel triangle attention over the 2-D-sharded pair tensor
     # (parallel/ring.py): exact long-context mode, active only when the
     # mesh actually shards the pair axes; no-op otherwise
@@ -354,7 +358,7 @@ class Alphafold2(nn.Module):
             ring_attention=self.ring_attention,
             outer_mean_reference_scale=self.outer_mean_reference_scale,
             dtype=self.dtype,
-            reversible=self.reversible, name="net",
+            reversible=self.reversible, use_scan=self.use_scan, name="net",
         )(x, m, mask=x_mask, msa_mask=msa_mask, deterministic=deterministic)
 
         # --- init-time coverage of conditional branches -------------------

@@ -93,12 +93,15 @@ def _attn_kernel(*refs, scale, has_bias, has_qm, has_km):
         logits = logits + bias_ref[0].astype(jnp.float32)
     if has_qm or has_km:
         # masks arrive as (1, len) f32 rows; the (bq, n) fill pattern is
-        # their outer AND, built here in VMEM rather than in HBM upstream
+        # their outer AND, built here in VMEM rather than in HBM upstream.
+        # Reshape the f32 rows BEFORE comparing: Mosaic (v5e) cannot
+        # reshape i1 vectors across the minor dim ("Insertion of minor dim
+        # that is not a no-op only supported for 32-bit types").
         valid = jnp.ones(logits.shape, dtype=bool)
         if has_qm:
-            valid &= (qm_ref[0] > 0).reshape(-1, 1)   # (bq, 1)
+            valid &= qm_ref[0].reshape(-1, 1) > 0     # (bq, 1)
         if has_km:
-            valid &= (km_ref[0] > 0).reshape(1, -1)   # (1, n)
+            valid &= km_ref[0].reshape(1, -1) > 0     # (1, n)
         logits = jnp.where(valid, logits, MASK_VALUE)
 
     m = jnp.max(logits, axis=-1, keepdims=True)
