@@ -1,0 +1,165 @@
+"""Multi-config benchmark suite over the BASELINE.json configs.
+
+`bench.py` covers the north-star metric (config-1-shaped train step at
+256res). This tool fills the rest of the BASELINE table: one JSON line
+per config with train-step ms and, where the config folds structures,
+folds/hour/chip (inference with recycling).
+
+Configs (BASELINE.md "Benchmark configs to measure"):
+  1 distogram-only dim256/depth2 trunk, 128-res
+  2 trRosetta-mode: predict_angles trunk with anglegram CE targets
+    (the ESM seq-embed preprocessing is host-side and not timed here)
+  3 EGNN structure module end-to-end, 64-res, backbone coords
+  4 SE3-style refiner, refinement_iters=4, reversible trunk
+  fold: folds/hour/chip at 256-res with 3 recycles (predict_coords IPA)
+
+Usage:
+  python tools/bench_suite.py [--configs 1,2,3,4,fold] [--iters 5]
+                              [--tiny]   # smoke sizes for CPU checks
+
+Runs on whatever platform jax selects (the real chip under the driver);
+falls back to CPU with the same hardening as bench.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import (_enable_compile_cache, force_cpu_fallback,  # noqa: E402
+                             jax_backends_initialized, tiny_op_probe)
+
+if not jax_backends_initialized() and \
+        os.environ.get("BENCH_NO_FALLBACK") != "1" and not tiny_op_probe():
+    force_cpu_fallback("bench_suite: default platform unreachable")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+_enable_compile_cache()
+
+from alphafold2_tpu import Alphafold2  # noqa: E402
+from alphafold2_tpu.data.synthetic import synthetic_batch  # noqa: E402
+from alphafold2_tpu.predict import fold  # noqa: E402
+from alphafold2_tpu.train import TrainState, adam, make_train_step  # noqa: E402
+
+
+def _train_step_ms(model, batch, iters, warmup=1):
+    params = model.init(
+        {"params": jax.random.PRNGKey(1), "mlm": jax.random.PRNGKey(2)},
+        batch["seq"], msa=batch["msa"], mask=batch["mask"],
+        msa_mask=batch["msa_mask"], train=True)
+    state = TrainState.create(apply_fn=model.apply, params=params,
+                              tx=adam(3e-4), rng=jax.random.PRNGKey(3))
+    step = jax.jit(make_train_step(model), donate_argnums=(0,))
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def config_1(tiny, iters):
+    l = 32 if tiny else 128
+    model = Alphafold2(dim=64 if tiny else 256, depth=2, heads=8,
+                       dim_head=64, dtype=jnp.bfloat16)
+    batch = synthetic_batch(jax.random.PRNGKey(0), batch=1, seq_len=l,
+                            msa_depth=5, with_coords=True)
+    return {"config": "1_distogram_128res",
+            "train_step_ms": round(_train_step_ms(model, batch, iters), 2)}
+
+
+def config_2(tiny, iters):
+    l = 32 if tiny else 128
+    dim = 64 if tiny else 256
+    model = Alphafold2(dim=dim, depth=2, heads=8, dim_head=64,
+                       predict_angles=True, dtype=jnp.bfloat16)
+    # with_angles: theta/phi/omega bucket targets so the anglegram CE
+    # loss (and its backward) is actually part of the timed step
+    batch = synthetic_batch(jax.random.PRNGKey(0), batch=1, seq_len=l,
+                            msa_depth=5, with_coords=True,
+                            with_angles=True)
+    return {"config": "2_trrosetta_angles",
+            "train_step_ms": round(_train_step_ms(model, batch, iters), 2)}
+
+
+def config_3(tiny, iters):
+    l = 16 if tiny else 64
+    model = Alphafold2(dim=32 if tiny else 128, depth=2, heads=8,
+                       dim_head=64, predict_coords=True,
+                       structure_module_type="egnn",
+                       structure_module_depth=2, dtype=jnp.bfloat16)
+    batch = synthetic_batch(jax.random.PRNGKey(0), batch=1, seq_len=l,
+                            msa_depth=5, with_coords=True)
+    return {"config": "3_egnn_end2end_64res",
+            "train_step_ms": round(_train_step_ms(model, batch, iters), 2)}
+
+
+def config_4(tiny, iters):
+    l = 16 if tiny else 64
+    model = Alphafold2(dim=32 if tiny else 128, depth=2, heads=8,
+                       dim_head=64, predict_coords=True,
+                       structure_module_type="se3",
+                       structure_module_depth=2,
+                       structure_module_refinement_iters=4,
+                       reversible=True, dtype=jnp.bfloat16)
+    batch = synthetic_batch(jax.random.PRNGKey(0), batch=1, seq_len=l,
+                            msa_depth=5, with_coords=True)
+    return {"config": "4_se3_refine_reversible",
+            "train_step_ms": round(_train_step_ms(model, batch, iters), 2)}
+
+
+def config_fold(tiny, iters):
+    l = 32 if tiny else 256
+    model = Alphafold2(dim=64 if tiny else 256, depth=2, heads=8,
+                       dim_head=64, predict_coords=True,
+                       structure_module_depth=2, dtype=jnp.bfloat16)
+    batch = synthetic_batch(jax.random.PRNGKey(0), batch=1, seq_len=l,
+                            msa_depth=5, with_coords=False)
+    params = model.init(jax.random.PRNGKey(1), batch["seq"],
+                        msa=batch["msa"], mask=batch["mask"],
+                        msa_mask=batch["msa_mask"])
+
+    import functools
+    run = jax.jit(functools.partial(fold, model,
+                                    num_recycles=3))
+    res = run(params, batch["seq"], msa=batch["msa"], mask=batch["mask"],
+              msa_mask=batch["msa_mask"])
+    jax.block_until_ready(res.coords)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        res = run(params, batch["seq"], msa=batch["msa"],
+                  mask=batch["mask"], msa_mask=batch["msa_mask"])
+    jax.block_until_ready(res.coords)
+    sec = (time.perf_counter() - t0) / iters
+    return {"config": f"fold_{l}res_3recycles",
+            "fold_seconds": round(sec, 4),
+            "folds_per_hour_per_chip": round(3600.0 / sec, 1)}
+
+
+CONFIGS = {"1": config_1, "2": config_2, "3": config_3, "4": config_4,
+           "fold": config_fold}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="1,2,3,4,fold")
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--tiny", action="store_true")
+    args = ap.parse_args()
+    for key in args.configs.split(","):
+        res = CONFIGS[key](args.tiny, args.iters)
+        res["platform"] = jax.default_backend()
+        print(json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    main()

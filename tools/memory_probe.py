@@ -25,7 +25,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from __graft_entry__ import _enable_compile_cache  # noqa: E402
+from __graft_entry__ import (_enable_compile_cache, force_cpu_fallback,  # noqa: E402
+                             jax_backends_initialized, tiny_op_probe)
+
+# same wedged-tunnel hardening as bench.py/bench_suite.py: fall back to
+# CPU with a message instead of hanging inside backend init
+if not jax_backends_initialized() and \
+        os.environ.get("BENCH_NO_FALLBACK") != "1" and not tiny_op_probe():
+    force_cpu_fallback("memory_probe: default platform unreachable")
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
@@ -65,7 +72,9 @@ def analyze(depth: int, seq_len: int, dim: int, reversible: bool,
             if v is not None:
                 out[k.replace("_in_bytes", "_mb")] = round(v / 2**20, 1)
     if run:
-        state, metrics = step(state, batch)
+        # reuse the AOT-compiled executable; calling `step` would
+        # re-trace and re-compile (jit's call cache is separate)
+        state, metrics = compiled(state, batch)
         jax.block_until_ready(metrics["loss"])
         out["loss"] = float(metrics["loss"])
         stats = jax.local_devices()[0].memory_stats() or {}
