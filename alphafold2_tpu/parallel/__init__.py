@@ -14,8 +14,17 @@ from alphafold2_tpu.parallel.sharding import (  # noqa: F401
     seq_spec,
     shard_msa,
     shard_pair,
+    shard_pytree_tp_zero,
     shard_pytree_zero,
     shard_seq,
+    tp_param_specs,
     use_mesh,
     zero_param_specs,
+)
+from alphafold2_tpu.parallel.pipeline import (  # noqa: F401
+    make_pipeline_mesh,
+    microbatch,
+    pipeline_apply,
+    stack_stage_params,
+    unmicrobatch,
 )

@@ -192,6 +192,29 @@ def shard_pytree_tp(params, mesh: Mesh, axis: str = PAIR_J_AXIS):
                              is_leaf=lambda x: isinstance(x, P)))
 
 
+def shard_pytree_tp_zero(tree, mesh: Mesh, tp_axis: str = PAIR_J_AXIS,
+                         zero_axis: str = DATA_AXIS):
+    """Combined placement: tensor-parallel specs where they apply (the
+    attention/FF/triangle projection kernels and, via shape-matched
+    suffixes, their optimizer moments), ZeRO over the data axis for every
+    other array leaf. One batched device_put; non-array leaves pass
+    through untouched."""
+    tp = tp_param_specs(tree, mesh, tp_axis)
+    zero = zero_param_specs(tree, mesh, zero_axis)
+    merged = jax.tree.map(
+        lambda t, z: t if t != P() else z, tp, zero,
+        is_leaf=lambda x: isinstance(x, P))
+    specs = jax.tree.leaves(merged, is_leaf=lambda x: isinstance(x, P))
+    leaves = jax.tree.leaves(tree)
+    assert len(leaves) == len(specs)
+    arr = [(l, s) for l, s in zip(leaves, specs) if hasattr(l, "shape")]
+    placed = jax.device_put([l for l, _ in arr],
+                            [NamedSharding(mesh, s) for _, s in arr])
+    it = iter(placed)
+    return jax.tree.map(
+        lambda leaf: next(it) if hasattr(leaf, "shape") else leaf, tree)
+
+
 def pytree_bytes_per_device(tree) -> int:
     """Max per-device bytes across the addressable shards of `tree`'s
     array leaves (replicated leaves count fully on every device)."""
