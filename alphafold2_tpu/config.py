@@ -72,6 +72,9 @@ class TrainConfig:
     learning_rate: float = 3e-4
     grad_accum_every: int = 16        # reference train_pre.py:16
     max_grad_norm: Optional[float] = None
+    # warmup+cosine schedule (0 / None = the reference's constant LR)
+    warmup_steps: int = 0
+    decay_steps: Optional[int] = None
     num_steps: int = 1000
     log_every: int = 10
     checkpoint_dir: Optional[str] = None
@@ -113,6 +116,8 @@ class Experiment:
         from alphafold2_tpu.train import adam
         model = self.model.build()
         tx = adam(self.train.learning_rate, self.train.grad_accum_every,
-                  self.train.max_grad_norm)
+                  self.train.max_grad_norm,
+                  warmup_steps=self.train.warmup_steps,
+                  decay_steps=self.train.decay_steps)
         mesh = self.mesh.build()
         return model, tx, mesh

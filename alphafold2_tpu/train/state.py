@@ -28,14 +28,32 @@ def adam(
     learning_rate: float = 3e-4,
     grad_accum_every: int = 1,
     max_grad_norm: Optional[float] = None,
+    warmup_steps: int = 0,
+    decay_steps: Optional[int] = None,
+    end_lr_ratio: float = 0.1,
 ) -> optax.GradientTransformation:
     """The reference's optimizer (Adam 3e-4, grad-accum 16 —
     train_pre.py:16,58; train_end2end.py:27) as one optax chain;
-    accumulation via MultiSteps instead of a Python loop."""
+    accumulation via MultiSteps instead of a Python loop.
+
+    Beyond the reference's bare Adam: optional linear warmup over
+    `warmup_steps` and cosine decay to `end_lr_ratio * learning_rate`
+    over `decay_steps` (the AF2-style schedule). Both default off, so
+    the reference configuration is the default behavior.
+    """
+    if warmup_steps > 0 or decay_steps is not None:
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0 if warmup_steps > 0 else learning_rate,
+            peak_value=learning_rate,
+            warmup_steps=warmup_steps,
+            decay_steps=max(decay_steps or warmup_steps, warmup_steps + 1),
+            end_value=end_lr_ratio * learning_rate)
+    else:
+        lr = learning_rate
     parts = []
     if max_grad_norm is not None:
         parts.append(optax.clip_by_global_norm(max_grad_norm))
-    parts.append(optax.adam(learning_rate))
+    parts.append(optax.adam(lr))
     tx = optax.chain(*parts)
     if grad_accum_every > 1:
         tx = optax.MultiSteps(tx, every_k_schedule=grad_accum_every)

@@ -79,6 +79,11 @@ def main(argv=None):
             msa_mask=first.get("msa_mask"), train=True)
         state = TrainState.create(apply_fn=model.apply, params=params,
                                   tx=tx, rng=jax.random.fold_in(rng, 2))
+        if mesh is not None:
+            # TP specs for the projection kernels, ZeRO for the rest —
+            # the same placement the multichip dryrun validates
+            from alphafold2_tpu.parallel import shard_pytree_tp_zero
+            state = shard_pytree_tp_zero(state, mesh)
 
         timer = StepTimer()
         logger = MetricsLogger(args.log)

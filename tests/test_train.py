@@ -332,3 +332,43 @@ class TestGuard:
         # failure-path save overwrites/creates at the current step
         ck.on_failure(state.replace(step=jnp.asarray(3)))
         assert ck.manager.latest_step() == 3
+
+
+class TestSchedule:
+    def test_warmup_cosine_descends_and_warms(self):
+        """Warmup: first update tiny; peak: updates grow; beyond the
+        reference's bare Adam (train_pre.py:16) but default-off."""
+        import optax
+
+        tx = adam(1e-2, warmup_steps=5, decay_steps=50)
+        params = {"w": jnp.ones((4,))}
+        opt_state = tx.init(params)
+        grads = {"w": jnp.ones((4,))}
+        sizes = []
+        for _ in range(6):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            sizes.append(float(jnp.abs(updates["w"]).max()))
+        # step 0 uses lr ~0 (warmup from 0); later steps approach peak
+        assert sizes[0] < 1e-4
+        assert sizes[-1] > sizes[0]
+
+    def test_default_matches_reference_constant_lr(self):
+        tx_plain = adam(1e-3)
+        tx_sched = adam(1e-3, warmup_steps=0, decay_steps=None)
+        params = {"w": jnp.ones((3,))}
+        g = {"w": jnp.full((3,), 0.5)}
+        s1, s2 = tx_plain.init(params), tx_sched.init(params)
+        u1, _ = tx_plain.update(g, s1, params)
+        u2, _ = tx_sched.update(g, s2, params)
+        assert np.allclose(np.asarray(u1["w"]), np.asarray(u2["w"]))
+
+    def test_config_roundtrip_with_schedule(self):
+        from alphafold2_tpu.config import Experiment
+
+        exp = Experiment()
+        exp.train.warmup_steps = 100
+        exp.train.decay_steps = 1000
+        back = Experiment.from_json(exp.to_json())
+        assert back.train.warmup_steps == 100
+        model, tx, mesh = back.build()
+        assert tx is not None
