@@ -162,11 +162,15 @@ def tp_param_specs(params, mesh: Mesh, axis: str = PAIR_J_AXIS):
     """
     n = mesh.shape[axis]
 
+    # The FF entries are anchored to the FeedForward module scope
+    # ("ff/", "msa_ff/" — primitives.py FeedForward's flax auto-named
+    # Dense_0/Dense_1) so unrelated Dense_0/Dense_1 elsewhere in the tree
+    # (head MLPs, structure module) stay replicated by intent, not luck.
     COL = ("to_q/kernel", "to_kv/kernel", "gating/kernel",
-           "left_proj/kernel", "right_proj/kernel", "Dense_0/kernel")
-    ROW = ("to_out/kernel", "proj_out/kernel", "Dense_1/kernel")
+           "left_proj/kernel", "right_proj/kernel", "ff/Dense_0/kernel")
+    ROW = ("to_out/kernel", "proj_out/kernel", "ff/Dense_1/kernel")
     COL_BIAS = ("gating/bias", "left_proj/bias", "right_proj/bias",
-                "Dense_0/bias")
+                "ff/Dense_0/bias")
 
     def spec_for(path, leaf):
         name = "/".join(str(getattr(k, "key", k)) for k in path)
@@ -181,7 +185,18 @@ def tp_param_specs(params, mesh: Mesh, axis: str = PAIR_J_AXIS):
                 return P(*([None] * (len(shape) - 1) + [axis]))
         return P()
 
-    return jax.tree_util.tree_map_with_path(spec_for, params)
+    specs = jax.tree_util.tree_map_with_path(spec_for, params)
+    if n > 1:
+        matched = sum(s != P() for s in jax.tree.leaves(
+            specs, is_leaf=lambda x: isinstance(x, P)))
+        if matched == 0:
+            import warnings
+            warnings.warn(
+                "tp_param_specs matched no parameters — the suffix table "
+                "no longer lines up with the model's module names, so "
+                "tensor parallelism silently degrades to replication",
+                stacklevel=2)
+    return specs
 
 
 def shard_pytree_tp(params, mesh: Mesh, axis: str = PAIR_J_AXIS):

@@ -41,13 +41,21 @@ def adam(
     over `decay_steps` (the AF2-style schedule). Both default off, so
     the reference configuration is the default behavior.
     """
-    if warmup_steps > 0 or decay_steps is not None:
+    if decay_steps is not None:
         lr = optax.warmup_cosine_decay_schedule(
             init_value=0.0 if warmup_steps > 0 else learning_rate,
             peak_value=learning_rate,
             warmup_steps=warmup_steps,
-            decay_steps=max(decay_steps or warmup_steps, warmup_steps + 1),
+            decay_steps=max(decay_steps, warmup_steps + 1),
             end_value=end_lr_ratio * learning_rate)
+    elif warmup_steps > 0:
+        # warmup alone: ramp to peak, then HOLD peak (no decay). The
+        # obvious warmup_cosine_decay_schedule(decay_steps=warmup_steps+1)
+        # spelling silently decays to end_lr one step after warmup.
+        lr = optax.join_schedules(
+            [optax.linear_schedule(0.0, learning_rate, warmup_steps),
+             optax.constant_schedule(learning_rate)],
+            boundaries=[warmup_steps])
     else:
         lr = learning_rate
     parts = []

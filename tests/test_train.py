@@ -352,6 +352,22 @@ class TestSchedule:
         assert sizes[0] < 1e-4
         assert sizes[-1] > sizes[0]
 
+    def test_warmup_only_holds_peak(self):
+        """warmup_steps without decay_steps must HOLD peak LR after the
+        ramp — the naive warmup_cosine spelling silently decayed 10x one
+        step after warmup (round-2 ADVICE, medium)."""
+        tx = adam(1e-2, warmup_steps=5, decay_steps=None)
+        params = {"w": jnp.ones((4,))}
+        opt_state = tx.init(params)
+        grads = {"w": jnp.ones((4,))}
+        sizes = []
+        for _ in range(60):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            sizes.append(float(jnp.abs(updates["w"]).max()))
+        # post-warmup updates stay peak-sized for the rest of training
+        assert sizes[-1] > 0.5 * max(sizes), (sizes[-1], max(sizes))
+        assert sizes[0] < 1e-4  # and warmup still ramps from ~0
+
     def test_default_matches_reference_constant_lr(self):
         tx_plain = adam(1e-3)
         tx_sched = adam(1e-3, warmup_steps=0, decay_steps=None)
