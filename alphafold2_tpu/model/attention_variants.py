@@ -177,23 +177,45 @@ class KroneckerAttention(nn.Module):
             x, mask=mask, context=pooled, context_mask=token_mask)
 
 
+def block_sparse_block_pattern(n_blocks: int, num_global: int = 1,
+                               window: int = 1):
+    """(n_blocks, n_blocks) bool numpy block pattern: attend within
+    +-`window` blocks of the diagonal plus the first `num_global` blocks
+    (global tokens). The single source of the local+global semantics —
+    both the dense mask below and the Pallas kernel plan derive from it,
+    so the two backends cannot diverge."""
+    import numpy as np
+    bi = np.arange(n_blocks)
+    local = np.abs(bi[:, None] - bi[None, :]) <= window
+    glob = (bi < num_global)[:, None] | (bi < num_global)[None, :]
+    return local | glob
+
+
 def block_sparse_mask(n: int, block: int = 32, num_global: int = 1,
                       window: int = 1) -> jnp.ndarray:
-    """(n, n) bool mask: attend within +-`window` blocks of the diagonal
-    plus the first `num_global` blocks (global tokens)."""
+    """(n, n) bool token mask expanded from `block_sparse_block_pattern`
+    (handles a trailing partial block when n % block != 0)."""
+    nb = -(-n // block)
+    bp = jnp.asarray(block_sparse_block_pattern(nb, num_global, window))
     bi = jnp.arange(n) // block
-    local = jnp.abs(bi[:, None] - bi[None, :]) <= window
-    global_rows = (bi < num_global)[:, None] | (bi < num_global)[None, :]
-    return local | global_rows
+    return bp[bi[:, None], bi[None, :]]
 
 
 class BlockSparseAttention(nn.Module):
     """Self-attention restricted to a fixed block-sparse pattern (the
-    DeepSpeed sparse-attention analog). Dense compute + additive mask —
-    correct semantics at any size. The true block-skipping TPU path is
-    `ops.block_sparse.block_sparse_attention` (splash-style Pallas
-    kernel, FLOPs ∝ nnz blocks; exactness-tested against this module's
-    semantics in tests/test_ops.py::TestBlockSparseKernel)."""
+    DeepSpeed sparse-attention analog, reference README.md:388-417).
+
+    Two compute backends behind ONE params tree (the projections and
+    gated output tail live in the inner `Attention`, shared by both):
+
+    - dense + additive mask (default): correct at any size/mask;
+    - the true block-skipping Pallas kernel
+      (`ops.block_sparse.block_sparse_attention`, FLOPs ∝ nnz blocks)
+      when `ops.use_pallas_attention(True)` is on and the shape allows
+      (n divisible by `block`, no token mask — the kernel skips whole
+      blocks and has no in-block mask support). Exactness between the
+      backends: tests/test_ops.py::TestBlockSparseKernel.
+    """
 
     dim: int
     heads: int = 8
@@ -206,10 +228,29 @@ class BlockSparseAttention(nn.Module):
     @nn.compact
     def __call__(self, x, mask=None):
         from alphafold2_tpu.model.primitives import Attention
+        from alphafold2_tpu.ops.attention import pallas_attention_enabled
         n = x.shape[-2]
+        attn = Attention(dim=self.dim, heads=self.heads,
+                         dim_head=self.dim_head, dtype=self.dtype,
+                         name="attn")
+
+        if (pallas_attention_enabled() and mask is None
+                and n % self.block == 0):
+            from alphafold2_tpu.ops.block_sparse import (
+                block_sparse_attention)
+            block_pattern = block_sparse_block_pattern(
+                n // self.block, self.num_global, self.window)
+            q, k, v = attn.project_qkv(x)          # (b, h, n, dh), q scaled
+            b, h, _, dh = q.shape
+            out = block_sparse_attention(
+                q.reshape(b * h, n, dh), k.reshape(b * h, n, dh),
+                v.reshape(b * h, n, dh), block_pattern,
+                scale=1.0,                         # project_qkv pre-scales
+                block=self.block,
+                interpret=jax.default_backend() == "cpu")
+            return attn.finish(out.reshape(b, h, n, dh), x)
+
         pattern = block_sparse_mask(n, self.block, self.num_global,
                                     self.window)
         bias = jnp.where(pattern, 0.0, MASK_VALUE)[None, None]
-        return Attention(dim=self.dim, heads=self.heads,
-                         dim_head=self.dim_head, dtype=self.dtype,
-                         name="attn")(x, mask=mask, attn_bias=bias)
+        return attn(x, mask=mask, attn_bias=bias)

@@ -61,7 +61,7 @@ def plan_block_pattern(pattern: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def _kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
-            acc_ref, m_ref, l_ref, *, t_total):
+            acc_ref, m_ref, l_ref, *, t_total, scale):
     qb = pl.program_id(1)
     t = pl.program_id(2)
 
@@ -73,7 +73,7 @@ def _kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(valid_ref[qb, t] == 1)
     def _step():
-        q = q_ref[0].astype(jnp.float32)          # (bq, d)
+        q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
         k = k_ref[0].astype(jnp.float32)          # (bk, d)
         v = v_ref[0].astype(jnp.float32)          # (bk, d)
         logits = jax.lax.dot_general(
@@ -96,15 +96,30 @@ def _kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def block_sparse_attention(
-    q: jnp.ndarray,                # (B, N, D), pre-scaled
+    q: jnp.ndarray,                # (B, N, D)
     k: jnp.ndarray,                # (B, N, D)
     v: jnp.ndarray,                # (B, N, D)
     pattern: np.ndarray,           # (nqb, nkb) bool, STATIC
     *,
+    scale: float | None = None,
     block: int = 128,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Attention restricted to `pattern` with true block skipping."""
+    """Attention restricted to `pattern` with true block skipping.
+
+    `scale` multiplies q inside the kernel; default 1/sqrt(D) (the
+    standard softmax temperature). Pass scale=1.0 for pre-scaled q —
+    e.g. when fed from Attention.project_qkv, which scales at projection
+    time. Token masks are NOT supported here; the model-level wrapper
+    (attention_variants.BlockSparseAttention) falls back to the dense
+    path when a mask is present.
+
+    The Mosaic compile path (PrefetchScalarGridSpec + scalar-prefetch
+    index maps) is exactness-tested in interpreter mode
+    (tests/test_ops.py); on-chip timing vs the XLA dense path is
+    `python tools/bench_blocksparse.py` (see STATUS.md for the current
+    keep-or-kill state).
+    """
     if not HAS_PALLAS:
         raise RuntimeError("block_sparse_attention needs jax.experimental"
                            ".pallas, which failed to import in this build")
@@ -114,6 +129,8 @@ def block_sparse_attention(
     assert pattern.shape == (nqb, nqb), (pattern.shape, nqb)
     cols, valid = plan_block_pattern(pattern)
     t_total = cols.shape[1]
+    if scale is None:
+        scale = float(d) ** -0.5
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -136,7 +153,7 @@ def block_sparse_attention(
             pltpu.VMEM((block, 1), jnp.float32),   # denominator
         ],
     )
-    kernel = functools.partial(_kernel, t_total=t_total)
+    kernel = functools.partial(_kernel, t_total=t_total, scale=scale)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,

@@ -1,0 +1,120 @@
+"""Block-sparse kernel microbenchmark: Pallas block-skipping attention
+(ops/block_sparse.py) vs the XLA dense+mask path, matched shapes/pattern.
+
+SURVEY §7.7 keep-or-kill rule: a kernel must beat the XLA baseline on
+hardware to be kept. This prints one JSON line per config:
+
+  {"n": N, "block": B, "live_frac": f, "dense_ms": X, "sparse_ms": Y,
+   "speedup": X/Y, "platform": ...}
+
+Run on the TPU (`python tools/bench_blocksparse.py` from /root/repo with
+the ambient axon platform). On CPU the Mosaic path cannot lower —
+the script emits a labeled skip line instead of timing interpret mode
+(which benchmarks nothing real).
+
+Shapes mirror the Evoformer axial-attention layout after head folding
+(B = batch*heads, N = crop length, D = head dim). Block sparsity pays
+off at long N (ring/long-context regime): at N=1024, window=1,
+num_global=1 the live fraction is ~0.3; at N=2048 ~0.16.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_DONE = threading.Event()
+
+
+def _watchdog(seconds: float):
+    def waiter():
+        if not _DONE.wait(seconds):
+            print(json.dumps({"error": f"bench_blocksparse timed out "
+                              f"after {seconds:.0f}s"}), flush=True)
+            os._exit(2)
+    threading.Thread(target=waiter, daemon=True).start()
+
+
+def main():
+    _watchdog(float(os.environ.get("BENCH_TIMEOUT_S", 900)))
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _enable_compile_cache
+    _enable_compile_cache()
+
+    platform = jax.default_backend()
+    on_tpu = platform == "axon" or "tpu" in platform
+    if not on_tpu:
+        print(json.dumps({
+            "skipped": True, "platform": platform,
+            "reason": "Mosaic lowering needs a TPU; interpret-mode timing "
+                      "is not evidence (exactness is covered by "
+                      "tests/test_ops.py)"}), flush=True)
+        _DONE.set()
+        return
+
+    from alphafold2_tpu.model.attention_variants import (
+        block_sparse_block_pattern)
+    from alphafold2_tpu.ops.attention import MASK_VALUE
+    from alphafold2_tpu.ops.block_sparse import block_sparse_attention
+
+    B, D = int(os.environ.get("BSB_BATCH", 8)), 64
+    block = int(os.environ.get("BSB_BLOCK", 128))
+    iters = int(os.environ.get("BSB_ITERS", 20))
+
+    for n in (512, 1024, 2048):
+        nb = n // block
+        pattern = block_sparse_block_pattern(nb, num_global=1, window=1)
+        live_frac = float(pattern.mean())
+        rng = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(rng, 3)
+        q = jax.random.normal(kq, (B, n, D), jnp.bfloat16)
+        k = jax.random.normal(kk, (B, n, D), jnp.bfloat16)
+        v = jax.random.normal(kv, (B, n, D), jnp.bfloat16)
+
+        import numpy as np
+        tok = np.repeat(np.repeat(pattern, block, 0), block, 1)
+        bias = jnp.where(jnp.asarray(tok), 0.0, MASK_VALUE)[None]
+        bias = jnp.broadcast_to(bias, (B, n, n)).astype(jnp.float32)
+
+        @jax.jit
+        def dense(q, k, v, bias):
+            logits = jnp.einsum("bnd,bmd->bnm", q.astype(jnp.float32),
+                                k.astype(jnp.float32)) * (D ** -0.5)
+            attn = jax.nn.softmax(logits + bias, axis=-1)
+            return jnp.einsum("bnm,bmd->bnd", attn,
+                              v.astype(jnp.float32)).astype(q.dtype)
+
+        sparse = jax.jit(functools.partial(
+            block_sparse_attention, block=block))
+
+        def timeit(fn, *args):
+            out = fn(*args)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / iters * 1e3
+
+        dense_ms = timeit(dense, q, k, v, bias)
+        sparse_ms = timeit(sparse, q, k, v, pattern)
+        print(json.dumps({
+            "n": n, "block": block, "batch": B, "dim_head": D,
+            "live_frac": round(live_frac, 3),
+            "dense_ms": round(dense_ms, 3),
+            "sparse_ms": round(sparse_ms, 3),
+            "speedup": round(dense_ms / sparse_ms, 3),
+            "platform": platform}), flush=True)
+    _DONE.set()
+
+
+if __name__ == "__main__":
+    main()
