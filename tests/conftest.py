@@ -32,3 +32,13 @@ jax.config.update("jax_default_matmul_precision", "float32")
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_af2tpu")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def perturb_params(params, key, scale=0.05):
+    """Add noise to every leaf — moves zero-init output projections off
+    zero so backend/path-parity comparisons are not trivially 0 == 0."""
+    leaves, treedef = jax.tree.flatten(params)
+    keys = jax.random.split(key, len(leaves))
+    return treedef.unflatten(
+        [l + scale * jax.random.normal(k, l.shape, l.dtype)
+         for l, k in zip(leaves, keys)])

@@ -198,14 +198,9 @@ class TestBlockSparseKernel:
         x = jax.random.normal(rng, (b, n, dim), jnp.float32)
         mod = BlockSparseAttention(dim=dim, heads=2, dim_head=8, block=8,
                                    num_global=1, window=1)
-        params = mod.init(jax.random.PRNGKey(12), x)
-        # perturb away from the zero-init output projection so the
-        # comparison is not trivially 0 == 0
-        leaves, treedef = jax.tree.flatten(params)
-        keys = jax.random.split(jax.random.PRNGKey(13), len(leaves))
-        params = treedef.unflatten(
-            [l + 0.05 * jax.random.normal(kk, l.shape, l.dtype)
-             for l, kk in zip(leaves, keys)])
+        from conftest import perturb_params
+        params = perturb_params(mod.init(jax.random.PRNGKey(12), x),
+                                jax.random.PRNGKey(13))
         out_dense = mod.apply(params, x)
         assert float(np.abs(np.asarray(out_dense)).max()) > 0
         with pallas_attention(True):

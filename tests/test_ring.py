@@ -129,8 +129,11 @@ class TestRingInTrunk:
         valid = np.asarray(pmask)[..., None]
         assert np.allclose(np.asarray(xr) * valid, np.asarray(xd) * valid,
                            atol=2e-5)
-        # the MSA track is untouched by the ring switch
-        assert np.allclose(np.asarray(mr), np.asarray(md), atol=2e-5)
+        # the MSA row attention is ALSO ring-parallel now (round-2
+        # VERDICT next-round #5) — match at valid MSA positions
+        mvalid = np.asarray(msa_mask)[..., None]
+        assert np.allclose(np.asarray(mr) * mvalid,
+                           np.asarray(md) * mvalid, atol=2e-5)
 
     def test_evoformer_block_ring_grads_match_dense(self):
         from alphafold2_tpu.parallel import make_mesh, use_mesh
@@ -185,6 +188,91 @@ class TestRingInTrunk:
         valid = np.asarray(pmask)[..., None]
         assert np.allclose(np.asarray(xr) * valid, np.asarray(xd) * valid,
                            atol=5e-5)
+
+
+class TestMsaRowRing:
+    """AxialAttention with ring_axes=(None, 'i'): the MSA row attention
+    layout — alignment rows local, the residue axis ring-sharded — with
+    per-alignment (non-separable) masks honored exactly."""
+
+    def test_matches_dense_with_per_row_mask(self):
+        from alphafold2_tpu.model.primitives import AxialAttention
+        from alphafold2_tpu.parallel import make_mesh, use_mesh
+
+        b, m, n, dim = 2, 3, 16, 32
+        ks = jax.random.split(jax.random.PRNGKey(40), 3)
+        x = jax.random.normal(ks[0], (b, m, n, dim)) * 0.5
+        # per-alignment gaps: a genuinely different mask in every row
+        mask = jax.random.bernoulli(ks[1], 0.7, (b, m, n))
+        mask = mask.at[..., :2].set(True)
+
+        dense = AxialAttention(dim=dim, heads=2, dim_head=16,
+                               row_attn=True, col_attn=False)
+        ring = AxialAttention(dim=dim, heads=2, dim_head=16,
+                              row_attn=True, col_attn=False,
+                              ring_axes=(None, "i"))
+        from conftest import perturb_params
+        params = perturb_params(dense.init(ks[2], x, mask=mask),
+                                jax.random.PRNGKey(41))
+
+        out_dense = dense.apply(params, x, mask=mask)
+        mesh = make_mesh(2, 2, 2)
+        with use_mesh(mesh):
+            out_ring = jax.jit(
+                lambda p: ring.apply(p, x, mask=mask))(params)
+
+        valid = np.asarray(mask)[..., None]
+        assert float(np.abs(np.asarray(out_dense)).max()) > 0
+        assert np.allclose(np.asarray(out_ring) * valid,
+                           np.asarray(out_dense) * valid, atol=2e-5)
+
+
+class TestReversibleRing:
+    """reversible=True + ring_attention=True (the round-2 assert is
+    lifted): forward and parameter gradients match the off-mesh
+    reversible trunk at valid positions."""
+
+    def _inputs(self, key, b=2, n=16, m=3, d=32):
+        ks = jax.random.split(key, 2)
+        x = jax.random.normal(ks[0], (b, n, n, d)) * 0.5
+        msa = jax.random.normal(ks[1], (b, m, n, d)) * 0.5
+        seq_mask = jnp.ones((b, n), dtype=bool).at[:, -4:].set(False)
+        pmask = seq_mask[:, :, None] & seq_mask[:, None, :]
+        msa_mask = jnp.ones((b, m, n), dtype=bool) & seq_mask[:, None, :]
+        return x, msa, pmask, msa_mask
+
+    def test_forward_and_grads_match_off_mesh(self):
+        from alphafold2_tpu.model.evoformer import Evoformer
+        from alphafold2_tpu.parallel import make_mesh, use_mesh
+
+        x, msa, pmask, msa_mask = self._inputs(jax.random.PRNGKey(50))
+        kw = dict(dim=32, depth=2, heads=2, dim_head=16, reversible=True)
+        plain = Evoformer(**kw, ring_attention=False)
+        ring = Evoformer(**kw, ring_attention=True)
+        params = plain.init(jax.random.PRNGKey(51), x, msa,
+                            mask=pmask, msa_mask=msa_mask)
+
+        def masked_loss(model):
+            def loss(p):
+                xo, mo = model.apply(p, x, msa, mask=pmask,
+                                     msa_mask=msa_mask)
+                return ((xo * pmask[..., None]) ** 2).sum() + \
+                    ((mo * msa_mask[..., None]) ** 2).sum()
+            return loss
+
+        l_plain, g_plain = jax.value_and_grad(masked_loss(plain))(params)
+        mesh = make_mesh(2, 2, 2)
+        with use_mesh(mesh):
+            l_ring, g_ring = jax.jit(
+                jax.value_and_grad(masked_loss(ring)))(params)
+
+        assert np.allclose(float(l_plain), float(l_ring), rtol=1e-5)
+        flat_p, _ = jax.tree_util.tree_flatten(g_plain)
+        flat_r, _ = jax.tree_util.tree_flatten(g_ring)
+        for a, b_ in zip(flat_r, flat_p):
+            assert np.allclose(np.asarray(a), np.asarray(b_),
+                               rtol=1e-5, atol=1e-3), \
+                float(jnp.abs(a - b_).max())
 
 
 class TestRotary:
@@ -249,17 +337,81 @@ class TestPairRowRing:
         k = jax.random.normal(ks[1], (b, h, I, J, d)) * 0.5
         v = jax.random.normal(ks[2], (b, h, I, J, d))
         bias = jax.random.normal(ks[3], (b, h, J, J))
-        mask = jnp.ones((b, J), dtype=bool).at[:, 6:].set(False)
+        col = jnp.ones((b, J), dtype=bool).at[:, 6:].set(False)
+        mask = jnp.broadcast_to(col[:, None, :], (b, I, J))
 
         mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("i", "j"))
         out = pair_row_attention_sharded(q, k, v, bias, mesh, mask=mask)
 
         logits = jnp.einsum("bhiqd,bhikd->bhiqk", q, k) + bias[:, :, None]
-        logits = jnp.where(mask[:, None, None, None, :], logits, -1e9)
+        logits = jnp.where(mask[:, None, :, None, :], logits, -1e9)
         ref = jnp.einsum("bhiqk,bhikd->bhiqd",
                          jax.nn.softmax(logits, -1), v)
         assert np.allclose(np.asarray(out)[:, :, :, :6],
                            np.asarray(ref)[:, :, :, :6], atol=1e-5)
+
+    def test_with_nonseparable_mask(self):
+        """Per-row key masks that are NOT an outer product of axis
+        vectors are honored exactly (round-2 VERDICT weak #5)."""
+        from alphafold2_tpu.parallel.ring import pair_row_attention_sharded
+        b, h, I, J, d = 1, 2, 4, 8, 8
+        ks = jax.random.split(jax.random.PRNGKey(33), 4)
+        q = jax.random.normal(ks[0], (b, h, I, J, d)) * 0.5
+        k = jax.random.normal(ks[1], (b, h, I, J, d)) * 0.5
+        v = jax.random.normal(ks[2], (b, h, I, J, d))
+        # random per-(row, key) mask; keys 0-1 always valid so every
+        # query row has something to attend to
+        mask = jax.random.bernoulli(ks[3], 0.6, (b, I, J))
+        mask = mask.at[..., :2].set(True)
+        assert not bool(jnp.array_equal(  # actually non-separable
+            mask, mask.any(1, keepdims=True) & mask.any(2, keepdims=True)))
+
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("i", "j"))
+        out = pair_row_attention_sharded(q, k, v, None, mesh, mask=mask)
+
+        logits = jnp.einsum("bhiqd,bhikd->bhiqk", q, k)
+        logits = jnp.where(mask[:, None, :, None, :], logits, -1e9)
+        ref = jnp.einsum("bhiqk,bhikd->bhiqd",
+                         jax.nn.softmax(logits, -1), v)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_batch_one_on_data_mesh(self):
+        """batch=1 on a data=2 training mesh: the data axis cannot divide
+        the batch, so it must quietly fall back to replication rather
+        than raise at trace time."""
+        from alphafold2_tpu.parallel import make_mesh
+        from alphafold2_tpu.parallel.ring import pair_row_attention_sharded
+        b, h, I, J, d = 1, 2, 4, 8, 8
+        ks = jax.random.split(jax.random.PRNGKey(35), 3)
+        q = jax.random.normal(ks[0], (b, h, I, J, d)) * 0.5
+        k = jax.random.normal(ks[1], (b, h, I, J, d)) * 0.5
+        v = jax.random.normal(ks[2], (b, h, I, J, d))
+
+        mesh = make_mesh(2, 2, 2)
+        out = pair_row_attention_sharded(q, k, v, None, mesh)
+
+        logits = jnp.einsum("bhiqd,bhikd->bhiqk", q, k)
+        ref = jnp.einsum("bhiqk,bhikd->bhiqd",
+                         jax.nn.softmax(logits, -1), v)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_unsharded_row_axis(self):
+        """i_axis=None: rows local (the MSA layout), keys ring over j."""
+        from alphafold2_tpu.parallel.ring import pair_row_attention_sharded
+        b, h, M, J, d = 1, 2, 3, 16, 8
+        ks = jax.random.split(jax.random.PRNGKey(34), 3)
+        q = jax.random.normal(ks[0], (b, h, M, J, d)) * 0.5
+        k = jax.random.normal(ks[1], (b, h, M, J, d)) * 0.5
+        v = jax.random.normal(ks[2], (b, h, M, J, d))
+
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("i", "j"))
+        out = pair_row_attention_sharded(q, k, v, None, mesh,
+                                         i_axis=None, j_axis="j")
+
+        logits = jnp.einsum("bhiqd,bhikd->bhiqk", q, k)
+        ref = jnp.einsum("bhiqk,bhikd->bhiqd",
+                         jax.nn.softmax(logits, -1), v)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
     def test_gradients_match_dense(self):
         from alphafold2_tpu.parallel.ring import pair_row_attention_sharded

@@ -92,8 +92,10 @@ def main():
             return jnp.einsum("bnm,bmd->bnd", attn,
                               v.astype(jnp.float32)).astype(q.dtype)
 
+        # pattern is STATIC (host-side plan); close it into the jitted fn
+        # rather than passing it as a (traced) argument
         sparse = jax.jit(functools.partial(
-            block_sparse_attention, block=block))
+            block_sparse_attention, pattern=pattern, block=block))
 
         def timeit(fn, *args):
             out = fn(*args)
@@ -105,7 +107,7 @@ def main():
             return (time.perf_counter() - t0) / iters * 1e3
 
         dense_ms = timeit(dense, q, k, v, bias)
-        sparse_ms = timeit(sparse, q, k, v, pattern)
+        sparse_ms = timeit(sparse, q, k, v)
         print(json.dumps({
             "n": n, "block": block, "batch": B, "dim_head": D,
             "live_frac": round(live_frac, 3),
