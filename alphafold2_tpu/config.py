@@ -33,6 +33,8 @@ class ModelConfig:
     structure_module_refinement_iters: int = 0
     reversible: bool = False
     ring_attention: bool = False
+    pipeline_stages: int = 1          # GPipe trunk stages (mesh pipe axis)
+    pipeline_microbatches: int = 0
     extra_msa_evoformer_layers: int = 4
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0
@@ -56,15 +58,16 @@ class DataConfig:
 
 @dataclass
 class MeshConfig:
+    pipe: int = 1
     data: int = 1
     i: int = 1
     j: int = 1
 
     def build(self):
         from alphafold2_tpu.parallel import make_mesh
-        if self.data * self.i * self.j == 1:
+        if self.pipe * self.data * self.i * self.j == 1:
             return None
-        return make_mesh(self.data, self.i, self.j)
+        return make_mesh(self.data, self.i, self.j, pipe=self.pipe)
 
 
 @dataclass

@@ -4,6 +4,7 @@ from alphafold2_tpu.data import (  # noqa: F401
     native,
     pdb_io,
     scn,
+    sidechainnet,
     trrosetta,
 )
 from alphafold2_tpu.data.featurize import (  # noqa: F401
@@ -22,5 +23,11 @@ from alphafold2_tpu.data.scn import (  # noqa: F401
     scn_atom_embedd,
     scn_backbone_mask,
     scn_cloud_mask,
+)
+from alphafold2_tpu.data.sidechainnet import (  # noqa: F401
+    SidechainnetDataModule,
+    SidechainnetDataset,
+    corpus_from_pdb,
+    load_scn_pickle,
 )
 from alphafold2_tpu.data.synthetic import pad_to, synthetic_batch  # noqa: F401

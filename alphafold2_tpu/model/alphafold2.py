@@ -107,6 +107,12 @@ class Alphafold2(nn.Module):
     # (parallel/ring.py): exact long-context mode, active only when the
     # mesh actually shards the pair axes; no-op otherwise
     ring_attention: bool = False
+    # GPipe pipeline parallelism for the main trunk over the mesh's
+    # `pipe` axis (Evoformer.pipeline_stages; parallel/pipeline.py).
+    # The small extra-MSA stack stays scanned — only the deep trunk is
+    # worth staging.
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 0
     # reproduce the reference's masked-OuterMean double division
     # (alphafold2.py:347 + the always-synthesized msa_mask at :703);
     # required for exact parity with reference-trained checkpoints
@@ -358,7 +364,9 @@ class Alphafold2(nn.Module):
             ring_attention=self.ring_attention,
             outer_mean_reference_scale=self.outer_mean_reference_scale,
             dtype=self.dtype,
-            reversible=self.reversible, use_scan=self.use_scan, name="net",
+            reversible=self.reversible, use_scan=self.use_scan,
+            pipeline_stages=self.pipeline_stages,
+            pipeline_microbatches=self.pipeline_microbatches, name="net",
         )(x, m, mask=x_mask, msa_mask=msa_mask, deterministic=deterministic)
 
         # --- init-time coverage of conditional branches -------------------

@@ -178,10 +178,120 @@ class Evoformer(nn.Module):
     # O(1)-activation reversible trunk (model/reversible.py; reference
     # README.md:40 `reversible=True`, reversible.py)
     reversible: bool = False
+    # GPipe pipeline parallelism over the mesh's `pipe` axis
+    # (parallel/pipeline.py): the depth-stacked scan params are regrouped
+    # into S stages of depth/S layers and the trunk runs the static skew
+    # schedule, microbatching the batch axis. Params are IDENTICAL to the
+    # scanned trunk (the pp path re-reads the scan's stacked params), so
+    # checkpoints move freely between pp and non-pp runs.
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 0   # 0 -> one microbatch per batch row
+
+    def _pipeline_ready(self, deterministic):
+        """The active mesh if the pipeline path applies, else None."""
+        from alphafold2_tpu.parallel.sharding import active_mesh
+        from alphafold2_tpu.parallel.mesh import PIPE_AXIS
+
+        if self.pipeline_stages <= 1:
+            return None
+        mesh = active_mesh()
+        if mesh is None or PIPE_AXIS not in mesh.axis_names:
+            return None
+        if mesh.shape[PIPE_AXIS] != self.pipeline_stages:
+            raise ValueError(
+                f"pipeline_stages={self.pipeline_stages} but mesh "
+                f"'{PIPE_AXIS}' axis has {mesh.shape[PIPE_AXIS]} devices")
+        if self.depth % self.pipeline_stages:
+            raise ValueError(
+                f"depth {self.depth} not divisible into "
+                f"{self.pipeline_stages} pipeline stages")
+        assert (self.attn_dropout == 0.0 and self.ff_dropout == 0.0) or \
+            deterministic, "pipeline trunk does not support dropout"
+        return mesh
+
+    def _pipeline_forward(self, mesh, block_kwargs, x, m, mask, msa_mask):
+        """GPipe over the scan-stacked layer params (parallel/pipeline.py).
+
+        Stage s applies layers [s*depth/S, (s+1)*depth/S) — a lax.scan
+        over its (depth/S, ...) param slice with per-block remat, the same
+        compute as the nn.scan path. Activations (x, m) plus the masks
+        ride the pipeline as one microbatched tree; masks pass through
+        stages unchanged. The in-model GSPMD constraints (shard_pair/
+        shard_msa) are disabled inside the shard_map body — within a
+        stage the spatial axes are whole; pp composes with dp (the
+        microbatch batch dim shards over the data axis), not with the
+        2-D pair sharding.
+        """
+        import jax
+
+        from alphafold2_tpu.parallel.mesh import DATA_AXIS
+        from alphafold2_tpu.parallel.pipeline import (microbatch,
+                                                      pipeline_apply,
+                                                      unmicrobatch)
+        from alphafold2_tpu.parallel.sharding import use_mesh
+
+        s_count = self.pipeline_stages
+        depth_per = self.depth // s_count
+        b, n = x.shape[0], x.shape[1]
+        if self.pipeline_microbatches:
+            m_count = self.pipeline_microbatches
+        else:
+            # default: the most microbatches whose per-microbatch batch
+            # dim still tiles over the data axis — pp x dp stays real
+            # (m_count=b would leave batch-1 microbatches that cannot
+            # shard, silently replicating across the data devices)
+            data_n = mesh.shape.get(DATA_AXIS, 1)
+            m_count = b // data_n if (data_n > 1 and b % data_n == 0) \
+                else b
+        if b % m_count:
+            raise ValueError(f"batch {b} not divisible into {m_count} "
+                             "microbatches")
+
+        params = self.get_variable("params", "layers")
+        stacked = jax.tree.map(
+            lambda p: p.reshape(s_count, depth_per, *p.shape[1:]), params)
+
+        block = nn.remat(EvoformerBlock, static_argnums=(5,),
+                         prevent_cse=False)(**block_kwargs, parent=None)
+
+        def stage_fn(stage_params, act):
+            xi, mi, pmask, mmask = act
+            bmask, bmsa = pmask > 0.5, mmask > 0.5
+
+            def body(carry, p):
+                xi, mi = carry
+                with use_mesh(None):   # constraints are no-ops in-stage
+                    xi, mi = block.apply({"params": p["block"]}, xi, mi,
+                                         bmask, bmsa, True)
+                return (xi, mi), None
+
+            (xi, mi), _ = jax.lax.scan(body, (xi, mi), stage_params)
+            return (xi, mi, pmask, mmask)
+
+        # masks ride as float tensors (one activation tree, one dtype
+        # rule per leaf); materialized when absent so the tree is static
+        pmask = jnp.ones((b, n, n), jnp.float32) if mask is None else \
+            mask.astype(jnp.float32)
+        mmask = jnp.ones(m.shape[:3], jnp.float32) if msa_mask is None \
+            else msa_mask.astype(jnp.float32)
+        xs = jax.tree.map(lambda t: microbatch(t, m_count),
+                          (x, m, pmask, mmask))
+        out = pipeline_apply(stage_fn, stacked, xs, mesh,
+                             data_axis=DATA_AXIS)
+        x, m = unmicrobatch(out[0]), unmicrobatch(out[1])
+        return x, m
 
     @nn.compact
     def __call__(self, x, m, mask=None, msa_mask=None,
                  deterministic: bool = True):
+        # refuse-rather-than-silently-drop: pp regroups the scan-stacked
+        # params, so it needs the scanned trunk (and depth to stage over)
+        if self.pipeline_stages > 1:
+            assert not self.reversible, \
+                "pipeline_stages>1 is not supported with the reversible " \
+                "trunk (pp regroups the scan-stacked params)"
+            assert self.use_scan and self.depth > 1, \
+                "pipeline_stages>1 requires use_scan=True and depth>1"
         if self.reversible:
             # the reversible trunk is deterministic by construction (exact
             # inverse reconstruction); refuse configs that expect dropout
@@ -230,6 +340,13 @@ class Evoformer(nn.Module):
                     x, m = block_cls(**block_kwargs, name="block")(
                         x, m, mask, msa_mask, deterministic)
                     return (x, m), None
+
+            pp = self._pipeline_ready(deterministic)
+            if pp is not None and not self.is_initializing():
+                # params were created by the scan path at init; regroup
+                # the (depth, ...) stack into pp stages and run GPipe
+                return self._pipeline_forward(
+                    pp, block_kwargs, x, m, mask, msa_mask)
 
             scan = nn.scan(
                 ScanBody,

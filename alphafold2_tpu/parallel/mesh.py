@@ -26,8 +26,9 @@ from jax.sharding import Mesh
 DATA_AXIS = "data"
 PAIR_I_AXIS = "i"
 PAIR_J_AXIS = "j"
+PIPE_AXIS = "pipe"
 
-AXIS_NAMES = (DATA_AXIS, PAIR_I_AXIS, PAIR_J_AXIS)
+AXIS_NAMES = (PIPE_AXIS, DATA_AXIS, PAIR_I_AXIS, PAIR_J_AXIS)
 
 
 def make_mesh(
@@ -35,18 +36,26 @@ def make_mesh(
     i: int = 1,
     j: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
+    *,
+    pipe: int = 1,
 ) -> Mesh:
-    """Build a (data, i, j) mesh over the given (or all) devices.
+    """Build a (pipe, data, i, j) mesh over the given (or all) devices.
 
-    On real hardware, prefer factorizations where `i` x `j` maps to an ICI
-    torus face so ring collectives over the sharded pair axes ride ICI.
+    `pipe` is the pipeline-parallel stage axis (parallel/pipeline.py);
+    size 1 (the default) makes it inert — every GSPMD spec addresses
+    axes by name, so existing (data, i, j) placements are unaffected.
+    On real hardware, prefer factorizations where `i` x `j` maps to an
+    ICI torus face so ring collectives over the sharded pair axes ride
+    ICI, and lay `pipe` along an ICI ring so stage hops are single-hop
+    neighbor exchanges.
     """
     devices = list(devices if devices is not None else jax.devices())
-    need = data * i * j
+    need = pipe * data * i * j
     if need != len(devices):
         raise ValueError(
-            f"mesh {data}x{i}x{j}={need} != #devices {len(devices)}")
-    arr = np.asarray(devices).reshape(data, i, j)
+            f"mesh {pipe}x{data}x{i}x{j}={need} != #devices "
+            f"{len(devices)}")
+    arr = np.asarray(devices).reshape(pipe, data, i, j)
     return Mesh(arr, AXIS_NAMES)
 
 

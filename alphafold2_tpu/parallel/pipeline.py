@@ -29,13 +29,13 @@ Helpers:
 
 from __future__ import annotations
 
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-PIPE_AXIS = "pipe"
+from alphafold2_tpu.parallel.mesh import PIPE_AXIS
 
 
 def make_pipeline_mesh(pipe: int, data: int = 1, devices=None) -> Mesh:
@@ -77,6 +77,7 @@ def pipeline_apply(
     mesh: Mesh,
     *,
     axis_name: str = PIPE_AXIS,
+    data_axis: Optional[str] = None,
 ) -> Any:
     """Run `stage_fn` as an S-stage pipeline over microbatched inputs.
 
@@ -86,15 +87,26 @@ def pipeline_apply(
       across stages (true for Evoformer blocks: (x, m) in -> (x, m) out).
     stacked_params: tree with leading stage axis S == mesh.shape[axis].
     xs: activation tree with leading microbatch axis M (every leaf
-      (M, ...)); replicated across the mesh.
-    Returns the output tree (M, ...), replicated.
+      (M, ...)).
+    data_axis: optional mesh axis to shard the per-microbatch batch dim
+      (leaf axis 1) over — composes pp x dp in one shard_map; without it
+      every pipe position computes the full microbatch. Falls back to
+      replication for leaves whose batch dim does not tile.
+    Returns the output tree (M, ...), sharded like the inputs.
     """
     s_count = mesh.shape[axis_name]
     m_count = jax.tree.leaves(xs)[0].shape[0]
     ticks = m_count + s_count - 1
 
     param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
-    x_specs = jax.tree.map(lambda _: P(), xs)
+
+    def x_spec(leaf):
+        if data_axis is not None and data_axis in mesh.axis_names and \
+                leaf.ndim >= 2 and leaf.shape[1] % mesh.shape[data_axis] == 0:
+            return P(None, data_axis)
+        return P()
+
+    x_specs = jax.tree.map(x_spec, xs)
 
     def spmd(params_local, xs):
         # shard_map hands each device its (1, ...) stage slice
@@ -140,5 +152,5 @@ def pipeline_apply(
 
     fn = jax.shard_map(spmd, mesh=mesh,
                        in_specs=(param_specs, x_specs),
-                       out_specs=jax.tree.map(lambda _: P(), xs))
+                       out_specs=x_specs)
     return fn(stacked_params, xs)

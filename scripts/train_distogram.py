@@ -2,12 +2,15 @@
 
 The reference's train_pre.py (sidechainnet loader + Adam loop,
 train_pre.py:37-96) as a config-driven jitted pipeline: synthetic batches
-by default, a trrosetta-style on-disk dataset when --data points at a
-directory of .a3m/.pdb pairs.
+by default; a trrosetta-style on-disk dataset when --data points at a
+directory of .a3m/.pdb pairs; a locally mounted sidechainnet pickle via
+--scn (the reference's actual corpus, scn.load at train_pre.py:37-43);
+or --pdb with one or more PDB files (real-structure demo without a
+mounted corpus — e.g. tests/data/1h22_head.pdb).
 
 Usage:
     python scripts/train_distogram.py [--config cfg.json] [--steps N]
-        [--data DIR] [--mesh data,i,j]
+        [--data DIR | --scn FILE.pkl | --pdb FILE...] [--mesh data,i,j]
 """
 
 from __future__ import annotations
@@ -32,7 +35,12 @@ def main(argv=None):
     ap.add_argument("--config", default=None)
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--data", default=None)
-    ap.add_argument("--mesh", default=None, help="data,i,j")
+    ap.add_argument("--scn", default=None,
+                    help="local sidechainnet pickle (train_pre.py corpus)")
+    ap.add_argument("--pdb", nargs="+", default=None,
+                    help="PDB file(s) as a real-structure demo corpus")
+    ap.add_argument("--mesh", default=None,
+                    help="data,i,j or pipe,data,i,j")
     ap.add_argument("--log", default=None, help="metrics JSONL path")
     args = ap.parse_args(argv)
 
@@ -47,12 +55,22 @@ def main(argv=None):
     if args.data is not None:
         exp.data.root = args.data
     if args.mesh is not None:
-        d, i, j = (int(v) for v in args.mesh.split(","))
-        exp.mesh.data, exp.mesh.i, exp.mesh.j = d, i, j
+        vals = [int(v) for v in args.mesh.split(",")]
+        if len(vals) == 3:
+            vals = [1] + vals   # full override: no pipe unless asked
+        exp.mesh.pipe, exp.mesh.data, exp.mesh.i, exp.mesh.j = vals
 
     model, tx, mesh = exp.build()
 
-    if exp.data.root:
+    if args.scn or args.pdb:
+        from alphafold2_tpu.data.sidechainnet import (SidechainnetDataModule,
+                                                      corpus_from_pdb)
+        source = args.scn if args.scn else corpus_from_pdb(args.pdb)
+        dm = SidechainnetDataModule(source, crop_len=exp.data.crop_len,
+                                    batch_size=exp.data.batch_size,
+                                    max_msa_rows=exp.data.msa_depth)
+        batches = dm.train_batches()
+    elif exp.data.root:
         from alphafold2_tpu.data.trrosetta import TrRosettaDataModule
         dm = TrRosettaDataModule(exp.data.root, crop_len=exp.data.crop_len,
                                  batch_size=exp.data.batch_size,

@@ -125,3 +125,102 @@ class TestEvoformerPipeline:
         for a, b_ in zip(flat_p, flat_s):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        atol=5e-4)
+
+
+class TestModelPipeline:
+    """Model-level pp (round-2 VERDICT next-round #7): the trunk's
+    pipeline_stages regroups the scan-stacked params into GPipe stages
+    under the mesh's pipe axis — same params tree, exactness vs the
+    scanned trunk, and a full-Alphafold2 train step."""
+
+    def _inputs(self, key, b=4, n=8, m=3, d=32):
+        ks = jax.random.split(key, 2)
+        x = jax.random.normal(ks[0], (b, n, n, d)) * 0.5
+        msa = jax.random.normal(ks[1], (b, m, n, d)) * 0.5
+        seq_mask = jnp.ones((b, n), bool).at[:, -2:].set(False)
+        pmask = seq_mask[:, :, None] & seq_mask[:, None, :]
+        msa_mask = jnp.ones((b, m, n), bool) & seq_mask[:, None, :]
+        return x, msa, pmask, msa_mask
+
+    def test_evoformer_pp_matches_scan(self):
+        from alphafold2_tpu.model.evoformer import Evoformer
+        from alphafold2_tpu.parallel import make_mesh, use_mesh
+
+        x, msa, pmask, msa_mask = self._inputs(jax.random.PRNGKey(60))
+        kw = dict(dim=32, depth=4, heads=2, dim_head=16)
+        plain = Evoformer(**kw)
+        pp = Evoformer(**kw, pipeline_stages=4)
+        params = plain.init(jax.random.PRNGKey(61), x, msa,
+                            mask=pmask, msa_mask=msa_mask)
+
+        xo, mo = plain.apply(params, x, msa, mask=pmask, msa_mask=msa_mask)
+        mesh = make_mesh(2, 1, 1, pipe=4)
+        with use_mesh(mesh):
+            xp, mp = jax.jit(lambda p: pp.apply(
+                p, x, msa, mask=pmask, msa_mask=msa_mask))(params)
+        assert np.allclose(np.asarray(xo), np.asarray(xp), atol=2e-5)
+        assert np.allclose(np.asarray(mo), np.asarray(mp), atol=2e-5)
+
+    def test_evoformer_pp_grads_match_scan(self):
+        from alphafold2_tpu.model.evoformer import Evoformer
+        from alphafold2_tpu.parallel import make_mesh, use_mesh
+
+        x, msa, pmask, msa_mask = self._inputs(jax.random.PRNGKey(62))
+        kw = dict(dim=32, depth=4, heads=2, dim_head=16)
+        plain = Evoformer(**kw)
+        pp = Evoformer(**kw, pipeline_stages=4)
+        params = plain.init(jax.random.PRNGKey(63), x, msa,
+                            mask=pmask, msa_mask=msa_mask)
+
+        def loss(model):
+            def f(p):
+                xo, mo = model.apply(p, x, msa, mask=pmask,
+                                     msa_mask=msa_mask)
+                return (xo ** 2).sum() + (mo ** 2).sum()
+            return f
+
+        g1 = jax.grad(loss(plain))(params)
+        mesh = make_mesh(2, 1, 1, pipe=4)
+        with use_mesh(mesh):
+            g2 = jax.jit(jax.grad(loss(pp)))(params)
+        for a, b_ in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            # remat/reassociation noise under a sum-of-squares loss of
+            # scale ~1e3; observed max ~2e-3 absolute on grads of |.|~1e1
+            assert np.allclose(np.asarray(a), np.asarray(b_),
+                               rtol=1e-4, atol=5e-3), \
+                float(jnp.abs(a - b_).max())
+
+    def test_alphafold2_pp_train_step(self):
+        """Full model + train step with the pipelined trunk on a
+        (pipe=2, data=2, i=2, j=1) mesh: distogram matches the non-pp
+        model, loss finite, step executes."""
+        from alphafold2_tpu import Alphafold2
+        from alphafold2_tpu.data.synthetic import synthetic_batch
+        from alphafold2_tpu.parallel import make_mesh, use_mesh
+        from alphafold2_tpu.train import (TrainState, adam,
+                                          make_train_step, shard_batch)
+
+        kw = dict(dim=32, depth=2, heads=2, dim_head=16)
+        plain = Alphafold2(**kw)
+        pp = Alphafold2(**kw, pipeline_stages=2)
+        batch = synthetic_batch(jax.random.PRNGKey(70), batch=4,
+                                seq_len=8, msa_depth=3, with_coords=True)
+        args = (batch["seq"],)
+        bkw = dict(msa=batch["msa"], mask=batch["mask"],
+                   msa_mask=batch["msa_mask"])
+        params = plain.init(jax.random.PRNGKey(71), *args, **bkw)
+
+        ret_plain = plain.apply(params, *args, **bkw)
+        mesh = make_mesh(2, 2, 1, pipe=2)
+        with use_mesh(mesh):
+            ret_pp = jax.jit(lambda p: pp.apply(p, *args, **bkw))(params)
+            assert np.allclose(np.asarray(ret_plain.distance),
+                               np.asarray(ret_pp.distance), atol=2e-4)
+
+            state = TrainState.create(apply_fn=pp.apply, params=params,
+                                      tx=adam(1e-3),
+                                      rng=jax.random.PRNGKey(72))
+            step = jax.jit(make_train_step(pp), donate_argnums=(0,))
+            new_state, metrics = step(state, shard_batch(batch, mesh))
+            assert bool(jnp.isfinite(metrics["loss"]))
+            assert int(new_state.step) == 1

@@ -20,9 +20,11 @@ pytestmark = pytest.mark.skipif(
 
 def test_make_mesh_shapes():
     mesh = make_mesh(2, 2, 2)
-    assert mesh.shape == {"data": 2, "i": 2, "j": 2}
+    assert mesh.shape == {"pipe": 1, "data": 2, "i": 2, "j": 2}
     with pytest.raises(ValueError):
         make_mesh(3, 3, 3)
+    mesh = make_mesh(2, 1, 1, pipe=4)
+    assert mesh.shape == {"pipe": 4, "data": 2, "i": 1, "j": 1}
 
 
 def test_pair_sharding_spec():

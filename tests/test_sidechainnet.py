@@ -1,0 +1,119 @@
+"""Sidechainnet local-corpus adapter (reference train_pre.py:37-47
+`scn.load`): pickle-format loading, PDB demo corpus, and a real-data
+distogram training run with decreasing loss on the 1H22 crystal fixture.
+"""
+
+import json
+import os
+import pickle
+
+import jax
+import numpy as np
+import pytest
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "data", "1h22_head.pdb")
+
+
+def _fake_scn_pickle(path, n_train=3, lengths=(40, 60, 30)):
+    """A miniature pickle in the sidechainnet on-disk format."""
+    rng = np.random.default_rng(0)
+    aas = "ARNDCQEGHILKMFPSTWYV"
+
+    def protein(L):
+        seq = "".join(rng.choice(list(aas)) for _ in range(L))
+        # chain-like CA trace with small atom clouds around it
+        ca = np.cumsum(rng.normal(0, 1.5, (L, 3)), axis=0)
+        crd = (ca[:, None] + rng.normal(0, 0.5, (L, 14, 3))).reshape(-1, 3)
+        msk = "".join("+" if rng.random() > 0.1 else "-" for _ in range(L))
+        return seq, crd.astype(np.float32), msk
+
+    train = {"seq": [], "crd": [], "msk": [], "ids": []}
+    for i, L in enumerate(lengths[:n_train]):
+        s, c, m = protein(L)
+        train["seq"].append(s)
+        train["crd"].append(c)
+        train["msk"].append(m)
+        train["ids"].append(f"P{i}")
+    data = {"train": train, "valid-10": {"seq": [], "crd": []},
+            "settings": {"casp_version": 12, "thinning": 30},
+            "description": "fake", "date": "2026"}
+    with open(path, "wb") as f:
+        pickle.dump(data, f)
+
+
+class TestScnPickle:
+    def test_load_and_batch(self, tmp_path):
+        from alphafold2_tpu.data.sidechainnet import (SidechainnetDataModule,
+                                                      load_scn_pickle)
+
+        p = str(tmp_path / "scn.pkl")
+        _fake_scn_pickle(p)
+        splits = load_scn_pickle(p)
+        assert "train" in splits and "settings" not in splits
+
+        dm = SidechainnetDataModule(p, crop_len=32, batch_size=2)
+        batch = next(dm.train_batches())
+        assert batch["seq"].shape == (2, 32)
+        assert batch["coords14"].shape == (2, 32, 14, 3)
+        assert batch["dist"].shape == (2, 32, 32)
+        assert batch["msa"].shape[0:1] == (2,)
+        # supervised targets exist and unresolved ('-') residues are
+        # excluded via the zero-coord convention
+        assert (batch["dist"] >= 0).any()
+
+    def test_threshold_length_filter(self, tmp_path):
+        from alphafold2_tpu.data.sidechainnet import SidechainnetDataModule
+
+        p = str(tmp_path / "scn.pkl")
+        _fake_scn_pickle(p)
+        dm = SidechainnetDataModule(p, crop_len=16, max_len=45)
+        # lengths (40, 60, 30): the 60-residue protein is filtered, the
+        # reference's THRESHOLD_LENGTH semantics (train_pre.py:19,45)
+        assert len(dm.train_ds) == 2
+
+    def test_bad_pickle_rejected(self, tmp_path):
+        from alphafold2_tpu.data.sidechainnet import load_scn_pickle
+
+        p = str(tmp_path / "bad.pkl")
+        with open(p, "wb") as f:
+            pickle.dump({"not": "scn"}, f)
+        with pytest.raises(ValueError):
+            load_scn_pickle(p)
+
+
+class TestPdbCorpus:
+    def test_corpus_from_fixture(self):
+        from alphafold2_tpu.data.sidechainnet import (SidechainnetDataModule,
+                                                      corpus_from_pdb)
+
+        corpus = corpus_from_pdb([FIXTURE])
+        assert len(corpus["seq"]) == 1
+        L = len(corpus["seq"][0])
+        assert corpus["crd"][0].shape == (L * 14, 3)
+        assert set(corpus["msk"][0]) <= {"+", "-"}
+
+        dm = SidechainnetDataModule(corpus, crop_len=32, batch_size=1)
+        batch = next(dm.train_batches())
+        assert (batch["dist"] >= 0).any()
+        assert bool(np.isfinite(batch["coords14"]).all())
+
+
+class TestRealDataTraining:
+    def test_distogram_loss_descends_on_crystal_structure(self, tmp_path):
+        """The round-2 VERDICT demo: a short train_distogram.py run on
+        real structure data (1H22 residues 4-75) with decreasing loss."""
+        from scripts.train_distogram import main
+
+        cfg = {"model": {"dim": 32, "depth": 1, "heads": 2, "dim_head": 16,
+                         "bfloat16": False},
+               "data": {"crop_len": 48, "msa_depth": 1, "batch_size": 1},
+               "train": {"num_steps": 25, "log_every": 5,
+                         "learning_rate": 1e-3, "grad_accum_every": 1}}
+        cfg_path = str(tmp_path / "cfg.json")
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+
+        history = main(["--config", cfg_path, "--pdb", FIXTURE])
+        losses = [h["loss"] for h in history]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.9, losses
