@@ -35,6 +35,7 @@ class ModelConfig:
     ring_attention: bool = False
     pipeline_stages: int = 1          # GPipe trunk stages (mesh pipe axis)
     pipeline_microbatches: int = 0
+    use_conv: bool = False            # trRosetta2-style trunk conv blocks
     extra_msa_evoformer_layers: int = 4
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0

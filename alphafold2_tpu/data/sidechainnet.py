@@ -129,6 +129,11 @@ class SidechainnetDataModule:
         if not len(self.train_ds):
             raise ValueError(f"split {train_split!r} has no proteins "
                              f"<= {max_len} residues")
+        if val_split is not None and val_split not in splits:
+            # an explicitly requested split must exist — silently serving
+            # train data as "validation" hides the mistake
+            raise KeyError(f"val_split {val_split!r} not in "
+                           f"{sorted(splits)}")
         val = val_split or next(
             (k for k in sorted(splits) if k.startswith("valid")), None)
         self.val_ds = SidechainnetDataset(splits[val], max_len) \

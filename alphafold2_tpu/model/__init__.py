@@ -14,6 +14,7 @@ from alphafold2_tpu.model.attention_variants import (  # noqa: F401
     KroneckerAttention,
     LinearAttention,
     MemoryCompressedAttention,
+    MultiKernelConvBlock,
 )
 from alphafold2_tpu.model.mlm import MLM  # noqa: F401
 from alphafold2_tpu.model.refiners import EGNNLayer, EnAttentionLayer, Refiner  # noqa: F401

@@ -31,6 +31,10 @@ from flax import linen as nn
 from flax import struct
 
 from alphafold2_tpu import constants
+from alphafold2_tpu.model.attention_variants import (
+    DEFAULT_CONV_MSA_KERNELS,
+    DEFAULT_CONV_SEQ_KERNELS,
+)
 from alphafold2_tpu.model.evoformer import Evoformer, PairwiseAttentionBlock
 from alphafold2_tpu.model.mlm import MLM
 from alphafold2_tpu.model.primitives import Attention, LayerNorm
@@ -113,6 +117,13 @@ class Alphafold2(nn.Module):
     # worth staging.
     pipeline_stages: int = 1
     pipeline_microbatches: int = 0
+    # trRosetta2-style conv blocks on both trunk tracks (the reference's
+    # README-era `use_conv` menu, README.md:271-340; kernels/dilations
+    # mirror its conv_seq_kernels / conv_msa_kernels / dilation cycle)
+    use_conv: bool = False
+    conv_seq_kernels: tuple = DEFAULT_CONV_SEQ_KERNELS
+    conv_msa_kernels: tuple = DEFAULT_CONV_MSA_KERNELS
+    conv_dilations: tuple = (1,)
     # reproduce the reference's masked-OuterMean double division
     # (alphafold2.py:347 + the always-synthesized msa_mask at :703);
     # required for exact parity with reference-trained checkpoints
@@ -363,6 +374,10 @@ class Alphafold2(nn.Module):
             ff_dropout=self.ff_dropout,
             ring_attention=self.ring_attention,
             outer_mean_reference_scale=self.outer_mean_reference_scale,
+            use_conv=self.use_conv,
+            conv_seq_kernels=self.conv_seq_kernels,
+            conv_msa_kernels=self.conv_msa_kernels,
+            conv_dilations=self.conv_dilations,
             dtype=self.dtype,
             reversible=self.reversible, use_scan=self.use_scan,
             pipeline_stages=self.pipeline_stages,

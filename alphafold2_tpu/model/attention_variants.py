@@ -177,6 +177,63 @@ class KroneckerAttention(nn.Module):
             x, mask=mask, context=pooled, context_mask=token_mask)
 
 
+# README-era defaults (reference README.md:305-307): 1d+2d kernel mix
+# for the (n, n) pair map and the (rows, n) MSA. The single source —
+# EvoformerBlock/Evoformer/Alphafold2/RevEvoLayer all default to these.
+DEFAULT_CONV_SEQ_KERNELS = ((9, 1), (1, 9), (3, 3))
+DEFAULT_CONV_MSA_KERNELS = ((1, 9), (3, 3))
+
+
+class MultiKernelConvBlock(nn.Module):
+    """trRosetta2-style residual conv block (reference README.md:271-340
+    `use_conv=True` + `conv_seq_kernels`/`conv_msa_kernels`/dilations —
+    "combining 1d and 2d kernels in one resnet-like block"): parallel
+    NHWC 2-D convolutions with per-branch kernel shapes x dilations over
+    the two spatial axes, averaged, gelu, then a zero-init output
+    projection (the package's residual-branch convention — the block is
+    an identity at init). The caller adds the residual.
+
+    TPU-first deviations from the README-era design: NHWC layout (XLA's
+    native conv layout on TPU — no transposes around the MXU) and the
+    dilation cycle applied WITHIN the block (one branch per kernel x
+    dilation) instead of varying per layer: the trunk runs under
+    `nn.scan`, which requires every layer to share one static config,
+    and in-block multi-dilation preserves the mixed receptive fields the
+    cycle existed to provide.
+
+    Masking: invalid spatial positions are zeroed BEFORE the convs so
+    padding never leaks into valid cells through the kernel window.
+    """
+
+    dim: int
+    kernels: Tuple[Tuple[int, int], ...] = ((3, 3),)
+    dilations: Tuple[int, ...] = (1,)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        from alphafold2_tpu.model.primitives import LayerNorm, zeros_init
+
+        h = LayerNorm(dtype=self.dtype)(x)
+        if mask is not None:
+            h = h * mask[..., None].astype(h.dtype)
+        branches = []
+        for kh, kw in self.kernels:
+            for d in self.dilations:
+                branches.append(nn.Conv(
+                    features=self.dim, kernel_size=(kh, kw),
+                    kernel_dilation=(d, d), padding="SAME",
+                    dtype=self.dtype, param_dtype=jnp.float32,
+                    name=f"conv_{kh}x{kw}_d{d}")(h))
+        h = jnn.gelu(sum(branches) / len(branches))
+        out = nn.Dense(self.dim, kernel_init=zeros_init(),
+                       bias_init=zeros_init(), dtype=self.dtype,
+                       param_dtype=jnp.float32, name="proj_out")(h)
+        if mask is not None:
+            out = out * mask[..., None].astype(out.dtype)
+        return out
+
+
 def block_sparse_block_pattern(n_blocks: int, num_global: int = 1,
                                window: int = 1):
     """(n_blocks, n_blocks) bool numpy block pattern: attend within

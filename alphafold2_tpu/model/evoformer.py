@@ -21,6 +21,10 @@ from typing import Optional
 import jax.numpy as jnp
 from flax import linen as nn
 
+from alphafold2_tpu.model.attention_variants import (
+    DEFAULT_CONV_MSA_KERNELS,
+    DEFAULT_CONV_SEQ_KERNELS,
+)
 from alphafold2_tpu.model.primitives import (
     AxialAttention,
     FeedForward,
@@ -117,7 +121,14 @@ class MsaAttentionBlock(nn.Module):
 
 
 class EvoformerBlock(nn.Module):
-    """One Evoformer layer (reference alphafold2.py:412-446)."""
+    """One Evoformer layer (reference alphafold2.py:412-446).
+
+    `use_conv=True` appends trRosetta2-style residual conv blocks to both
+    tracks (the README-era `use_conv` menu item, README.md:271-340):
+    `conv_seq_kernels` over the (n, n) pair map, `conv_msa_kernels` over
+    the (rows, n) MSA, with the dilation cycle applied in-block
+    (attention_variants.MultiKernelConvBlock documents the TPU-first
+    deviations)."""
 
     dim: int
     heads: int
@@ -127,11 +138,18 @@ class EvoformerBlock(nn.Module):
     global_column_attn: bool = False
     ring_attention: bool = False
     outer_mean_reference_scale: bool = False
+    use_conv: bool = False
+    conv_seq_kernels: tuple = DEFAULT_CONV_SEQ_KERNELS
+    conv_msa_kernels: tuple = DEFAULT_CONV_MSA_KERNELS
+    conv_dilations: tuple = (1,)
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, m, mask=None, msa_mask=None,
                  deterministic: bool = True):
+        from alphafold2_tpu.model.attention_variants import (
+            MultiKernelConvBlock)
+
         # msa attention and transition
         m = MsaAttentionBlock(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
@@ -141,6 +159,11 @@ class EvoformerBlock(nn.Module):
         m = FeedForward(dim=self.dim, dropout=self.ff_dropout,
                         dtype=self.dtype, name="msa_ff")(
                             m, deterministic=deterministic) + m
+        if self.use_conv:
+            m = MultiKernelConvBlock(
+                dim=self.dim, kernels=self.conv_msa_kernels,
+                dilations=self.conv_dilations, dtype=self.dtype,
+                name="msa_conv")(m, mask=msa_mask) + m
 
         # pairwise attention (ingesting the updated MSA) and transition
         x = PairwiseAttentionBlock(
@@ -155,6 +178,11 @@ class EvoformerBlock(nn.Module):
         x = FeedForward(dim=self.dim, dropout=self.ff_dropout,
                         dtype=self.dtype, name="ff")(
                             x, deterministic=deterministic) + x
+        if self.use_conv:
+            x = MultiKernelConvBlock(
+                dim=self.dim, kernels=self.conv_seq_kernels,
+                dilations=self.conv_dilations, dtype=self.dtype,
+                name="pair_conv")(x, mask=mask) + x
 
         return x, m
 
@@ -173,6 +201,10 @@ class Evoformer(nn.Module):
     global_column_attn: bool = False
     ring_attention: bool = False
     outer_mean_reference_scale: bool = False
+    use_conv: bool = False
+    conv_seq_kernels: tuple = DEFAULT_CONV_SEQ_KERNELS
+    conv_msa_kernels: tuple = DEFAULT_CONV_MSA_KERNELS
+    conv_dilations: tuple = (1,)
     dtype: jnp.dtype = jnp.float32
     use_scan: bool = True
     # O(1)-activation reversible trunk (model/reversible.py; reference
@@ -310,6 +342,10 @@ class Evoformer(nn.Module):
                 dim_head=self.dim_head,
                 global_column_attn=self.global_column_attn,
                 ring_attention=self.ring_attention,
+                use_conv=self.use_conv,
+                conv_seq_kernels=self.conv_seq_kernels,
+                conv_msa_kernels=self.conv_msa_kernels,
+                conv_dilations=self.conv_dilations,
                 dtype=self.dtype, name="rev")(
                     x, m, mask=mask, msa_mask=msa_mask)
 
@@ -319,6 +355,10 @@ class Evoformer(nn.Module):
             global_column_attn=self.global_column_attn,
             ring_attention=self.ring_attention,
             outer_mean_reference_scale=self.outer_mean_reference_scale,
+            use_conv=self.use_conv,
+            conv_seq_kernels=self.conv_seq_kernels,
+            conv_msa_kernels=self.conv_msa_kernels,
+            conv_dilations=self.conv_dilations,
             dtype=self.dtype,
         )
 

@@ -37,6 +37,10 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from alphafold2_tpu.model.attention_variants import (
+    DEFAULT_CONV_MSA_KERNELS,
+    DEFAULT_CONV_SEQ_KERNELS,
+)
 from alphafold2_tpu.model.primitives import FeedForward
 # imported late to avoid a cycle: evoformer imports nothing from here
 
@@ -49,9 +53,20 @@ class RevEvoLayer(nn.Module):
     dim_head: int = 64
     global_column_attn: bool = False
     ring_attention: bool = False
+    # the reference's reversible 'conv' block type (reversible.py:303-347
+    # dispatches 'conv' through the same coupling machinery as 'self'):
+    # the conv blocks join the second (FF) coupling of each track, which
+    # keeps the layer exactly invertible — x2' = x2 + f(x1') inverts as
+    # x2 = x2' - f(x1') no matter what f contains
+    use_conv: bool = False
+    conv_seq_kernels: tuple = DEFAULT_CONV_SEQ_KERNELS
+    conv_msa_kernels: tuple = DEFAULT_CONV_MSA_KERNELS
+    conv_dilations: tuple = (1,)
     dtype: Any = jnp.float32
 
     def setup(self):
+        from alphafold2_tpu.model.attention_variants import (
+            MultiKernelConvBlock)
         from alphafold2_tpu.model.evoformer import (
             MsaAttentionBlock, PairwiseAttentionBlock)
         self.msa_attn = MsaAttentionBlock(
@@ -63,35 +78,65 @@ class RevEvoLayer(nn.Module):
             global_column_attn=self.global_column_attn,
             ring_attention=self.ring_attention, dtype=self.dtype)
         self.pair_ff = FeedForward(dim=self.dim, dtype=self.dtype)
+        if self.use_conv:
+            self.msa_conv = MultiKernelConvBlock(
+                dim=self.dim, kernels=self.conv_msa_kernels,
+                dilations=self.conv_dilations, dtype=self.dtype)
+            self.pair_conv = MultiKernelConvBlock(
+                dim=self.dim, kernels=self.conv_seq_kernels,
+                dilations=self.conv_dilations, dtype=self.dtype)
 
     # deltas (no outer residual — the coupling adds it)
     def delta_msa(self, m2, x_ctx, mask, msa_mask):
         return self.msa_attn(m2, mask=msa_mask, pairwise_repr=x_ctx) - m2
 
-    def delta_msa_ff(self, m1):
-        return self.msa_ff(m1)
+    def delta_msa_ff(self, m1, msa_mask):
+        out = self.msa_ff(m1)
+        if self.use_conv:
+            out = out + self.msa_conv(m1, mask=msa_mask)
+        return out
 
     def delta_pair(self, x2, m_ctx, mask, msa_mask):
         return self.pair_attn(x2, mask=mask, msa_repr=m_ctx,
                               msa_mask=msa_mask) - x2
 
-    def delta_pair_ff(self, x1):
-        return self.pair_ff(x1)
+    def delta_pair_ff(self, x1, mask):
+        out = self.pair_ff(x1)
+        if self.use_conv:
+            out = out + self.pair_conv(x1, mask=mask)
+        return out
 
     def __call__(self, m2, m1, x2, x1, mask, msa_mask):
         """Used only at init time to create all params."""
         x_ctx = (x1 + x2) * 0.5
         d1 = self.delta_msa(m2, x_ctx, mask, msa_mask)
-        d2 = self.delta_msa_ff(m1)
+        d2 = self.delta_msa_ff(m1, msa_mask)
         d3 = self.delta_pair(x2, (m1 + m2) * 0.5, mask, msa_mask)
-        d4 = self.delta_pair_ff(x1)
+        d4 = self.delta_pair_ff(x1, mask)
         return d1, d2, d3, d4
 
 
+def layer_cfg(dim, heads, dim_head=64, global_column_attn=False,
+              ring_attention=False, use_conv=False,
+              conv_seq_kernels=DEFAULT_CONV_SEQ_KERNELS,
+              conv_msa_kernels=DEFAULT_CONV_MSA_KERNELS,
+              conv_dilations=(1,), dtype="float32"):
+    """The static (hashable) layer-config tuple `_run_reversible` carries
+    as a nondiff argument — one constructor so tests and the module can't
+    drift from `_make_layer`'s unpacking order."""
+    return (dim, heads, dim_head, global_column_attn, ring_attention,
+            use_conv, tuple(map(tuple, conv_seq_kernels)),
+            tuple(map(tuple, conv_msa_kernels)), tuple(conv_dilations),
+            jnp.dtype(dtype).name)
+
+
 def _make_layer(cfg) -> RevEvoLayer:
-    dim, heads, dim_head, gca, ring, dtype_name = cfg
+    (dim, heads, dim_head, gca, ring, use_conv, seq_k, msa_k, dil,
+     dtype_name) = cfg
     return RevEvoLayer(dim=dim, heads=heads, dim_head=dim_head,
                        global_column_attn=gca, ring_attention=ring,
+                       use_conv=use_conv, conv_seq_kernels=seq_k,
+                       conv_msa_kernels=msa_k, conv_dilations=dil,
                        dtype=jnp.dtype(dtype_name), parent=None)
 
 
@@ -105,10 +150,10 @@ def _layer_fwd(cfg, params, streams, mask, msa_mask):
 
     x_in = (x1 + x2) * 0.5
     m1 = m1 + ap(RevEvoLayer.delta_msa, m2, x_in, bmask, bmsa)
-    m2 = m2 + ap(RevEvoLayer.delta_msa_ff, m1)
+    m2 = m2 + ap(RevEvoLayer.delta_msa_ff, m1, bmsa)
     m_out = (m1 + m2) * 0.5
     x1 = x1 + ap(RevEvoLayer.delta_pair, x2, m_out, bmask, bmsa)
-    x2 = x2 + ap(RevEvoLayer.delta_pair_ff, x1)
+    x2 = x2 + ap(RevEvoLayer.delta_pair_ff, x1, bmask)
     return (x1, x2, m1, m2)
 
 
@@ -121,10 +166,10 @@ def _layer_inv(cfg, params, streams, mask, msa_mask):
     ap = lambda method, *args: layer.apply(
         {"params": params}, *args, method=method)
 
-    x2 = x2p - ap(RevEvoLayer.delta_pair_ff, x1p)
+    x2 = x2p - ap(RevEvoLayer.delta_pair_ff, x1p, bmask)
     m_out = (m1p + m2p) * 0.5
     x1 = x1p - ap(RevEvoLayer.delta_pair, x2, m_out, bmask, bmsa)
-    m2 = m2p - ap(RevEvoLayer.delta_msa_ff, m1p)
+    m2 = m2p - ap(RevEvoLayer.delta_msa_ff, m1p, bmsa)
     x_in = (x1 + x2) * 0.5
     m1 = m1p - ap(RevEvoLayer.delta_msa, m2, x_in, bmask, bmsa)
     return (x1, x2, m1, m2)
@@ -181,15 +226,22 @@ class ReversibleEvoformer(nn.Module):
     # collectives schedule is identical in forward, reconstruction, and
     # gradient recomputation (tests/test_ring.py::TestReversibleRing)
     ring_attention: bool = False
+    # the 'conv' coupling (see RevEvoLayer.use_conv)
+    use_conv: bool = False
+    conv_seq_kernels: tuple = DEFAULT_CONV_SEQ_KERNELS
+    conv_msa_kernels: tuple = DEFAULT_CONV_MSA_KERNELS
+    conv_dilations: tuple = (1,)
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, m, mask=None, msa_mask=None,
                  deterministic: bool = True):
         del deterministic  # reversible trunk is always deterministic
-        cfg = (self.dim, self.heads, self.dim_head,
-               self.global_column_attn, self.ring_attention,
-               jnp.dtype(self.dtype).name)
+        cfg = layer_cfg(self.dim, self.heads, self.dim_head,
+                        self.global_column_attn, self.ring_attention,
+                        self.use_conv, self.conv_seq_kernels,
+                        self.conv_msa_kernels, self.conv_dilations,
+                        jnp.dtype(self.dtype).name)
         layer = _make_layer(cfg)
 
         mask_f = None if mask is None else mask.astype(jnp.float32)

@@ -40,14 +40,15 @@ def initialize(coordinator_address: Optional[str] = None,
     return True
 
 
-def global_mesh(data: int = 1, i: int = 1, j: int = 1) -> Mesh:
+def global_mesh(data: int = 1, i: int = 1, j: int = 1,
+                pipe: int = 1) -> Mesh:
     """Mesh over all processes' devices (jax.devices() is global)."""
     devices = jax.devices()
-    need = data * i * j
+    need = pipe * data * i * j
     if need != len(devices):
-        raise ValueError(f"mesh {data}x{i}x{j}={need} != global device "
-                         f"count {len(devices)}")
-    return Mesh(np.asarray(devices).reshape(data, i, j), AXIS_NAMES)
+        raise ValueError(f"mesh {pipe}x{data}x{i}x{j}={need} != global "
+                         f"device count {len(devices)}")
+    return Mesh(np.asarray(devices).reshape(pipe, data, i, j), AXIS_NAMES)
 
 
 def host_local_batch_to_global(batch, mesh: Mesh):
