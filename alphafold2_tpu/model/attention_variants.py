@@ -35,7 +35,9 @@ from jax import nn as jnn
 
 from alphafold2_tpu.model.primitives import (
     MASK_VALUE,
+    LayerNorm,
     attention_output_tail,
+    zeros_init,
 )
 
 
@@ -212,8 +214,6 @@ class MultiKernelConvBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None):
-        from alphafold2_tpu.model.primitives import LayerNorm, zeros_init
-
         h = LayerNorm(dtype=self.dtype)(x)
         if mask is not None:
             h = h * mask[..., None].astype(h.dtype)

@@ -24,6 +24,7 @@ from flax import linen as nn
 from alphafold2_tpu.model.attention_variants import (
     DEFAULT_CONV_MSA_KERNELS,
     DEFAULT_CONV_SEQ_KERNELS,
+    MultiKernelConvBlock,
 )
 from alphafold2_tpu.model.primitives import (
     AxialAttention,
@@ -147,9 +148,6 @@ class EvoformerBlock(nn.Module):
     @nn.compact
     def __call__(self, x, m, mask=None, msa_mask=None,
                  deterministic: bool = True):
-        from alphafold2_tpu.model.attention_variants import (
-            MultiKernelConvBlock)
-
         # msa attention and transition
         m = MsaAttentionBlock(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,

@@ -40,6 +40,7 @@ from flax import linen as nn
 from alphafold2_tpu.model.attention_variants import (
     DEFAULT_CONV_MSA_KERNELS,
     DEFAULT_CONV_SEQ_KERNELS,
+    MultiKernelConvBlock,
 )
 from alphafold2_tpu.model.primitives import FeedForward
 # imported late to avoid a cycle: evoformer imports nothing from here
@@ -65,8 +66,6 @@ class RevEvoLayer(nn.Module):
     dtype: Any = jnp.float32
 
     def setup(self):
-        from alphafold2_tpu.model.attention_variants import (
-            MultiKernelConvBlock)
         from alphafold2_tpu.model.evoformer import (
             MsaAttentionBlock, PairwiseAttentionBlock)
         self.msa_attn = MsaAttentionBlock(

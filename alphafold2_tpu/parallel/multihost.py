@@ -8,7 +8,7 @@ module holds the host-side glue:
 
 - `initialize()`: `jax.distributed.initialize` wrapper (no-op when
   single-process, e.g. local runs and tests);
-- `global_mesh()`: build the (data, i, j) mesh over ALL processes'
+- `global_mesh()`: build the (pipe, data, i, j) mesh over ALL processes'
   devices;
 - `host_local_batch_to_global()`: assemble a globally-sharded array from
   per-host shards (`jax.make_array_from_process_local_data`) so each host

@@ -42,3 +42,10 @@ def perturb_params(params, key, scale=0.05):
     return treedef.unflatten(
         [l + scale * jax.random.normal(k, l.shape, l.dtype)
          for l, k in zip(leaves, keys)])
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "quick: fast smoke tier (one representative test per subsystem, "
+        "~4-5 min on 1 CPU core): python -m pytest -m quick")

@@ -4,6 +4,7 @@ Kronecker-pooled, block-sparse."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from alphafold2_tpu.model.attention_variants import (
     BlockSparseAttention,
@@ -109,6 +110,7 @@ class TestKronecker:
 
 
 class TestBlockSparse:
+    @pytest.mark.quick
     def test_mask_pattern(self):
         m = block_sparse_mask(64, block=16, num_global=1, window=1)
         assert m.shape == (64, 64)
@@ -150,6 +152,7 @@ class TestMultiKernelConv:
     """trRosetta2-style conv blocks (reference README.md:271-340
     `use_conv` / conv_seq_kernels / conv_msa_kernels / dilations)."""
 
+    @pytest.mark.quick
     def test_identity_at_init_and_shapes(self):
         from alphafold2_tpu.model import MultiKernelConvBlock
 
@@ -162,6 +165,7 @@ class TestMultiKernelConv:
         # zero-init output projection: the residual branch starts as 0
         assert float(jnp.abs(out).max()) == 0.0
 
+    @pytest.mark.quick
     def test_mask_blocks_leakage(self):
         """Values in masked cells must not influence valid outputs —
         the conv window sees zeros there, not garbage."""

@@ -12,6 +12,8 @@ import pytest
 from alphafold2_tpu.config import Experiment, ModelConfig
 from alphafold2_tpu.utils import MetricsLogger, StepTimer
 
+pytestmark = pytest.mark.quick
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

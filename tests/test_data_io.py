@@ -2,6 +2,7 @@
 on-disk samples, PDB write->parse round trip, and the gradient relaxer."""
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -9,6 +10,8 @@ from alphafold2_tpu import relax
 from alphafold2_tpu.core import nerf
 from alphafold2_tpu.data import featurize, native, pdb_io
 from alphafold2_tpu.data.trrosetta import TrRosettaDataModule, TrRosettaDataset
+
+pytestmark = pytest.mark.quick
 
 
 def write_sample(root, sample_id, length, rng):

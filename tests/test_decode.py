@@ -5,11 +5,14 @@ recovery/property tests it lacks."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from alphafold2_tpu import constants
 from alphafold2_tpu.core import geometry as geo
 from alphafold2_tpu.core import mds, nerf
 from alphafold2_tpu.data import featurize, graph, scn
+
+pytestmark = pytest.mark.quick
 
 
 class TestMDS:

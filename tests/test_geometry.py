@@ -12,6 +12,8 @@ from alphafold2_tpu.core import geometry as geo
 from alphafold2_tpu.core import quaternion as quat
 from alphafold2_tpu.core.rigid import Rigid
 
+pytestmark = pytest.mark.quick
+
 
 def random_rotation(key):
     q = jax.random.normal(key, (4,))

@@ -151,6 +151,7 @@ class TestBlockSparseKernel:
         glob = (bi[None, :] < num_global) | (bi[:, None] < num_global)
         return local | glob
 
+    @pytest.mark.quick
     def test_matches_dense_masked_reference(self):
         from alphafold2_tpu.ops.block_sparse import block_sparse_attention
 
@@ -167,6 +168,7 @@ class TestBlockSparseKernel:
             q, k, v, bias=jnp.broadcast_to(bias, (b, n, n)))
         assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
+    @pytest.mark.quick
     def test_default_scale_is_inv_sqrt_d(self):
         """scale=None applies 1/sqrt(D) inside the kernel — equivalent to
         pre-scaling q (the asymmetric pre-scaled-q-only API invited a
@@ -209,6 +211,7 @@ class TestBlockSparseKernel:
                            atol=1e-4), np.abs(
             np.asarray(out_dense) - np.asarray(out_kernel)).max()
 
+    @pytest.mark.quick
     def test_plan_compresses(self):
         from alphafold2_tpu.ops.block_sparse import plan_block_pattern
 

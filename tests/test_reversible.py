@@ -5,6 +5,7 @@ model-level reversible=True smoke + backward."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from alphafold2_tpu import Alphafold2
 from alphafold2_tpu.model.reversible import (
@@ -38,6 +39,7 @@ def init_trunk(depth=2, d=16, use_conv=False):
 
 
 class TestReversible:
+    @pytest.mark.quick
     def test_layer_inverse_roundtrip(self):
         trunk, params, (x, m, pair_mask, msa_mask) = init_trunk(depth=1)
         stacked = params["params"]["rev_layers"]
@@ -106,6 +108,7 @@ class TestReversibleConv:
     303-347): conv blocks join the FF couplings; the layer stays exactly
     invertible and custom-vjp grads match plain autodiff."""
 
+    @pytest.mark.quick
     def test_conv_layer_inverse_roundtrip(self):
         trunk, params, (x, m, pair_mask, msa_mask) = init_trunk(
             depth=1, use_conv=True)

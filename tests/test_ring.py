@@ -33,6 +33,7 @@ def ring_mesh(n=8):
 
 
 class TestRingAttention:
+    @pytest.mark.quick
     def test_matches_dense(self):
         q, k, v = make_qkv(jax.random.PRNGKey(0))
         mesh = ring_mesh()
@@ -350,6 +351,7 @@ class TestPairRowRing:
         assert np.allclose(np.asarray(out)[:, :, :, :6],
                            np.asarray(ref)[:, :, :, :6], atol=1e-5)
 
+    @pytest.mark.quick
     def test_with_nonseparable_mask(self):
         """Per-row key masks that are NOT an outer product of axis
         vectors are honored exactly (round-2 VERDICT weak #5)."""

@@ -18,6 +18,7 @@ pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs 8 virtual devices")
 
 
+@pytest.mark.quick
 def test_make_mesh_shapes():
     mesh = make_mesh(2, 2, 2)
     assert mesh.shape == {"pipe": 1, "data": 2, "i": 2, "j": 2}
@@ -27,6 +28,7 @@ def test_make_mesh_shapes():
     assert mesh.shape == {"pipe": 4, "data": 2, "i": 1, "j": 1}
 
 
+@pytest.mark.quick
 def test_pair_sharding_spec():
     assert pair_spec() == P("data", "i", "j", None)
 
@@ -218,6 +220,7 @@ class TestTensorParallel:
         # substantially (not 8x: embeddings/norms stay replicated)
         assert tp < 0.55 * full, (tp, full)
 
+    @pytest.mark.quick
     def test_tp_specs_hit_attention_and_ff(self):
         from alphafold2_tpu.parallel.sharding import tp_param_specs
 
@@ -248,6 +251,7 @@ class TestTensorParallel:
         # new Dense) must fail loudly, not degrade TP to replication
         assert len(sharded) == 107, len(sharded)
 
+    @pytest.mark.quick
     def test_tp_specs_warn_when_nothing_matches(self):
         import warnings
 

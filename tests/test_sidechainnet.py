@@ -42,6 +42,7 @@ def _fake_scn_pickle(path, n_train=3, lengths=(40, 60, 30)):
 
 
 class TestScnPickle:
+    @pytest.mark.quick
     def test_load_and_batch(self, tmp_path):
         from alphafold2_tpu.data.sidechainnet import (SidechainnetDataModule,
                                                       load_scn_pickle)
@@ -61,6 +62,7 @@ class TestScnPickle:
         # excluded via the zero-coord convention
         assert (batch["dist"] >= 0).any()
 
+    @pytest.mark.quick
     def test_threshold_length_filter(self, tmp_path):
         from alphafold2_tpu.data.sidechainnet import SidechainnetDataModule
 
@@ -71,6 +73,7 @@ class TestScnPickle:
         # reference's THRESHOLD_LENGTH semantics (train_pre.py:19,45)
         assert len(dm.train_ds) == 2
 
+    @pytest.mark.quick
     def test_bad_pickle_rejected(self, tmp_path):
         from alphafold2_tpu.data.sidechainnet import load_scn_pickle
 
@@ -82,6 +85,7 @@ class TestScnPickle:
 
 
 class TestPdbCorpus:
+    @pytest.mark.quick
     def test_corpus_from_fixture(self):
         from alphafold2_tpu.data.sidechainnet import (SidechainnetDataModule,
                                                       corpus_from_pdb)

@@ -4,6 +4,7 @@ descends, grad-accum equivalence, checkpoint save/restore round-trip."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from alphafold2_tpu import Alphafold2, constants
 from alphafold2_tpu.data.synthetic import synthetic_batch
@@ -352,6 +353,7 @@ class TestSchedule:
         assert sizes[0] < 1e-4
         assert sizes[-1] > sizes[0]
 
+    @pytest.mark.quick
     def test_warmup_only_holds_peak(self):
         """warmup_steps without decay_steps must HOLD peak LR after the
         ramp — the naive warmup_cosine spelling silently decayed 10x one
@@ -368,6 +370,7 @@ class TestSchedule:
         assert sizes[-1] > 0.5 * max(sizes), (sizes[-1], max(sizes))
         assert sizes[0] < 1e-4  # and warmup still ramps from ~0
 
+    @pytest.mark.quick
     def test_default_matches_reference_constant_lr(self):
         tx_plain = adam(1e-3)
         tx_sched = adam(1e-3, warmup_steps=0, decay_steps=None)
