@@ -199,8 +199,11 @@ def _build_tables():
                 length[ai, slot] = chem[0]
                 angle[ai, slot] = np.deg2rad(chem[1])
             build[ai, slot] = 1.0
-    return (jnp.asarray(parent), jnp.asarray(grand), jnp.asarray(great),
-            jnp.asarray(length), jnp.asarray(angle), jnp.asarray(build))
+    # numpy on purpose: jnp.asarray here would device_put at IMPORT time,
+    # initializing the XLA backend before the user can call
+    # jax.distributed.initialize (multihost.py's pod flow). jnp consumers
+    # convert at use — constant-folded once under jit.
+    return parent, grand, great, length, angle, build
 
 
 _PARENT, _GRAND, _GREAT, _LENGTH, _ANGLE, _BUILD = _build_tables()
@@ -226,7 +229,7 @@ def _branch_offsets():
             rank = seen.get(p, 0)
             off[ai, slot] = [0.0, 2 * np.pi / 3, -2 * np.pi / 3][rank % 3]
             seen[p] = rank + 1
-    return jnp.asarray(off)
+    return off  # numpy: no device_put at import (see _build_tables)
 
 
 _TORSION_OFF = _branch_offsets()
@@ -283,13 +286,15 @@ def sidechain_container(
     else:
         coords = coords.at[:, :, 3].set(place_o(n_at, ca, c_at))
 
-    parent = _PARENT[seq]     # (b, l, 14)
-    grand = _GRAND[seq]
-    great = _GREAT[seq]
-    length = _LENGTH[seq]
-    angle = _ANGLE[seq]
-    build = _BUILD[seq]
-    tors = _TORSION_OFF[seq] + _TORSION_BASE
+    # tables are host numpy (see _build_tables); convert for traced
+    # gathers — folded to constants under jit
+    parent = jnp.asarray(_PARENT)[seq]     # (b, l, 14)
+    grand = jnp.asarray(_GRAND)[seq]
+    great = jnp.asarray(_GREAT)[seq]
+    length = jnp.asarray(_LENGTH)[seq]
+    angle = jnp.asarray(_ANGLE)[seq]
+    build = jnp.asarray(_BUILD)[seq]
+    tors = jnp.asarray(_TORSION_OFF)[seq] + _TORSION_BASE
     if chi_torsions is not None:
         tors = tors + chi_torsions
 

@@ -33,41 +33,45 @@ from alphafold2_tpu.train import (TrainState, adam, make_train_step,
                                   shard_batch)
 
 
-def one_step(model, mesh, batch, tag):
+def one_step(model, mesh, batch, tag, params):
     with use_mesh(mesh):
-        params = model.init(jax.random.PRNGKey(1), batch["seq"],
-                            msa=batch["msa"], mask=batch["mask"],
-                            msa_mask=batch["msa_mask"])
         state = TrainState.create(apply_fn=model.apply, params=params,
                                   tx=adam(3e-4), rng=jax.random.PRNGKey(2))
         state = shard_pytree_tp_zero(state, mesh)
-        step = jax.jit(make_train_step(model), donate_argnums=(0,))
+        # no donate_argnums here: the demo reuses `params` across both
+        # runs, and donation would delete buffers the second run aliases
+        # (in training loops, donate the state — train/loop.py does)
+        step = jax.jit(make_train_step(model))
         state, metrics = step(state, shard_batch(batch, mesh))
         jax.block_until_ready(metrics["loss"])
     print(f"[{tag}] mesh={dict(mesh.shape)} "
           f"loss={float(metrics['loss']):.4f}")
-    return params
 
 
 def main():
-    n = len(jax.devices())
-    assert n >= 8, f"want 8 devices for the demo, have {n}"
+    devices = jax.devices()[:8]
+    assert len(devices) >= 8, \
+        f"want 8 devices for the demo, have {len(devices)}"
     batch = synthetic_batch(jax.random.PRNGKey(0), batch=4, seq_len=16,
                             msa_depth=3, with_coords=True)
+    kw = dict(dim=32, depth=2, heads=4, dim_head=16, predict_coords=True,
+              structure_module_depth=2, dtype=jnp.bfloat16)
+
+    # ONE params tree serves both runs below: the pipelined trunk
+    # regroups the same scan-stacked params, so checkpoints move freely
+    model = Alphafold2(**kw, ring_attention=True)
+    params = model.init(jax.random.PRNGKey(1), batch["seq"],
+                        msa=batch["msa"], mask=batch["mask"],
+                        msa_mask=batch["msa_mask"])
 
     # 1) dp x 2-D pair sharding, ring attention, TP + ZeRO placement
-    mesh = make_mesh(2, 2, 2)
-    model = Alphafold2(dim=32, depth=2, heads=4, dim_head=16,
-                       predict_coords=True, structure_module_depth=2,
-                       dtype=jnp.bfloat16, ring_attention=True)
-    one_step(model, mesh, batch, "dp x sp(ring) x tp x zero")
+    mesh = make_mesh(2, 2, 2, devices=devices)
+    one_step(model, mesh, batch, "dp x sp(ring) x tp x zero", params)
 
-    # 2) GPipe trunk: same architecture, pipe mesh axis
-    mesh_pp = make_mesh(2, 2, 1, pipe=2)
-    model_pp = Alphafold2(dim=32, depth=2, heads=4, dim_head=16,
-                          predict_coords=True, structure_module_depth=2,
-                          dtype=jnp.bfloat16, pipeline_stages=2)
-    one_step(model_pp, mesh_pp, batch, "pp(GPipe) x dp")
+    # 2) GPipe trunk: same architecture and THE SAME params, pipe axis
+    mesh_pp = make_mesh(2, 2, 1, pipe=2, devices=devices)
+    model_pp = Alphafold2(**kw, pipeline_stages=2)
+    one_step(model_pp, mesh_pp, batch, "pp(GPipe) x dp", params)
 
 
 if __name__ == "__main__":

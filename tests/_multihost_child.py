@@ -1,0 +1,44 @@
+"""Child process for tests/test_multihost.py: one host of a 2-process
+CPU cluster. argv: <process_id> <num_processes> <coordinator_addr>.
+
+Must configure platform/device-count via env BEFORE importing jax, and
+call multihost.initialize() before anything touches a backend — which is
+the same contract a pod entrypoint has (multihost.py docstring); the
+package import staying backend-free is load-bearing here (core/nerf.py
+keeps its tables as numpy for exactly this reason).
+"""
+
+import os
+import sys
+
+pid, n, addr = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from alphafold2_tpu.parallel import multihost  # noqa: E402
+
+multihost.initialize(addr, n, pid)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+assert jax.process_count() == n, jax.process_count()
+assert jax.local_device_count() == 2
+assert jax.device_count() == 2 * n
+
+mesh = multihost.global_mesh(data=2 * n)
+
+# each host contributes only its slice of the global batch
+full = np.arange(16 * n, dtype=np.float32).reshape(2 * n, 8)
+local = full[2 * pid:2 * pid + 2]
+batch = multihost.host_local_batch_to_global({"x": local}, mesh)
+
+glob = batch["x"]
+assert glob.shape == (2 * n, 8)              # global logical shape
+assert len(glob.addressable_shards) == 2     # but only local shards here
+
+# the jitted sum reduces across hosts (cross-process collective over the
+# data axis) — every process must see the full-array total
+total = float(jax.jit(lambda t: t["x"].sum())(batch))
+print(f"SUM {total}", flush=True)
