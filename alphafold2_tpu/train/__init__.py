@@ -7,4 +7,5 @@ from alphafold2_tpu.train.loop import (  # noqa: F401
     make_train_step,
     shard_batch,
 )
+from alphafold2_tpu.train.prefetch import device_prefetch  # noqa: F401
 from alphafold2_tpu.train.state import TrainState, adam  # noqa: F401

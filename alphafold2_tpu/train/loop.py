@@ -139,16 +139,27 @@ def fit(
     log_every: int = 10,
     logger=None,
     step_timer=None,
+    prefetch: int = 2,
 ):
     """Minimal host loop (reference train_pre.py:64-96 analog): consumes an
-    iterator of batches, runs the jitted step, logs scalar metrics."""
+    iterator of batches, runs the jitted step, logs scalar metrics.
+    `prefetch` stages that many batches onto device from a background
+    thread (train/prefetch.py) so host featurization/transfer overlaps
+    the step; 0 disables."""
+    pre_placed = prefetch > 0
+    if pre_placed:
+        from alphafold2_tpu.train.prefetch import device_prefetch
+        batches = device_prefetch(batches, size=prefetch)
     train_step = jax.jit(make_train_step(model), donate_argnums=(0,))
     history = []
     for i in range(num_steps):
         batch = next(batches)
         if step_timer is not None:
             step_timer.start()
-        state, metrics = train_step(state, shard_batch(batch))
+        # the prefetch worker already owns placement; re-sharding every
+        # step would redo a tree of device_puts on the hot path
+        state, metrics = train_step(
+            state, batch if pre_placed else shard_batch(batch))
         if step_timer is not None:
             jax.block_until_ready(metrics["loss"])
             step_timer.stop()

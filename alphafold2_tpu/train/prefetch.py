@@ -1,0 +1,92 @@
+"""Async host→device input staging.
+
+The reference leans on torch DataLoader worker processes for input
+overlap (training_scripts/datasets/trrosetta.py:451-476); the TPU-native
+equivalent is simpler: featurization is already host-side numpy
+(data/featurize.py), so one background thread that runs the iterator and
+issues `device_put` (with the mesh placement of `train.shard_batch`) is
+enough to hide host time behind the accelerator step — XLA transfers are
+async and thread-safe.
+
+`fit(..., prefetch=N)` uses this by default (N=2: one batch on device,
+one staging). Exceptions in the source iterator surface in the consumer,
+not silently in a dead thread.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+_END = object()
+
+
+def device_prefetch(batches: Iterator[dict], size: int = 2,
+                    mesh=None) -> Iterator[dict]:
+    """Wrap a host batch iterator: a daemon thread stages up to `size`
+    batches onto device while the caller's step runs — mesh data-axis
+    placement via `train.shard_batch` under a mesh, plain `device_put`
+    otherwise (so single-device training still gets the H2D overlap).
+    Yields the same batches in order; the batches it yields are already
+    placed (consumers must not re-shard).
+
+    The worker stops when the consumer does: closing the generator (or
+    letting it be GC'd after a partial read, as `fit` does after
+    num_steps) signals the thread to exit rather than draining the
+    source forever. At most one extra source batch — the one in flight —
+    is consumed past the last one yielded; that lookahead is what
+    prefetching is.
+    """
+    import jax
+
+    from alphafold2_tpu.parallel.sharding import active_mesh
+    from alphafold2_tpu.train.loop import shard_batch
+
+    # resolve the mesh HERE: active_mesh() is thread-local, so the worker
+    # thread would otherwise silently see none and skip placement
+    mesh = mesh or active_mesh()
+    if mesh is not None:
+        place = lambda b: shard_batch(b, mesh)  # noqa: E731
+    else:
+        place = lambda b: jax.tree.map(jax.device_put, b)  # noqa: E731
+
+    if size <= 0:
+        yield from (place(b) for b in batches)
+        return
+
+    q: queue.Queue = queue.Queue(maxsize=size)
+    stop = threading.Event()
+
+    def worker():
+        try:
+            it = iter(batches)
+            while not stop.is_set():
+                try:
+                    b = next(it)
+                except StopIteration:
+                    q.put((None, _END))
+                    return
+                item = ("ok", place(b))
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # noqa: BLE001 - propagate to consumer
+            q.put(("err", e))
+
+    threading.Thread(target=worker, daemon=True,
+                     name="device-prefetch").start()
+
+    try:
+        while True:
+            tag, item = q.get()
+            if item is _END:
+                return
+            if tag == "err":
+                raise item
+            yield item
+    finally:
+        stop.set()

@@ -307,8 +307,11 @@ def _parent_main() -> int:
         print("bench: default platform unreachable or too slow; "
               "falling back to CPU", file=sys.stderr, flush=True)
         cpu_env = _cpu_env()
+        # cpu-full worst case measured ~515s uncontended (fp32); the 900s
+        # cap leaves contention headroom while the deadline math still
+        # closes: probe 60 + 900 + mid 300 + tiny 80 < total - 30
         ladder = [
-            (_CPU_FULL, 600.0, 1000.0, "cpu-full"),
+            (_CPU_FULL, 900.0, 1100.0, "cpu-full"),
             (_CPU_MID, 300.0, 220.0, "cpu-mid"),
             (_CPU_TINY, 0.0, 75.0, "cpu-tiny"),
         ]

@@ -12,6 +12,7 @@ from alphafold2_tpu.train import (
     CheckpointManager,
     TrainState,
     adam,
+    fit,
     losses,
     make_train_step,
 )
@@ -391,3 +392,149 @@ class TestSchedule:
         assert back.train.warmup_steps == 100
         model, tx, mesh = back.build()
         assert tx is not None
+
+
+class TestPrefetch:
+    """Async host->device staging (train/prefetch.py) — the torch
+    DataLoader-workers analog (reference trrosetta.py:451-476)."""
+
+    @pytest.mark.quick
+    def test_order_and_values_preserved(self):
+        from alphafold2_tpu.train import device_prefetch
+
+        src = [{"x": np.full((4, 2), i, np.float32)} for i in range(7)]
+        out = list(device_prefetch(iter(src), size=3))
+        assert len(out) == 7
+        for i, b in enumerate(out):
+            assert float(np.asarray(b["x"])[0, 0]) == i
+
+    @pytest.mark.quick
+    def test_exception_propagates(self):
+        from alphafold2_tpu.train import device_prefetch
+
+        def bad():
+            yield {"x": np.zeros((2,), np.float32)}
+            raise RuntimeError("loader died")
+
+        it = device_prefetch(bad(), size=2)
+        next(it)
+        with pytest.raises(RuntimeError, match="loader died"):
+            next(it)
+
+    @pytest.mark.quick
+    def test_worker_stops_on_close(self):
+        """Closing the consumer stops the worker: a shared finite
+        iterator loses at most size+1 lookahead batches, and no thread
+        is left blocked forever."""
+        import threading
+        import time
+
+        from alphafold2_tpu.train import device_prefetch
+
+        consumed = []
+
+        def src():
+            for i in range(100):
+                consumed.append(i)
+                yield {"x": np.full((2,), i, np.float32)}
+
+        it = device_prefetch(src(), size=2)
+        next(it), next(it)
+        it.close()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and any(
+                t.name == "device-prefetch" and t.is_alive()
+                for t in threading.enumerate()):
+            time.sleep(0.05)
+        assert not any(t.name == "device-prefetch" and t.is_alive()
+                       for t in threading.enumerate())
+        # yielded 2 + queue capacity 2 + at most 1 in flight
+        assert len(consumed) <= 5, consumed
+
+    @pytest.mark.quick
+    def test_single_device_batches_are_committed(self):
+        """No mesh: batches still come back as committed device arrays
+        (the H2D transfer happened in the worker, not in the step)."""
+        from alphafold2_tpu.train import device_prefetch
+
+        src = [{"x": np.ones((2, 3), np.float32)}]
+        out = next(device_prefetch(iter(src), size=1))
+        # already a device array (transfer happened in the worker);
+        # device_put without an explicit device leaves it uncommitted,
+        # which is what the jitted step wants (free to keep placement)
+        assert isinstance(out["x"], jax.Array)
+
+    def test_mesh_placement_from_calling_thread(self):
+        """active_mesh() is thread-local; the prefetch worker must still
+        place batches with the caller's mesh."""
+        from alphafold2_tpu.parallel import make_mesh, use_mesh
+        from alphafold2_tpu.train import device_prefetch, shard_batch
+
+        mesh = make_mesh(2, 2, 2)
+        src = [{"x": np.arange(8, dtype=np.float32).reshape(2, 4)}]
+        with use_mesh(mesh):
+            out = next(device_prefetch(iter(src), size=1))
+            want = shard_batch(src[0], mesh)
+        assert out["x"].sharding == want["x"].sharding
+        assert np.allclose(np.asarray(out["x"]), src[0]["x"])
+
+    def test_fit_with_prefetch_trains(self):
+        model = Alphafold2(dim=32, depth=1, heads=2, dim_head=16)
+        batch = synthetic_batch(jax.random.PRNGKey(0), batch=1, seq_len=8,
+                                msa_depth=2, with_coords=True)
+        params = model.init(
+            {"params": jax.random.PRNGKey(1), "mlm": jax.random.PRNGKey(2)},
+            batch["seq"], msa=batch["msa"], mask=batch["mask"],
+            msa_mask=batch["msa_mask"], train=True)
+        state = TrainState.create(apply_fn=model.apply, params=params,
+                                  tx=adam(1e-3), rng=jax.random.PRNGKey(3))
+
+        def stream():
+            i = 0
+            while True:
+                yield synthetic_batch(jax.random.PRNGKey(i), batch=1,
+                                      seq_len=8, msa_depth=2,
+                                      with_coords=True)
+                i += 1
+
+        state, history = fit(model, state, stream(), num_steps=4,
+                             log_every=1, prefetch=2)
+        assert int(state.step) == 4
+        assert all(np.isfinite(h["loss"]) for h in history)
+
+
+class TestShardedCheckpoint:
+    def test_restore_preserves_mesh_sharding(self, tmp_path):
+        """Save a ZeRO/TP-sharded state, restore into a sharded target:
+        leaves come back with their NamedShardings and equal values."""
+        from alphafold2_tpu.parallel import (make_mesh,
+                                             shard_pytree_tp_zero, use_mesh)
+        from alphafold2_tpu.train import CheckpointManager
+
+        model = Alphafold2(dim=32, depth=1, heads=2, dim_head=16)
+        batch = synthetic_batch(jax.random.PRNGKey(0), batch=2, seq_len=8,
+                                msa_depth=2, with_coords=True)
+        mesh = make_mesh(2, 2, 2)
+
+        def build():
+            params = model.init(
+                {"params": jax.random.PRNGKey(1),
+                 "mlm": jax.random.PRNGKey(2)},
+                batch["seq"], msa=batch["msa"], mask=batch["mask"],
+                msa_mask=batch["msa_mask"], train=True)
+            return TrainState.create(apply_fn=model.apply, params=params,
+                                     tx=adam(1e-3),
+                                     rng=jax.random.PRNGKey(3))
+
+        with use_mesh(mesh):
+            state = shard_pytree_tp_zero(build(), mesh)
+            ck = CheckpointManager(str(tmp_path / "ck"))
+            ck.save(state, step=0)
+
+            target = shard_pytree_tp_zero(build(), mesh)
+            restored = ck.restore(target, step=0)
+
+        for a, b in zip(jax.tree.leaves(restored.params),
+                        jax.tree.leaves(state.params)):
+            assert a.sharding == b.sharding, (a.sharding, b.sharding)
+            assert np.allclose(np.asarray(a), np.asarray(b))
