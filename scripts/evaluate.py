@@ -1,0 +1,111 @@
+"""Fold-and-score entry point: the inference + eval-metrics stack
+(SURVEY.md §3.5 — the reference's closest analog is two manual recycling
+passes inside a test, test_attention.py:344-385; it has no eval CLI).
+
+Folds a sequence with recycling (predict.fold) and, when a reference
+PDB is given, reports CA RMSD / TM-score / GDT-TS / lDDT against it
+(Kabsch-aligned where applicable). With --checkpoint, weights come from
+an orbax checkpoint directory (scripts/train_*.py --config ... writes
+one); otherwise random init — useful for pipeline smoke tests only.
+
+Usage:
+    python scripts/evaluate.py --pdb tests/data/1h22_head.pdb \
+        [--config cfg.json] [--checkpoint DIR] [--recycles 3] \
+        [--out pred.pdb] [--json metrics.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pdb", required=True,
+                    help="reference PDB: supplies the sequence and the "
+                         "ground-truth CA trace to score against")
+    ap.add_argument("--config", default=None)
+    ap.add_argument("--checkpoint", default=None)
+    ap.add_argument("--recycles", type=int, default=3)
+    ap.add_argument("--out", default=None, help="write predicted CA PDB")
+    ap.add_argument("--json", default=None, help="write metrics JSON")
+    args = ap.parse_args(argv)
+
+    from alphafold2_tpu.config import Experiment
+    from alphafold2_tpu.core import geometry
+    from alphafold2_tpu.data import native
+    from alphafold2_tpu.predict import fold
+    from alphafold2_tpu.train import CheckpointManager, TrainState
+
+    if args.config:
+        with open(args.config) as f:
+            exp = Experiment.from_json(f.read())
+    else:
+        exp = Experiment()
+        exp.model.dim, exp.model.depth = 64, 2
+    exp.model.predict_coords = True
+
+    with open(args.pdb) as f:
+        seq_tok, coords14, atom_mask = native.parse_pdb(f.read())
+    n = len(seq_tok)
+    seq = jnp.asarray(seq_tok)[None]
+    mask = jnp.asarray(atom_mask[:, 1])[None]          # CA resolved
+    ca_true = jnp.asarray(coords14[:, 1])[None]        # (1, n, 3)
+
+    from alphafold2_tpu.parallel import use_mesh
+
+    model, tx, mesh = exp.build()
+    with use_mesh(mesh):
+        params = model.init(jax.random.PRNGKey(0), seq, msa=seq[:, None],
+                            mask=mask, msa_mask=mask[:, None])
+        if args.checkpoint:
+            # the CONFIG's tx, not a fresh adam: the opt_state pytree
+            # layout must match what the training script saved (e.g.
+            # MultiSteps wrapping under grad_accum_every)
+            state = TrainState.create(apply_fn=model.apply, params=params,
+                                      tx=tx, rng=jax.random.PRNGKey(1))
+            state = CheckpointManager(args.checkpoint).restore(state)
+            params = state.params
+
+        result = fold(model, params, seq, msa=seq[:, None], mask=mask,
+                      msa_mask=mask[:, None], num_recycles=args.recycles)
+    pred = result.coords
+
+    metrics = {
+        "n_residues": n,
+        "recycles": args.recycles,
+        "kabsch_rmsd": float(geometry.kabsch_rmsd(pred, ca_true,
+                                                  mask=mask)[0]),
+        "tm_score": float(geometry.kabsch_tm(pred, ca_true, mask=mask)[0]),
+        "gdt_ts": float(geometry.kabsch_gdt(pred, ca_true, mask=mask)[0]),
+        # lddt_ca is per-residue (b, n); report the masked mean
+        "lddt": float((geometry.lddt_ca(ca_true, pred, mask=mask)[0] *
+                       mask[0]).sum() / jnp.maximum(mask[0].sum(), 1)),
+        # masked like the structural metrics: confidence at unresolved
+        # (never-scored) positions must not skew the summary
+        "mean_confidence": float((result.confidence[0] * mask[0]).sum() /
+                                 jnp.maximum(mask[0].sum(), 1)),
+        "checkpoint": args.checkpoint,
+    }
+    print(json.dumps(metrics))
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(metrics, f, indent=2)
+    if args.out:
+        from alphafold2_tpu.data.pdb_io import coords2pdb
+        coords2pdb(np.asarray(seq[0]), np.asarray(pred[0]), name=args.out)
+    return metrics
+
+
+if __name__ == "__main__":
+    main()
