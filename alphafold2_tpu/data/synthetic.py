@@ -8,8 +8,6 @@ so the generator emits crop-sized tensors directly.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 

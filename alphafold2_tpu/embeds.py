@@ -15,7 +15,6 @@ instead of failing at import time.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
 
 import numpy as np
 

@@ -16,8 +16,6 @@ identically under a pjit mesh (see alphafold2_tpu/parallel).
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax.numpy as jnp
 from flax import linen as nn
 

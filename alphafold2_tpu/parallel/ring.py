@@ -16,12 +16,11 @@ that for (b, n, h, d) inputs sharded on n.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 
 def _block_attend(q, k, v, bias, acc, row_max, row_sum):

@@ -17,7 +17,6 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from alphafold2_tpu import constants
 from alphafold2_tpu.data.graph import prot_covalent_bond
 
 # idealized bond length by element pair (see core/nerf.py)

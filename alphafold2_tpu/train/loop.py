@@ -16,9 +16,6 @@ and .backward(); here the step is one jitted, pjit-shardable function:
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Optional
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
