@@ -268,10 +268,11 @@ class BlockSparseAttention(nn.Module):
     - dense + additive mask (default): correct at any size/mask;
     - the true block-skipping Pallas kernel
       (`ops.block_sparse.block_sparse_attention`, FLOPs ∝ nnz blocks)
-      when `ops.use_pallas_attention(True)` is on and the shape allows
-      (n divisible by `block`, no token mask — the kernel skips whole
-      blocks and has no in-block mask support). Exactness between the
-      backends: tests/test_ops.py::TestBlockSparseKernel.
+      when `ops.use_pallas_attention(True)` is on and n divides into
+      `block`s. Token masks ride into the kernel as per-key validity
+      (replayed across the folded head axis); masked-query rows are
+      unspecified on both backends. Exactness between the backends:
+      tests/test_ops.py::TestBlockSparseKernel.
     """
 
     dim: int
@@ -291,8 +292,7 @@ class BlockSparseAttention(nn.Module):
                          dim_head=self.dim_head, dtype=self.dtype,
                          name="attn")
 
-        if (pallas_attention_enabled() and mask is None
-                and n % self.block == 0):
+        if pallas_attention_enabled() and n % self.block == 0:
             from alphafold2_tpu.ops.block_sparse import (
                 block_sparse_attention)
             block_pattern = block_sparse_block_pattern(
@@ -302,6 +302,8 @@ class BlockSparseAttention(nn.Module):
             out = block_sparse_attention(
                 q.reshape(b * h, n, dh), k.reshape(b * h, n, dh),
                 v.reshape(b * h, n, dh), block_pattern,
+                k_mask=mask,                       # unrepeated; index map
+                heads=h,                           # replays across heads
                 scale=1.0,                         # project_qkv pre-scales
                 block=self.block,
                 interpret=jax.default_backend() == "cpu")

@@ -38,6 +38,7 @@ except Exception:  # pragma: no cover
     HAS_PALLAS = False
 
 NEG_INF = float("-inf")
+MASK_VALUE = -1e9  # matches ops/attention.py and the dense model path
 
 
 def plan_block_pattern(pattern: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -60,8 +61,15 @@ def plan_block_pattern(pattern: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return cols, valid
 
 
-def _kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
-            acc_ref, m_ref, l_ref, *, t_total, scale):
+def _kernel(cols_ref, valid_ref, *refs, t_total, scale, has_kmask):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    idx = 3
+    km_ref = refs[idx] if has_kmask else None
+    idx += int(has_kmask)
+    o_ref = refs[idx]
+    acc_ref, m_ref, l_ref = refs[idx + 1:]
+
     qb = pl.program_id(1)
     t = pl.program_id(2)
 
@@ -79,6 +87,10 @@ def _kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)   # (bq, bk)
+        if has_kmask:
+            # (1, bk) f32 row — stays >=2-D in VMEM, broadcasting over
+            # the query dim (same mask recipe as ops/attention.py)
+            logits = jnp.where(km_ref[0] > 0, logits, MASK_VALUE)
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, logits.max(axis=-1, keepdims=True))
         # exp(-inf - m_new) == 0 covers the first live step cleanly
@@ -92,6 +104,11 @@ def _kernel(cols_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(t == t_total - 1)
     def _finish():
+        # l >= 1 always: every q-block has >= 1 live k-block
+        # (plan_block_pattern), and even a fully-masked block contributes
+        # p = exp(-1e9 - (-1e9)) = 1 per key — fully-masked rows yield a
+        # mean of visited values (unspecified on every backend), never a
+        # zero division
         o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
 
 
@@ -101,6 +118,8 @@ def block_sparse_attention(
     v: jnp.ndarray,                # (B, N, D)
     pattern: np.ndarray,           # (nqb, nkb) bool, STATIC
     *,
+    k_mask: jnp.ndarray | None = None,   # (B // heads, N) key validity
+    heads: int = 1,
     scale: float | None = None,
     block: int = 128,
     interpret: bool = False,
@@ -110,9 +129,14 @@ def block_sparse_attention(
     `scale` multiplies q inside the kernel; default 1/sqrt(D) (the
     standard softmax temperature). Pass scale=1.0 for pre-scaled q —
     e.g. when fed from Attention.project_qkv, which scales at projection
-    time. Token masks are NOT supported here; the model-level wrapper
-    (attention_variants.BlockSparseAttention) falls back to the dense
-    path when a mask is present.
+    time. `k_mask` masks individual keys INSIDE live blocks (the padded
+    tail of a crop, per-sequence gaps) with the dense path's -1e9 fill;
+    it stays UNrepeated — shape (B // heads, N) with head folded
+    innermost into B — and the BlockSpec index map replays it across
+    heads at zero HBM cost (same contract as ops/attention.py's
+    fused_attention). Query-side masking is not applied — masked-query
+    rows are unspecified on every backend, matching the dense path's
+    contract.
 
     The Mosaic compile path (PrefetchScalarGridSpec + scalar-prefetch
     index maps) is exactness-tested in interpreter mode
@@ -131,20 +155,38 @@ def block_sparse_attention(
     t_total = cols.shape[1]
     if scale is None:
         scale = float(d) ** -0.5
+    has_kmask = k_mask is not None
+
+    qkv_spec = [
+        pl.BlockSpec((1, block, d),
+                     lambda bi, qb, t, cols, valid: (bi, qb, 0)),
+        pl.BlockSpec((1, block, d),
+                     lambda bi, qb, t, cols, valid:
+                     (bi, cols[qb, t], 0)),
+        pl.BlockSpec((1, block, d),
+                     lambda bi, qb, t, cols, valid:
+                     (bi, cols[qb, t], 0)),
+    ]
+    args = [jnp.asarray(cols), jnp.asarray(valid), q, k, v]
+    if has_kmask:
+        assert b % heads == 0, (b, heads)
+        assert k_mask.shape == (b // heads, n), \
+            (k_mask.shape, (b // heads, n))
+        # 3-D (B//heads, 1, N) f32, sliced (1, 1, block) per live block
+        # and replayed across the folded head axis by the index map —
+        # mirrors fused_attention's mask recipe (stays >=2-D in VMEM;
+        # Mosaic v5e cannot reshape 1-bit/1-D vectors on the minor dim)
+        args.append(k_mask.astype(jnp.float32)
+                    .reshape(b // heads, 1, n))
+        qkv_spec.append(pl.BlockSpec(
+            (1, 1, block),
+            lambda bi, qb, t, cols, valid:
+            (bi // heads, 0, cols[qb, t])))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, nqb, t_total),
-        in_specs=[
-            pl.BlockSpec((1, block, d),
-                         lambda bi, qb, t, cols, valid: (bi, qb, 0)),
-            pl.BlockSpec((1, block, d),
-                         lambda bi, qb, t, cols, valid:
-                         (bi, cols[qb, t], 0)),
-            pl.BlockSpec((1, block, d),
-                         lambda bi, qb, t, cols, valid:
-                         (bi, cols[qb, t], 0)),
-        ],
+        in_specs=qkv_spec,
         out_specs=pl.BlockSpec((1, block, d),
                                lambda bi, qb, t, cols, valid: (bi, qb, 0)),
         scratch_shapes=[
@@ -153,7 +195,8 @@ def block_sparse_attention(
             pltpu.VMEM((block, 1), jnp.float32),   # denominator
         ],
     )
-    kernel = functools.partial(_kernel, t_total=t_total, scale=scale)
+    kernel = functools.partial(_kernel, t_total=t_total, scale=scale,
+                               has_kmask=has_kmask)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -161,4 +204,4 @@ def block_sparse_attention(
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(jnp.asarray(cols), jnp.asarray(valid), q, k, v)
+    )(*args)
