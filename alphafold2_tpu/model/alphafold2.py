@@ -37,7 +37,7 @@ from alphafold2_tpu.model.attention_variants import (
 )
 from alphafold2_tpu.model.evoformer import Evoformer, PairwiseAttentionBlock
 from alphafold2_tpu.model.mlm import MLM
-from alphafold2_tpu.model.primitives import Attention, LayerNorm
+from alphafold2_tpu.model.primitives import Attention, Dense, LayerNorm
 from alphafold2_tpu.model.refiners import Refiner
 from alphafold2_tpu.model.structure import StructureModule
 from alphafold2_tpu.parallel.sharding import shard_msa, shard_pair
@@ -203,7 +203,7 @@ class Alphafold2(nn.Module):
         def project_embed(e, prefix):
             e = e.astype(self.dtype)
             if e.shape[-1] != self.dim:
-                e = nn.Dense(self.dim, param_dtype=jnp.float32,
+                e = Dense(self.dim, param_dtype=jnp.float32,
                              dtype=self.dtype,
                              name=f"{prefix}_{e.shape[-1]}")(e)
             return e
@@ -243,7 +243,7 @@ class Alphafold2(nn.Module):
             if msa_mask is None:
                 msa_mask = jnp.ones_like(msa, dtype=bool)
         elif embedds is not None:
-            m = nn.Dense(self.dim, param_dtype=jnp.float32, dtype=self.dtype,
+            m = Dense(self.dim, param_dtype=jnp.float32, dtype=self.dtype,
                          name="embedd_project")(embedds.astype(self.dtype))
             if msa_mask is None:
                 msa_mask = jnp.ones(embedds.shape[:-1], dtype=bool)
@@ -252,7 +252,7 @@ class Alphafold2(nn.Module):
         m = shard_msa(m)
 
         # pairwise representation by outer sum (reference alphafold2.py:715-717)
-        x_pair_proj = nn.Dense(self.dim * 2, param_dtype=jnp.float32,
+        x_pair_proj = Dense(self.dim * 2, param_dtype=jnp.float32,
                                dtype=self.dtype, name="to_pairwise_repr")(
                                    x_single)
         x_left, x_right = jnp.split(x_pair_proj, 2, axis=-1)
@@ -295,7 +295,7 @@ class Alphafold2(nn.Module):
         # templates (reference alphafold2.py:743-785)
         if templates_feats is not None:
             num_templates = templates_feats.shape[1]
-            t = nn.Dense(self.dim, param_dtype=jnp.float32, dtype=self.dtype,
+            t = Dense(self.dim, param_dtype=jnp.float32, dtype=self.dtype,
                          name="to_template_embed")(
                              templates_feats.astype(self.dtype))
             t_mask_crossed = templates_mask[:, :, :, None] & \
@@ -340,10 +340,10 @@ class Alphafold2(nn.Module):
         # alphafold2.py:782-785)
         if templates_angles is not None:
             t_angs = templates_angles.astype(self.dtype)
-            t_angle_feats = nn.Dense(
+            t_angle_feats = Dense(
                 self.dim, param_dtype=jnp.float32, dtype=self.dtype,
                 name="template_angle_mlp_in")(t_angs)
-            t_angle_feats = nn.Dense(
+            t_angle_feats = Dense(
                 self.dim, param_dtype=jnp.float32, dtype=self.dtype,
                 name="template_angle_mlp_out")(jax.nn.gelu(t_angle_feats))
             m = jnp.concatenate([m, t_angle_feats], axis=1)
@@ -395,7 +395,7 @@ class Alphafold2(nn.Module):
             if msa is not None or embedds is None:
                 # embedd_project ran only on the (msa-absent, embedds-given)
                 # path; create it otherwise
-                nn.Dense(self.dim, param_dtype=jnp.float32, dtype=self.dtype,
+                Dense(self.dim, param_dtype=jnp.float32, dtype=self.dtype,
                          name="embedd_project")(zf(1, 1, 1, self.num_embedds))
             # projector coverage for every known pretrained-LM width plus
             # the configured num_embedds (skip widths this trace created)
@@ -405,11 +405,11 @@ class Alphafold2(nn.Module):
             msa_w = None if msa_embed is None else msa_embed.shape[-1]
             for w in sorted(widths):
                 if w != seq_w:
-                    nn.Dense(self.dim, param_dtype=jnp.float32,
+                    Dense(self.dim, param_dtype=jnp.float32,
                              dtype=self.dtype,
                              name=f"seq_embed_project_{w}")(zf(1, 1, w))
                 if w != msa_w:
-                    nn.Dense(self.dim, param_dtype=jnp.float32,
+                    Dense(self.dim, param_dtype=jnp.float32,
                              dtype=self.dtype,
                              name=f"msa_embed_project_{w}")(zf(1, 1, 1, w))
             if not (train and original_msa is not None):
@@ -425,7 +425,7 @@ class Alphafold2(nn.Module):
                          name="recycling_distance_embed")(
                              jnp.zeros((1, 1, 1), jnp.int32))
             if templates_feats is None:
-                t_d = nn.Dense(self.dim, param_dtype=jnp.float32,
+                t_d = Dense(self.dim, param_dtype=jnp.float32,
                                dtype=self.dtype, name="to_template_embed")(
                                    zf(1, 1, 1, self.templates_dim))
                 t_d = PairwiseAttentionBlock(
@@ -436,10 +436,10 @@ class Alphafold2(nn.Module):
                           name="template_pointwise_attn")(
                               zf(1, 1, self.dim), context=zf(1, 1, self.dim))
             if templates_angles is None:
-                a = nn.Dense(self.dim, param_dtype=jnp.float32,
+                a = Dense(self.dim, param_dtype=jnp.float32,
                              dtype=self.dtype, name="template_angle_mlp_in")(
                                  zf(1, 1, 1, self.templates_angles_feats_dim))
-                nn.Dense(self.dim, param_dtype=jnp.float32, dtype=self.dtype,
+                Dense(self.dim, param_dtype=jnp.float32, dtype=self.dtype,
                          name="template_angle_mlp_out")(jax.nn.gelu(a))
             if extra_msa is None:
                 Evoformer(dim=self.dim, depth=self.extra_msa_evoformer_layers,
@@ -455,10 +455,10 @@ class Alphafold2(nn.Module):
         # theta / phi heads before symmetrization (reference alphafold2.py:815-817)
         x_f32 = x.astype(jnp.float32)
         if self.predict_angles:
-            ret_kwargs["theta"] = nn.Dense(
+            ret_kwargs["theta"] = Dense(
                 constants.THETA_BUCKETS, param_dtype=jnp.float32,
                 name="to_prob_theta")(x_f32)
-            ret_kwargs["phi"] = nn.Dense(
+            ret_kwargs["phi"] = Dense(
                 constants.PHI_BUCKETS, param_dtype=jnp.float32,
                 name="to_prob_phi")(x_f32)
 
@@ -466,7 +466,7 @@ class Alphafold2(nn.Module):
         trunk_embeds = (x_f32 + x_f32.swapaxes(1, 2)) * 0.5
         distance_pred = LayerNorm(
             dtype=jnp.float32, name="distogram_norm")(trunk_embeds)
-        distance_pred = nn.Dense(
+        distance_pred = Dense(
             constants.DISTOGRAM_BUCKETS, param_dtype=jnp.float32,
             name="to_distogram_logits")(distance_pred)
         ret_kwargs["distance"] = distance_pred
@@ -480,7 +480,7 @@ class Alphafold2(nn.Module):
         # omega head (reference alphafold2.py:834-836)
         if self.predict_angles:
             omega_input = trunk_embeds if self.symmetrize_omega else x_f32
-            ret_kwargs["omega"] = nn.Dense(
+            ret_kwargs["omega"] = Dense(
                 constants.OMEGA_BUCKETS, param_dtype=jnp.float32,
                 name="to_prob_omega")(omega_input)
 
@@ -493,10 +493,10 @@ class Alphafold2(nn.Module):
         # single / pairwise projections for the structure module
         # (reference alphafold2.py:843-851); fp32 island from here on
         single_msa_repr_row = m[:, 0]
-        single_repr = nn.Dense(self.dim, param_dtype=jnp.float32,
+        single_repr = Dense(self.dim, param_dtype=jnp.float32,
                                name="msa_to_single_repr_dim")(
                                    single_msa_repr_row.astype(jnp.float32))
-        pairwise_repr = nn.Dense(self.dim, param_dtype=jnp.float32,
+        pairwise_repr = Dense(self.dim, param_dtype=jnp.float32,
                                  name="trunk_to_pairwise_repr_dim")(
                                      x.astype(jnp.float32))
 
@@ -529,7 +529,7 @@ class Alphafold2(nn.Module):
 
         # confidence head always built (cheap Dense(1)) so one params tree
         # serves every return configuration
-        confidence = nn.Dense(1, param_dtype=jnp.float32,
+        confidence = Dense(1, param_dtype=jnp.float32,
                               name="lddt_linear")(single_out)
         ret_kwargs["confidence"] = confidence
 

@@ -34,6 +34,7 @@ from flax import linen as nn
 from jax import nn as jnn
 
 from alphafold2_tpu.model.primitives import (
+    Dense,
     MASK_VALUE,
     LayerNorm,
     attention_output_tail,
@@ -42,7 +43,7 @@ from alphafold2_tpu.model.primitives import (
 
 
 def _dense_factory(module_dtype):
-    return lambda f, name, use_bias=True, **kw: nn.Dense(
+    return lambda f, name, use_bias=True, **kw: Dense(
         f, use_bias=use_bias, dtype=module_dtype,
         param_dtype=jnp.float32, name=name, **kw)
 
@@ -226,7 +227,7 @@ class MultiKernelConvBlock(nn.Module):
                     dtype=self.dtype, param_dtype=jnp.float32,
                     name=f"conv_{kh}x{kw}_d{d}")(h))
         h = jnn.gelu(sum(branches) / len(branches))
-        out = nn.Dense(self.dim, kernel_init=zeros_init(),
+        out = Dense(self.dim, kernel_init=zeros_init(),
                        bias_init=zeros_init(), dtype=self.dtype,
                        param_dtype=jnp.float32, name="proj_out")(h)
         if mask is not None:

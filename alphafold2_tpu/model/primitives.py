@@ -31,6 +31,21 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax import nn as jnn
 
+
+def Dense(features, **kw):
+    """`nn.Dense` whose contraction may route to the AMX host GEMM.
+
+    Identical to `flax.linen.Dense` (same params tree — flax names the
+    returned module by its class, `Dense_N`) except the contraction goes
+    through `ops.cpu_gemm.amx_dense_dot_general`, which dispatches eligible
+    f32 GEMMs to the native AMX kernel on the XLA:CPU fallback path and is
+    `lax.dot_general` bit-for-bit everywhere else (TPU path unchanged).
+    """
+    if "dot_general" not in kw:
+        from alphafold2_tpu.ops.cpu_gemm import amx_dense_dot_general
+        kw["dot_general"] = amx_dense_dot_general
+    return nn.Dense(features, **kw)
+
 # Large-negative fill for masked logits; -finfo.max in the reference
 # (alphafold2.py:165). A fixed large constant is safer in bf16.
 MASK_VALUE = -1e9
@@ -75,13 +90,13 @@ class FeedForward(nn.Module):
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
         x = LayerNorm(dtype=self.dtype)(x)
-        x = nn.Dense(self.dim * self.mult * 2, dtype=self.dtype,
+        x = Dense(self.dim * self.mult * 2, dtype=self.dtype,
                      param_dtype=jnp.float32)(x)
         x = GEGLU()(x)
         x = nn.Dropout(self.dropout, deterministic=deterministic)(x)
         # zero-initialized output projection: the block starts as identity
         # w.r.t. the residual stream (reference init_zero_, alphafold2.py:90)
-        x = nn.Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
+        x = Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
                      kernel_init=zeros_init(), bias_init=zeros_init())(x)
         return x
 
@@ -118,7 +133,7 @@ class Attention(nn.Module):
 
     def setup(self):
         inner = self.heads * self.dim_head
-        dense = lambda features, name, use_bias=True, **kw: nn.Dense(
+        dense = lambda features, name, use_bias=True, **kw: Dense(
             features, use_bias=use_bias, dtype=self.dtype,
             param_dtype=jnp.float32, name=name, **kw)
         self._to_q = dense(inner, "to_q", use_bias=False)
@@ -332,7 +347,7 @@ class AxialAttention(nn.Module):
 
         bias = None
         if self.accept_edges and edges is not None:
-            bias = nn.Dense(self.heads, use_bias=False, dtype=self.dtype,
+            bias = Dense(self.heads, use_bias=False, dtype=self.dtype,
                             param_dtype=jnp.float32,
                             name="edges_to_attn_bias")(edges)
             bias = bias.transpose(0, 3, 1, 2)  # (b, heads, i, j)
@@ -381,7 +396,7 @@ class AxialAttention(nn.Module):
         if self.accept_edges and edges is not None:
             # (b, i, j, d) -> per-head bias (b, heads, i, j), tiled over the
             # folded axis (reference alphafold2.py:214-217, :246-248)
-            bias = nn.Dense(self.heads, use_bias=False, dtype=self.dtype,
+            bias = Dense(self.heads, use_bias=False, dtype=self.dtype,
                             param_dtype=jnp.float32,
                             name="edges_to_attn_bias")(edges)
             attn_bias = bias.transpose(0, 3, 1, 2)  # (b, heads, i, j)
@@ -421,7 +436,7 @@ class TriangleMultiplicativeModule(nn.Module):
         assert x.shape[1] == x.shape[2], "feature map must be square"
         hidden = self.hidden_dim or self.dim
 
-        dense = lambda features, name, **kw: nn.Dense(
+        dense = lambda features, name, **kw: Dense(
             features, dtype=self.dtype, param_dtype=jnp.float32,
             name=name, **kw)
 
@@ -479,9 +494,9 @@ class OuterMean(nn.Module):
     def __call__(self, x, mask=None):
         hidden = self.hidden_dim or self.dim
         x = LayerNorm(dtype=self.dtype)(x)
-        left = nn.Dense(hidden, dtype=self.dtype, param_dtype=jnp.float32,
+        left = Dense(hidden, dtype=self.dtype, param_dtype=jnp.float32,
                         name="left_proj")(x)
-        right = nn.Dense(hidden, dtype=self.dtype, param_dtype=jnp.float32,
+        right = Dense(hidden, dtype=self.dtype, param_dtype=jnp.float32,
                          name="right_proj")(x)
 
         if mask is not None:
@@ -500,5 +515,5 @@ class OuterMean(nn.Module):
             outer = jnp.einsum("bmid,bmjd->bijd", left, right)
             outer = outer / x.shape[1]
 
-        return nn.Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
+        return Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
                         name="proj_out")(outer)

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from alphafold2_tpu.model.primitives import LayerNorm, zeros_init
+from alphafold2_tpu.model.primitives import Dense, LayerNorm, zeros_init
 
 
 def _safe_norm2(v, eps=1e-8):
@@ -60,10 +60,10 @@ class EGNNLayer(nn.Module):
             feats.append(edges)
         msg_in = jnp.concatenate(feats, axis=-1)
 
-        msg = nn.Dense(hidden, param_dtype=jnp.float32, name="edge_mlp_in")(
+        msg = Dense(hidden, param_dtype=jnp.float32, name="edge_mlp_in")(
             msg_in)
         msg = jax.nn.silu(msg)
-        msg = nn.Dense(hidden, param_dtype=jnp.float32, name="edge_mlp_out")(
+        msg = Dense(hidden, param_dtype=jnp.float32, name="edge_mlp_out")(
             msg)
         msg = jax.nn.silu(msg)
 
@@ -76,7 +76,7 @@ class EGNNLayer(nn.Module):
 
         # equivariant coordinate update, zero-init scale so the layer starts
         # as identity on coordinates
-        coor_w = nn.Dense(1, param_dtype=jnp.float32, use_bias=False,
+        coor_w = Dense(1, param_dtype=jnp.float32, use_bias=False,
                           kernel_init=zeros_init(), name="coor_mlp")(msg)
         coor_w = jnp.tanh(coor_w) * self.coor_clamp
         denom = jnp.maximum(
@@ -87,10 +87,10 @@ class EGNNLayer(nn.Module):
         # invariant feature update
         agg = msg.sum(axis=2) / denom
         h_in = jnp.concatenate([h, agg], axis=-1)
-        dh = nn.Dense(hidden, param_dtype=jnp.float32, name="node_mlp_in")(
+        dh = Dense(hidden, param_dtype=jnp.float32, name="node_mlp_in")(
             h_in)
         dh = jax.nn.silu(dh)
-        dh = nn.Dense(self.dim, param_dtype=jnp.float32, name="node_mlp_out")(
+        dh = Dense(self.dim, param_dtype=jnp.float32, name="node_mlp_out")(
             dh)
         return h + dh, x
 
@@ -114,11 +114,11 @@ class EnAttentionLayer(nn.Module):
         inner = hd * nh
 
         hn = LayerNorm(name="norm")(h)
-        q = nn.Dense(inner, use_bias=False, param_dtype=jnp.float32,
+        q = Dense(inner, use_bias=False, param_dtype=jnp.float32,
                      name="to_q")(hn).reshape(b, n, nh, hd)
-        k = nn.Dense(inner, use_bias=False, param_dtype=jnp.float32,
+        k = Dense(inner, use_bias=False, param_dtype=jnp.float32,
                      name="to_k")(hn).reshape(b, n, nh, hd)
-        v = nn.Dense(inner, use_bias=False, param_dtype=jnp.float32,
+        v = Dense(inner, use_bias=False, param_dtype=jnp.float32,
                      name="to_v")(hn).reshape(b, n, nh, hd)
 
         rel = x[:, :, None, :] - x[:, None, :, :]
@@ -126,11 +126,11 @@ class EnAttentionLayer(nn.Module):
 
         logits = jnp.einsum("bihd,bjhd->bhij", q, k) * (hd ** -0.5)
         # distance-aware bias (+ optional pair-rep edge bias)
-        dist_bias = nn.Dense(nh, param_dtype=jnp.float32,
+        dist_bias = Dense(nh, param_dtype=jnp.float32,
                              name="dist_to_bias")(jnp.log(dist2))
         logits = logits + dist_bias.transpose(0, 3, 1, 2)
         if edges is not None:
-            logits = logits + nn.Dense(
+            logits = logits + Dense(
                 nh, use_bias=False, param_dtype=jnp.float32,
                 name="edge_to_bias")(edges).transpose(0, 3, 1, 2)
 
@@ -141,12 +141,12 @@ class EnAttentionLayer(nn.Module):
         attn = jax.nn.softmax(logits, axis=-1)              # (b, h, i, j)
 
         out = jnp.einsum("bhij,bjhd->bihd", attn, v).reshape(b, n, inner)
-        h = h + nn.Dense(self.dim, param_dtype=jnp.float32,
+        h = h + Dense(self.dim, param_dtype=jnp.float32,
                          kernel_init=zeros_init(), bias_init=zeros_init(),
                          name="to_out")(out)
 
         # equivariant coordinate update weighted by mean attention
-        coor_w = nn.Dense(1, use_bias=False, param_dtype=jnp.float32,
+        coor_w = Dense(1, use_bias=False, param_dtype=jnp.float32,
                           kernel_init=zeros_init(), name="coor_mlp")(
                               attn.mean(1)[..., None])
         coor_w = jnp.tanh(coor_w) * self.coor_clamp

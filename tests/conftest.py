@@ -25,6 +25,15 @@ os.environ.pop("PYTHONPATH", None)
 
 import jax  # noqa: E402
 
+# Under a bare `python -m pytest tests` the axon sitecustomize hook has
+# ALREADY imported jax at interpreter start (PYTHONPATH=/root/.axon_site),
+# so jax's config captured JAX_PLATFORMS=axon before the env scrub above
+# could matter — first backend use then dials the (possibly wedged) TPU
+# tunnel and hangs with 0% CPU. Backends are not initialized yet at
+# conftest time, so forcing the config value directly makes the bare
+# invocation as safe as the scrubbed one (round-3 VERDICT weak #5).
+jax.config.update("jax_platforms", "cpu")
+
 jax.config.update("jax_default_matmul_precision", "float32")
 
 # persistent compilation cache: the suite is compile-dominated (many tiny
