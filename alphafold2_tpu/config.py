@@ -36,6 +36,20 @@ class ModelConfig:
     pipeline_stages: int = 1          # GPipe trunk stages (mesh pipe axis)
     pipeline_microbatches: int = 0
     use_conv: bool = False            # trRosetta2-style trunk conv blocks
+    # README-era efficient-attention menu for the MSA row track: bools
+    # (all layers) or per-layer lists, e.g. sparse_self_attn =
+    # [true, false, true, false] interleaves sparse and full layers
+    # (reference README.md:388-487; Evoformer documents semantics).
+    # kv_compress_ratio: 0 = off.
+    sparse_self_attn: Any = False
+    linear_attn: Any = False
+    kron_attn: Any = False
+    kv_compress_ratio: Any = 0
+    linear_attn_kind: str = "favor"   # "favor" (Performer) | "elu"
+    performer_nb_features: int = 256
+    sparse_block: int = 32
+    sparse_num_global: int = 1
+    sparse_window: int = 1
     extra_msa_evoformer_layers: int = 4
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0

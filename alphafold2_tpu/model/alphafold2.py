@@ -124,6 +124,24 @@ class Alphafold2(nn.Module):
     conv_seq_kernels: tuple = DEFAULT_CONV_SEQ_KERNELS
     conv_msa_kernels: tuple = DEFAULT_CONV_MSA_KERNELS
     conv_dilations: tuple = (1,)
+    # README-era efficient-attention menu for the main trunk's MSA row
+    # track (reference README.md:388-487; Evoformer documents the
+    # semantics). Bools (all layers) or per-layer tuples — e.g.
+    # `sparse_self_attn=(True, False) * 3` interleaves sparse and full
+    # (README.md:415). kv_compress_ratio: 0 = off (README.md:485).
+    # Reference-name mapping (MIGRATING.md): sparse_self_attn ->
+    # sparse_self_attn, cross_attn_linear -> linear_attn,
+    # cross_attn_kron_primary/_msa -> kron_attn,
+    # cross_attn_compress_ratio -> kv_compress_ratio.
+    sparse_self_attn: Any = False
+    linear_attn: Any = False
+    kron_attn: Any = False
+    kv_compress_ratio: Any = 0
+    sparse_block: int = 32
+    sparse_num_global: int = 1
+    sparse_window: int = 1
+    linear_attn_kind: str = "favor"
+    performer_nb_features: int = 256
     # reproduce the reference's masked-OuterMean double division
     # (alphafold2.py:347 + the always-synthesized msa_mask at :703);
     # required for exact parity with reference-trained checkpoints
@@ -378,6 +396,15 @@ class Alphafold2(nn.Module):
             conv_seq_kernels=self.conv_seq_kernels,
             conv_msa_kernels=self.conv_msa_kernels,
             conv_dilations=self.conv_dilations,
+            sparse_self_attn=self.sparse_self_attn,
+            linear_attn=self.linear_attn,
+            kron_attn=self.kron_attn,
+            kv_compress_ratio=self.kv_compress_ratio,
+            sparse_block=self.sparse_block,
+            sparse_num_global=self.sparse_num_global,
+            sparse_window=self.sparse_window,
+            linear_attn_kind=self.linear_attn_kind,
+            performer_nb_features=self.performer_nb_features,
             dtype=self.dtype,
             reversible=self.reversible, use_scan=self.use_scan,
             pipeline_stages=self.pipeline_stages,

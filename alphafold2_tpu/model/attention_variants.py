@@ -91,6 +91,104 @@ class LinearAttention(nn.Module):
                                      self.dim)
 
 
+def orthogonal_random_features(key, nb_features: int, dim: int):
+    """FAVOR+ projection matrix (nb_features, dim): rows are orthogonal
+    within each dim-sized block (QR of a Gaussian), with row norms
+    redrawn chi(dim) — the unbiased orthogonal random features of
+    Choromanski et al. 2021 (the reference's performer-pytorch
+    gaussian_orthogonal_random_matrix, README.md:419-449)."""
+    n_blocks = -(-nb_features // dim)
+    keys = jax.random.split(key, n_blocks + 1)
+    blocks = []
+    for i in range(n_blocks):
+        g = jax.random.normal(keys[i], (dim, dim))
+        q, _ = jnp.linalg.qr(g)
+        blocks.append(q.T)
+    w = jnp.concatenate(blocks, axis=0)[:nb_features]
+    norms = jnp.sqrt(jax.random.chisquare(keys[-1], dim, (nb_features, 1)))
+    return w * norms
+
+
+def favor_softmax_features(x, proj, is_query: bool, eps: float = 1e-4):
+    """Positive softmax-kernel features phi(x) (FAVOR+, Choromanski et al.
+    2021 eq. 5): phi(x) = exp(Wx - ||x||^2/2 - c) / sqrt(m), giving the
+    unbiased estimator E[phi(q)^T phi(k)] = exp(q . k).
+
+    x: (..., n, d) already scaled by d^-1/4 (so q.k carries the 1/sqrt(d)
+    softmax temperature). Stabilizer c: per-token max for queries (cancels
+    in the attention ratio), global max for keys (uniform scale, also
+    cancels)."""
+    m = proj.shape[0]
+    u = x @ proj.T                                     # (..., n, m)
+    sq = (x * x).sum(-1, keepdims=True) / 2.0
+    h = u - sq
+    if is_query:
+        c = jax.lax.stop_gradient(h.max(-1, keepdims=True))
+    else:
+        c = jax.lax.stop_gradient(h.max())
+    return (jnp.exp(h - c) + eps) / jnp.sqrt(m)
+
+
+class PerformerAttention(nn.Module):
+    """FAVOR+ attention (the reference's cross_attn_linear Performer,
+    README.md:419-449): unbiased softmax-kernel approximation via
+    orthogonal random features — O(n m d) instead of O(n^2 d), with the
+    approximation error shrinking as `nb_features` grows
+    (tests/test_attention_menu.py::test_favor_error_shrinks_with_features).
+
+    Redraw hook: the projection is drawn from the 'performer' RNG
+    collection when provided — `module.apply(params, x,
+    rngs={"performer": key})` redraws per call (the JAX form of
+    performer-pytorch's redraw_projections interval); without it a fixed
+    fallback key keeps features deterministic across steps.
+    """
+
+    dim: int
+    heads: int = 8
+    dim_head: int = 64
+    nb_features: int = 256
+    gating: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, context=None, mask=None, context_mask=None):
+        dense = _dense_factory(self.dtype)
+        ctx = x if context is None else context
+        q, k, v = _qkv(dense, x, ctx, self.heads, self.dim_head)
+        # FAVOR splits the softmax temperature as d^-1/4 on each of q and
+        # k so phi(q)^T phi(k) estimates exp(q.k / sqrt(d)); features run
+        # in f32 (exp of differences — bf16 rounding hurts here)
+        scale = self.dim_head ** 0.25
+        q = (q / scale).astype(jnp.float32)
+        k = (k / scale).astype(jnp.float32)
+
+        if self.has_rng("performer"):
+            feat_key = self.make_rng("performer")
+        else:
+            feat_key = jax.random.PRNGKey(0)
+        proj = orthogonal_random_features(feat_key, self.nb_features,
+                                          self.dim_head)
+
+        phi_q = favor_softmax_features(q, proj, is_query=True)
+        phi_k = favor_softmax_features(k, proj, is_query=False)
+
+        kmask = context_mask if context is not None else mask
+        if kmask is not None:
+            w = kmask[:, None, :, None]
+            phi_k = phi_k * w
+            v = v * w
+
+        kv = jnp.einsum("bhnm,bhne->bhme", phi_k, v.astype(jnp.float32))
+        z = jnp.einsum("bhnm,bhm->bhn", phi_q, phi_k.sum(-2))
+        out = jnp.einsum("bhnm,bhme->bhne", phi_q, kv) / \
+            jnp.maximum(z[..., None], 1e-6)
+        out = out.astype(self.dtype)
+
+        inner = self.heads * self.dim_head
+        return attention_output_tail(dense, out, x, inner, self.gating,
+                                     self.dim)
+
+
 class MemoryCompressedAttention(nn.Module):
     """Standard attention with mean-pooled K/V (compression ratio r)."""
 

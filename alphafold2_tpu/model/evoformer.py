@@ -72,14 +72,14 @@ class PairwiseAttentionBlock(nn.Module):
         x = AxialAttention(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
             row_attn=True, col_attn=False, accept_edges=True,
-            ring_axes=ring_axes,
+            dropout=self.dropout, ring_axes=ring_axes,
             dtype=self.dtype, name="triangle_attention_outgoing",
         )(x, edges=x, mask=mask, deterministic=deterministic) + x
         x = AxialAttention(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
             row_attn=False, col_attn=True, accept_edges=True,
             global_query_attn=self.global_column_attn,
-            ring_axes=ring_axes,
+            dropout=self.dropout, ring_axes=ring_axes,
             dtype=self.dtype, name="triangle_attention_ingoing",
         )(x, edges=x, mask=mask, deterministic=deterministic) + x
         return shard_pair(x)
@@ -93,6 +93,28 @@ class MsaAttentionBlock(nn.Module):
     axis) ring-parallel instead of letting GSPMD all-gather the full
     residue axis (round-2 VERDICT next-round #5). Column attention is
     over the alignment axis, which is never mesh-sharded — dense there.
+
+    `row_variant` swaps the residue-axis row attention for one of the
+    README-era efficient variants (reference README.md:388-487 — there
+    they applied to the pre-Evoformer sequence/MSA self- and cross-
+    attention; here the residue axis is where the O(n^2) pressure lives):
+
+    - "full"     — pair-biased axial attention (the default Evoformer row
+                   attention; the only variant that consumes pair edges);
+    - "sparse"   — `BlockSparseAttention` local+global block pattern (the
+                   DeepSpeed sparse-self-attn analog, README.md:388-417;
+                   dispatches to the Pallas block-skipping kernel under
+                   `ops.use_pallas_attention(True)`);
+    - "linear"   — kernelized linear attention (Performer slot,
+                   README.md:419-449);
+    - "compress" — memory-compressed attention, K/V mean-pooled by
+                   `kv_compress_ratio` (README.md:475-487);
+    - "kron"     — cross-attention onto the axial-pooled (H+W token) pair
+                   map (README.md:451-468's Kronecker operator, re-aimed
+                   at the Evoformer's pair context).
+
+    The non-full variants do not take the pair-edge bias — matching the
+    README-era modules, which had no pair track to be biased by.
     """
 
     dim: int
@@ -100,23 +122,90 @@ class MsaAttentionBlock(nn.Module):
     dim_head: int = 64
     dropout: float = 0.0
     ring_attention: bool = False
+    row_variant: str = "full"
+    sparse_block: int = 32
+    sparse_num_global: int = 1
+    sparse_window: int = 1
+    kv_compress_ratio: int = 2
+    # "linear" row variant backend: "favor" = FAVOR+ Performer (unbiased
+    # softmax approximation, the reference's cross_attn_linear), "elu" =
+    # the cheap deterministic elu+1 kernel
+    linear_attn_kind: str = "favor"
+    performer_nb_features: int = 256
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, mask=None, pairwise_repr=None,
+    def __call__(self, x, mask=None, pairwise_repr=None, pair_mask=None,
                  deterministic: bool = True):
+        if self.row_variant == "full":
+            x = AxialAttention(
+                dim=self.dim, heads=self.heads, dim_head=self.dim_head,
+                row_attn=True, col_attn=False, accept_edges=True,
+                dropout=self.dropout,
+                ring_axes=(None, PAIR_I_AXIS) if self.ring_attention
+                else None,
+                dtype=self.dtype, name="row_attn",
+            )(x, mask=mask, edges=pairwise_repr,
+              deterministic=deterministic) + x
+        else:
+            x = self._row_variant_attn(x, mask, pairwise_repr,
+                                       pair_mask) + x
         x = AxialAttention(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
-            row_attn=True, col_attn=False, accept_edges=True,
-            ring_axes=(None, PAIR_I_AXIS) if self.ring_attention else None,
-            dtype=self.dtype, name="row_attn",
-        )(x, mask=mask, edges=pairwise_repr, deterministic=deterministic) + x
-        x = AxialAttention(
-            dim=self.dim, heads=self.heads, dim_head=self.dim_head,
-            row_attn=False, col_attn=True,
+            row_attn=False, col_attn=True, dropout=self.dropout,
             dtype=self.dtype, name="col_attn",
         )(x, mask=mask, deterministic=deterministic) + x
         return shard_msa(x)
+
+    def _row_variant_attn(self, x, mask, pairwise_repr, pair_mask):
+        """Residue-axis attention via an efficient variant: alignment rows
+        fold into batch (as AxialAttention does), pre-LN applied here (the
+        variants are bare attention modules; AxialAttention normalizes
+        internally)."""
+        from alphafold2_tpu.model.attention_variants import (
+            BlockSparseAttention,
+            LinearAttention,
+            MemoryCompressedAttention,
+            kronecker_pool_2d,
+        )
+        from alphafold2_tpu.model.primitives import Attention, LayerNorm
+
+        b, rows, n, d = x.shape
+        h = LayerNorm(dtype=self.dtype, name="row_norm")(x)
+        hf = h.reshape(b * rows, n, d)
+        mf = None if mask is None else mask.reshape(b * rows, n)
+        kw = dict(dim=self.dim, heads=self.heads, dim_head=self.dim_head,
+                  dtype=self.dtype, name="row_attn")
+
+        if self.row_variant == "sparse":
+            out = BlockSparseAttention(
+                block=self.sparse_block, num_global=self.sparse_num_global,
+                window=self.sparse_window, **kw)(hf, mask=mf)
+        elif self.row_variant == "linear":
+            if self.linear_attn_kind == "favor":
+                from alphafold2_tpu.model.attention_variants import (
+                    PerformerAttention)
+                out = PerformerAttention(
+                    nb_features=self.performer_nb_features, **kw)(
+                        hf, mask=mf)
+            else:
+                out = LinearAttention(**kw)(hf, mask=mf)
+        elif self.row_variant == "compress":
+            out = MemoryCompressedAttention(
+                compress_ratio=self.kv_compress_ratio, **kw)(hf, mask=mf)
+        elif self.row_variant == "kron":
+            assert pairwise_repr is not None, \
+                "row_variant='kron' needs the pair representation"
+            pooled, tmask = kronecker_pool_2d(pairwise_repr, pair_mask)
+            # one pooled context per batch item, shared by its alignment
+            # rows (repeat matches the row-major fold of x above)
+            pooled = jnp.repeat(pooled, rows, axis=0)
+            tmask = jnp.repeat(tmask, rows, axis=0)
+            out = Attention(**kw)(hf, mask=mf, context=pooled,
+                                  context_mask=tmask)
+        else:
+            raise ValueError(f"unknown row_variant {self.row_variant!r}")
+        return out.reshape(b, rows, n, d)
 
 
 class EvoformerBlock(nn.Module):
@@ -141,6 +230,15 @@ class EvoformerBlock(nn.Module):
     conv_seq_kernels: tuple = DEFAULT_CONV_SEQ_KERNELS
     conv_msa_kernels: tuple = DEFAULT_CONV_MSA_KERNELS
     conv_dilations: tuple = (1,)
+    # README-era efficient-attention menu for the MSA row track
+    # (MsaAttentionBlock.row_variant documents the options)
+    msa_row_variant: str = "full"
+    sparse_block: int = 32
+    sparse_num_global: int = 1
+    sparse_window: int = 1
+    kv_compress_ratio: int = 2
+    linear_attn_kind: str = "favor"
+    performer_nb_features: int = 256
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -150,8 +248,16 @@ class EvoformerBlock(nn.Module):
         m = MsaAttentionBlock(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
             dropout=self.attn_dropout, ring_attention=self.ring_attention,
+            row_variant=self.msa_row_variant,
+            sparse_block=self.sparse_block,
+            sparse_num_global=self.sparse_num_global,
+            sparse_window=self.sparse_window,
+            kv_compress_ratio=self.kv_compress_ratio,
+            linear_attn_kind=self.linear_attn_kind,
+            performer_nb_features=self.performer_nb_features,
             dtype=self.dtype, name="msa_attn",
-        )(m, mask=msa_mask, pairwise_repr=x, deterministic=deterministic)
+        )(m, mask=msa_mask, pairwise_repr=x, pair_mask=mask,
+          deterministic=deterministic)
         m = FeedForward(dim=self.dim, dropout=self.ff_dropout,
                         dtype=self.dtype, name="msa_ff")(
                             m, deterministic=deterministic) + m
@@ -201,6 +307,25 @@ class Evoformer(nn.Module):
     conv_seq_kernels: tuple = DEFAULT_CONV_SEQ_KERNELS
     conv_msa_kernels: tuple = DEFAULT_CONV_MSA_KERNELS
     conv_dilations: tuple = (1,)
+    # README-era efficient-attention menu (reference README.md:388-487),
+    # applied to the MSA row track (MsaAttentionBlock.row_variant). Each
+    # flag is a bool (all layers) or a per-layer tuple of bools — e.g.
+    # `sparse_self_attn=(True, False) * 3` interleaves sparse and full
+    # layers (README.md:415). `kv_compress_ratio` is 0 (off) or the pool
+    # ratio (README.md:485), scalar or per-layer. At most one variant may
+    # be on per layer. Per-layer-heterogeneous menus run the unrolled
+    # trunk (nn.scan needs layer-uniform params; the README-era reference
+    # was an unrolled torch stack too) and are incompatible with
+    # `pipeline_stages`/`reversible`, which regroup scan-stacked params.
+    sparse_self_attn: "bool | tuple" = False
+    linear_attn: "bool | tuple" = False
+    kron_attn: "bool | tuple" = False
+    kv_compress_ratio: "int | tuple" = 0
+    sparse_block: int = 32
+    sparse_num_global: int = 1
+    sparse_window: int = 1
+    linear_attn_kind: str = "favor"
+    performer_nb_features: int = 256
     dtype: jnp.dtype = jnp.float32
     use_scan: bool = True
     # O(1)-activation reversible trunk (model/reversible.py; reference
@@ -214,6 +339,43 @@ class Evoformer(nn.Module):
     # checkpoints move freely between pp and non-pp runs.
     pipeline_stages: int = 1
     pipeline_microbatches: int = 0   # 0 -> one microbatch per batch row
+
+    def _row_variants(self):
+        """Per-layer MSA-row attention variants + compress ratios.
+
+        Returns (variants, ratios): depth-length tuples of variant names
+        and kv-pool ratios, validated to at most one variant per layer."""
+        def flags(v, label):
+            if isinstance(v, (tuple, list)):
+                assert len(v) == self.depth, \
+                    f"{label} tuple has {len(v)} entries for depth " \
+                    f"{self.depth}"
+                return tuple(bool(b) for b in v)
+            return (bool(v),) * self.depth
+
+        sp = flags(self.sparse_self_attn, "sparse_self_attn")
+        li = flags(self.linear_attn, "linear_attn")
+        kr = flags(self.kron_attn, "kron_attn")
+        cr = self.kv_compress_ratio
+        if isinstance(cr, (tuple, list)):
+            assert len(cr) == self.depth, \
+                f"kv_compress_ratio tuple has {len(cr)} entries for " \
+                f"depth {self.depth}"
+            cr = tuple(int(c) for c in cr)
+        else:
+            cr = (int(cr),) * self.depth
+
+        variants = []
+        for i in range(self.depth):
+            picks = [name for name, on in (
+                ("sparse", sp[i]), ("linear", li[i]), ("kron", kr[i]),
+                ("compress", cr[i] > 0)) if on]
+            assert len(picks) <= 1, \
+                f"layer {i}: conflicting attention variants {picks} — " \
+                "at most one of sparse_self_attn/linear_attn/kron_attn/" \
+                "kv_compress_ratio per layer"
+            variants.append(picks[0] if picks else "full")
+        return tuple(variants), cr
 
     def _pipeline_ready(self, deterministic):
         """The active mesh if the pipeline path applies, else None."""
@@ -233,11 +395,10 @@ class Evoformer(nn.Module):
             raise ValueError(
                 f"depth {self.depth} not divisible into "
                 f"{self.pipeline_stages} pipeline stages")
-        assert (self.attn_dropout == 0.0 and self.ff_dropout == 0.0) or \
-            deterministic, "pipeline trunk does not support dropout"
         return mesh
 
-    def _pipeline_forward(self, mesh, block_kwargs, x, m, mask, msa_mask):
+    def _pipeline_forward(self, mesh, block_kwargs, x, m, mask,
+                          msa_mask, deterministic=True):
         """GPipe over the scan-stacked layer params (parallel/pipeline.py).
 
         Stage s applies layers [s*depth/S, (s+1)*depth/S) — a lax.scan
@@ -252,7 +413,7 @@ class Evoformer(nn.Module):
         """
         import jax
 
-        from alphafold2_tpu.parallel.mesh import DATA_AXIS
+        from alphafold2_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
         from alphafold2_tpu.parallel.pipeline import (microbatch,
                                                       pipeline_apply,
                                                       unmicrobatch)
@@ -260,6 +421,14 @@ class Evoformer(nn.Module):
 
         s_count = self.pipeline_stages
         depth_per = self.depth // s_count
+        # dropout: one base key; each (microbatch, global layer) derives
+        # its mask key by fold_in, so the schedule's recomputations (none
+        # in GPipe) and the backward replay see identical masks. Keys ride
+        # the pipeline as RAW uint32 key data — a plain array leaf that
+        # ppermute/where/zeros_like handle like any activation.
+        has_dropout = (self.attn_dropout > 0.0 or self.ff_dropout > 0.0) \
+            and not deterministic
+        base_key = self.make_rng("dropout") if has_dropout else None
         b, n = x.shape[0], x.shape[1]
         if self.pipeline_microbatches:
             m_count = self.pipeline_microbatches
@@ -283,18 +452,30 @@ class Evoformer(nn.Module):
                          prevent_cse=False)(**block_kwargs, parent=None)
 
         def stage_fn(stage_params, act):
-            xi, mi, pmask, mmask = act
+            xi, mi, pmask, mmask = act[:4]
             bmask, bmsa = pmask > 0.5, mmask > 0.5
+            if has_dropout:
+                mb_key = jax.random.wrap_key_data(act[4][0])
+                s_idx = jax.lax.axis_index(PIPE_AXIS)
 
-            def body(carry, p):
+            def body(carry, pj):
+                p, j = pj
                 xi, mi = carry
                 with use_mesh(None):   # constraints are no-ops in-stage
-                    xi, mi = block.apply({"params": p["block"]}, xi, mi,
-                                         bmask, bmsa, True)
+                    if has_dropout:
+                        lk = jax.random.fold_in(
+                            mb_key, s_idx * depth_per + j)
+                        xi, mi = block.apply(
+                            {"params": p["block"]}, xi, mi, bmask, bmsa,
+                            False, rngs={"dropout": lk})
+                    else:
+                        xi, mi = block.apply({"params": p["block"]}, xi,
+                                             mi, bmask, bmsa, True)
                 return (xi, mi), None
 
-            (xi, mi), _ = jax.lax.scan(body, (xi, mi), stage_params)
-            return (xi, mi, pmask, mmask)
+            (xi, mi), _ = jax.lax.scan(
+                body, (xi, mi), (stage_params, jnp.arange(depth_per)))
+            return (xi, mi, pmask, mmask) + act[4:]
 
         # masks ride as float tensors (one activation tree, one dtype
         # rule per leaf); materialized when absent so the tree is static
@@ -304,6 +485,10 @@ class Evoformer(nn.Module):
             else msa_mask.astype(jnp.float32)
         xs = jax.tree.map(lambda t: microbatch(t, m_count),
                           (x, m, pmask, mmask))
+        if has_dropout:
+            mb_keys = jax.vmap(lambda i: jax.random.key_data(
+                jax.random.fold_in(base_key, i)))(jnp.arange(m_count))
+            xs = xs + (mb_keys[:, None],)   # (M, 1, key_words)
         out = pipeline_apply(stage_fn, stacked, xs, mesh,
                              data_axis=DATA_AXIS)
         x, m = unmicrobatch(out[0]), unmicrobatch(out[1])
@@ -312,6 +497,12 @@ class Evoformer(nn.Module):
     @nn.compact
     def __call__(self, x, m, mask=None, msa_mask=None,
                  deterministic: bool = True):
+        variants, ratios = self._row_variants()
+        uniform = len(set(variants)) == 1 and len(set(ratios)) == 1
+        if not uniform or variants[0] != "full":
+            assert self.pipeline_stages <= 1 and not self.reversible, \
+                "the efficient-attention menu is not supported with " \
+                "pipeline_stages>1 or reversible=True"
         # refuse-rather-than-silently-drop: pp regroups the scan-stacked
         # params, so it needs the scanned trunk (and depth to stage over)
         if self.pipeline_stages > 1:
@@ -321,11 +512,6 @@ class Evoformer(nn.Module):
             assert self.use_scan and self.depth > 1, \
                 "pipeline_stages>1 requires use_scan=True and depth>1"
         if self.reversible:
-            # the reversible trunk is deterministic by construction (exact
-            # inverse reconstruction); refuse configs that expect dropout
-            # rather than silently ignoring it
-            assert self.attn_dropout == 0.0 and self.ff_dropout == 0.0, \
-                "reversible trunk does not support dropout"
             # refuse (rather than silently drop) the OuterMean reference-
             # scaling flag: the reversible blocks construct their own
             # PairwiseAttentionBlock without it
@@ -342,8 +528,11 @@ class Evoformer(nn.Module):
                 conv_seq_kernels=self.conv_seq_kernels,
                 conv_msa_kernels=self.conv_msa_kernels,
                 conv_dilations=self.conv_dilations,
+                attn_dropout=self.attn_dropout,
+                ff_dropout=self.ff_dropout,
                 dtype=self.dtype, name="rev")(
-                    x, m, mask=mask, msa_mask=msa_mask)
+                    x, m, mask=mask, msa_mask=msa_mask,
+                    deterministic=deterministic)
 
         block_kwargs = dict(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
@@ -355,10 +544,19 @@ class Evoformer(nn.Module):
             conv_seq_kernels=self.conv_seq_kernels,
             conv_msa_kernels=self.conv_msa_kernels,
             conv_dilations=self.conv_dilations,
+            sparse_block=self.sparse_block,
+            sparse_num_global=self.sparse_num_global,
+            sparse_window=self.sparse_window,
+            linear_attn_kind=self.linear_attn_kind,
+            performer_nb_features=self.performer_nb_features,
             dtype=self.dtype,
         )
+        if uniform:
+            block_kwargs["msa_row_variant"] = variants[0]
+            if ratios[0] > 0:
+                block_kwargs["kv_compress_ratio"] = ratios[0]
 
-        if self.use_scan and self.depth > 1:
+        if self.use_scan and self.depth > 1 and uniform:
             # remat each block, stack parameters along a scanned depth axis:
             # constant compile time and one block of live activations.
             block_cls = nn.remat(
@@ -382,18 +580,25 @@ class Evoformer(nn.Module):
                 # params were created by the scan path at init; regroup
                 # the (depth, ...) stack into pp stages and run GPipe
                 return self._pipeline_forward(
-                    pp, block_kwargs, x, m, mask, msa_mask)
+                    pp, block_kwargs, x, m, mask, msa_mask, deterministic)
 
             scan = nn.scan(
                 ScanBody,
                 variable_axes={"params": 0},
-                split_rngs={"params": True, "dropout": True},
+                split_rngs={"params": True, "dropout": True,
+                            "performer": True},
                 length=self.depth,
             )
             (x, m), _ = scan(name="layers")((x, m), None)
         else:
+            # unrolled trunk: per-layer configs are free here, so each
+            # layer takes its own menu entry
             for i in range(self.depth):
-                x, m = EvoformerBlock(**block_kwargs, name=f"layers_{i}")(
+                kw = dict(block_kwargs)
+                kw["msa_row_variant"] = variants[i]
+                if ratios[i] > 0:
+                    kw["kv_compress_ratio"] = ratios[i]
+                x, m = EvoformerBlock(**kw, name=f"layers_{i}")(
                     x, m, mask=mask, msa_mask=msa_mask,
                     deterministic=deterministic)
 

@@ -19,9 +19,12 @@ hand-written `backward_pass` and RNG record/replay, README.md:40
   inputs by algebraically inverting the couplings (reverse `lax.scan`) and
   re-plays `jax.vjp` per layer. Activation memory is O(1) in depth vs
   O(depth) for scan+remat (which must store every layer's carry);
-- no RNG record/replay machinery is needed (reference reversible.py:26-56):
-  the reversible trunk is deterministic (dropout unsupported here), and
-  explicit PRNG keys would make replay trivial if ever added.
+- dropout composes with reversibility via deterministic key replay (the
+  JAX form of the reference's RNG record/replay, reversible.py:26-56): one
+  base key rides through the custom_vjp; every coupling derives its mask
+  key as fold_in(base, layer*4 + coupling), so the forward pass, the
+  algebraic inverse (which must subtract the SAME dropout-realized
+  deltas), and the per-layer vjp replay all see identical masks.
 
 Numerical note: reconstruction is exact algebra but floating-point
 round-trip; run this trunk in fp32 (default) — bf16 streams accumulate
@@ -63,6 +66,8 @@ class RevEvoLayer(nn.Module):
     conv_seq_kernels: tuple = DEFAULT_CONV_SEQ_KERNELS
     conv_msa_kernels: tuple = DEFAULT_CONV_MSA_KERNELS
     conv_dilations: tuple = (1,)
+    attn_dropout: float = 0.0
+    ff_dropout: float = 0.0
     dtype: Any = jnp.float32
 
     def setup(self):
@@ -70,13 +75,17 @@ class RevEvoLayer(nn.Module):
             MsaAttentionBlock, PairwiseAttentionBlock)
         self.msa_attn = MsaAttentionBlock(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
+            dropout=self.attn_dropout,
             ring_attention=self.ring_attention, dtype=self.dtype)
-        self.msa_ff = FeedForward(dim=self.dim, dtype=self.dtype)
+        self.msa_ff = FeedForward(dim=self.dim, dropout=self.ff_dropout,
+                                  dtype=self.dtype)
         self.pair_attn = PairwiseAttentionBlock(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
+            dropout=self.attn_dropout,
             global_column_attn=self.global_column_attn,
             ring_attention=self.ring_attention, dtype=self.dtype)
-        self.pair_ff = FeedForward(dim=self.dim, dtype=self.dtype)
+        self.pair_ff = FeedForward(dim=self.dim, dropout=self.ff_dropout,
+                                   dtype=self.dtype)
         if self.use_conv:
             self.msa_conv = MultiKernelConvBlock(
                 dim=self.dim, kernels=self.conv_msa_kernels,
@@ -86,21 +95,23 @@ class RevEvoLayer(nn.Module):
                 dilations=self.conv_dilations, dtype=self.dtype)
 
     # deltas (no outer residual — the coupling adds it)
-    def delta_msa(self, m2, x_ctx, mask, msa_mask):
-        return self.msa_attn(m2, mask=msa_mask, pairwise_repr=x_ctx) - m2
+    def delta_msa(self, m2, x_ctx, mask, msa_mask, deterministic=True):
+        return self.msa_attn(m2, mask=msa_mask, pairwise_repr=x_ctx,
+                             deterministic=deterministic) - m2
 
-    def delta_msa_ff(self, m1, msa_mask):
-        out = self.msa_ff(m1)
+    def delta_msa_ff(self, m1, msa_mask, deterministic=True):
+        out = self.msa_ff(m1, deterministic=deterministic)
         if self.use_conv:
             out = out + self.msa_conv(m1, mask=msa_mask)
         return out
 
-    def delta_pair(self, x2, m_ctx, mask, msa_mask):
+    def delta_pair(self, x2, m_ctx, mask, msa_mask, deterministic=True):
         return self.pair_attn(x2, mask=mask, msa_repr=m_ctx,
-                              msa_mask=msa_mask) - x2
+                              msa_mask=msa_mask,
+                              deterministic=deterministic) - x2
 
-    def delta_pair_ff(self, x1, mask):
-        out = self.pair_ff(x1)
+    def delta_pair_ff(self, x1, mask, deterministic=True):
+        out = self.pair_ff(x1, deterministic=deterministic)
         if self.use_conv:
             out = out + self.pair_conv(x1, mask=mask)
         return out
@@ -119,93 +130,127 @@ def layer_cfg(dim, heads, dim_head=64, global_column_attn=False,
               ring_attention=False, use_conv=False,
               conv_seq_kernels=DEFAULT_CONV_SEQ_KERNELS,
               conv_msa_kernels=DEFAULT_CONV_MSA_KERNELS,
-              conv_dilations=(1,), dtype="float32"):
+              conv_dilations=(1,), dtype="float32",
+              attn_dropout=0.0, ff_dropout=0.0):
     """The static (hashable) layer-config tuple `_run_reversible` carries
     as a nondiff argument — one constructor so tests and the module can't
     drift from `_make_layer`'s unpacking order."""
     return (dim, heads, dim_head, global_column_attn, ring_attention,
             use_conv, tuple(map(tuple, conv_seq_kernels)),
             tuple(map(tuple, conv_msa_kernels)), tuple(conv_dilations),
-            jnp.dtype(dtype).name)
+            jnp.dtype(dtype).name, float(attn_dropout), float(ff_dropout))
 
 
 def _make_layer(cfg) -> RevEvoLayer:
     (dim, heads, dim_head, gca, ring, use_conv, seq_k, msa_k, dil,
-     dtype_name) = cfg
+     dtype_name, attn_drop, ff_drop) = cfg
     return RevEvoLayer(dim=dim, heads=heads, dim_head=dim_head,
                        global_column_attn=gca, ring_attention=ring,
                        use_conv=use_conv, conv_seq_kernels=seq_k,
                        conv_msa_kernels=msa_k, conv_dilations=dil,
+                       attn_dropout=attn_drop, ff_dropout=ff_drop,
                        dtype=jnp.dtype(dtype_name), parent=None)
 
 
-def _layer_fwd(cfg, params, streams, mask, msa_mask):
+def _coupling_apply(cfg, params, key):
+    """Coupling applicator: coupling j runs with the mask key
+    fold_in(key, j) — the SAME key in the forward pass, the algebraic
+    inverse, and the vjp replay, which is what makes dropout compose with
+    reversibility (the reference's RNG record/replay, reversible.py:26-56,
+    done as deterministic key derivation)."""
     layer = _make_layer(cfg)
+
+    def ap(method, j, *args):
+        if key is None:
+            return layer.apply({"params": params}, *args, method=method)
+        return layer.apply(
+            {"params": params}, *args, False, method=method,
+            rngs={"dropout": jax.random.fold_in(key, j)})
+
+    return ap
+
+
+def _layer_fwd(cfg, params, streams, mask, msa_mask, key=None):
     x1, x2, m1, m2 = streams
     bmask = None if mask is None else mask > 0.5
     bmsa = None if msa_mask is None else msa_mask > 0.5
-    ap = lambda method, *args: layer.apply(
-        {"params": params}, *args, method=method)
+    ap = _coupling_apply(cfg, params, key)
 
     x_in = (x1 + x2) * 0.5
-    m1 = m1 + ap(RevEvoLayer.delta_msa, m2, x_in, bmask, bmsa)
-    m2 = m2 + ap(RevEvoLayer.delta_msa_ff, m1, bmsa)
+    m1 = m1 + ap(RevEvoLayer.delta_msa, 0, m2, x_in, bmask, bmsa)
+    m2 = m2 + ap(RevEvoLayer.delta_msa_ff, 1, m1, bmsa)
     m_out = (m1 + m2) * 0.5
-    x1 = x1 + ap(RevEvoLayer.delta_pair, x2, m_out, bmask, bmsa)
-    x2 = x2 + ap(RevEvoLayer.delta_pair_ff, x1, bmask)
+    x1 = x1 + ap(RevEvoLayer.delta_pair, 2, x2, m_out, bmask, bmsa)
+    x2 = x2 + ap(RevEvoLayer.delta_pair_ff, 3, x1, bmask)
     return (x1, x2, m1, m2)
 
 
-def _layer_inv(cfg, params, streams, mask, msa_mask):
-    """Exact algebraic inverse of `_layer_fwd`."""
-    layer = _make_layer(cfg)
+def _layer_inv(cfg, params, streams, mask, msa_mask, key=None):
+    """Exact algebraic inverse of `_layer_fwd` (same `key` -> same
+    dropout-realized deltas are subtracted)."""
     x1p, x2p, m1p, m2p = streams
     bmask = None if mask is None else mask > 0.5
     bmsa = None if msa_mask is None else msa_mask > 0.5
-    ap = lambda method, *args: layer.apply(
-        {"params": params}, *args, method=method)
+    ap = _coupling_apply(cfg, params, key)
 
-    x2 = x2p - ap(RevEvoLayer.delta_pair_ff, x1p, bmask)
+    x2 = x2p - ap(RevEvoLayer.delta_pair_ff, 3, x1p, bmask)
     m_out = (m1p + m2p) * 0.5
-    x1 = x1p - ap(RevEvoLayer.delta_pair, x2, m_out, bmask, bmsa)
-    m2 = m2p - ap(RevEvoLayer.delta_msa_ff, m1p, bmsa)
+    x1 = x1p - ap(RevEvoLayer.delta_pair, 2, x2, m_out, bmask, bmsa)
+    m2 = m2p - ap(RevEvoLayer.delta_msa_ff, 1, m1p, bmsa)
     x_in = (x1 + x2) * 0.5
-    m1 = m1p - ap(RevEvoLayer.delta_msa, m2, x_in, bmask, bmsa)
+    m1 = m1p - ap(RevEvoLayer.delta_msa, 0, m2, x_in, bmask, bmsa)
     return (x1, x2, m1, m2)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(0,))
-def _run_reversible(cfg, stacked_params, streams, mask, msa_mask):
-    def body(s, p):
-        return _layer_fwd(cfg, p, s, mask, msa_mask), None
+def _layer_keys(key, stacked_params):
+    """(depth,) per-layer dropout keys (None -> None): layer i uses
+    fold_in(base, i); couplings fold in further (_coupling_apply)."""
+    if key is None:
+        return None
+    depth = jax.tree.leaves(stacked_params)[0].shape[0]
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(depth))
 
-    out, _ = jax.lax.scan(body, streams, stacked_params)
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _run_reversible(cfg, stacked_params, streams, mask, msa_mask,
+                    key=None):
+    keys = _layer_keys(key, stacked_params)
+
+    def body(s, pk):
+        p, lk = pk
+        return _layer_fwd(cfg, p, s, mask, msa_mask, lk), None
+
+    out, _ = jax.lax.scan(body, streams, (stacked_params, keys))
     return out
 
 
-def _run_fwd(cfg, stacked_params, streams, mask, msa_mask):
-    out = _run_reversible(cfg, stacked_params, streams, mask, msa_mask)
+def _run_fwd(cfg, stacked_params, streams, mask, msa_mask, key=None):
+    out = _run_reversible(cfg, stacked_params, streams, mask, msa_mask,
+                          key)
     # store ONLY the outputs — this is the whole point
-    return out, (stacked_params, out, mask, msa_mask)
+    return out, (stacked_params, out, mask, msa_mask, key)
 
 
 def _run_bwd(cfg, res, g):
-    stacked_params, out, mask, msa_mask = res
+    stacked_params, out, mask, msa_mask, key = res
+    keys = _layer_keys(key, stacked_params)
 
-    def body(carry, p):
+    def body(carry, pk):
+        p, lk = pk
         s_out, d_out = carry
-        s_in = _layer_inv(cfg, p, s_out, mask, msa_mask)
+        s_in = _layer_inv(cfg, p, s_out, mask, msa_mask, lk)
         _, vjp = jax.vjp(
-            lambda pp, ss: _layer_fwd(cfg, pp, ss, mask, msa_mask),
+            lambda pp, ss: _layer_fwd(cfg, pp, ss, mask, msa_mask, lk),
             p, s_in)
         dp, d_in = vjp(d_out)
         return (s_in, d_in), dp
 
-    (s0, d_in), dps = jax.lax.scan(body, (out, g), stacked_params,
-                                   reverse=True)
+    (s0, d_in), dps = jax.lax.scan(body, (out, g),
+                                   (stacked_params, keys), reverse=True)
     zero_mask = None if mask is None else jnp.zeros_like(mask)
     zero_msa = None if msa_mask is None else jnp.zeros_like(msa_mask)
-    return dps, d_in, zero_mask, zero_msa
+    return dps, d_in, zero_mask, zero_msa, None
 
 
 _run_reversible.defvjp(_run_fwd, _run_bwd)
@@ -230,17 +275,26 @@ class ReversibleEvoformer(nn.Module):
     conv_seq_kernels: tuple = DEFAULT_CONV_SEQ_KERNELS
     conv_msa_kernels: tuple = DEFAULT_CONV_MSA_KERNELS
     conv_dilations: tuple = (1,)
+    # dropout composes with reversibility via deterministic key replay
+    # (module docstring); active when deterministic=False and a 'dropout'
+    # rng is provided at apply
+    attn_dropout: float = 0.0
+    ff_dropout: float = 0.0
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, m, mask=None, msa_mask=None,
                  deterministic: bool = True):
-        del deterministic  # reversible trunk is always deterministic
+        has_dropout = self.attn_dropout > 0.0 or self.ff_dropout > 0.0
+        dropout_key = None
+        if has_dropout and not deterministic:
+            dropout_key = self.make_rng("dropout")
         cfg = layer_cfg(self.dim, self.heads, self.dim_head,
                         self.global_column_attn, self.ring_attention,
                         self.use_conv, self.conv_seq_kernels,
                         self.conv_msa_kernels, self.conv_dilations,
-                        jnp.dtype(self.dtype).name)
+                        jnp.dtype(self.dtype).name,
+                        self.attn_dropout, self.ff_dropout)
         layer = _make_layer(cfg)
 
         mask_f = None if mask is None else mask.astype(jnp.float32)
@@ -269,5 +323,5 @@ class ReversibleEvoformer(nn.Module):
 
         streams = (x, x, m, m)
         x1, x2, m1, m2 = _run_reversible(cfg, stacked, streams,
-                                         mask_f, msa_f)
+                                         mask_f, msa_f, dropout_key)
         return (x1 + x2) * 0.5, (m1 + m2) * 0.5

@@ -41,7 +41,10 @@ def compute_loss(model, params, batch, rng, train: bool = True):
         msa_mask=batch.get("msa_mask"),
         train=train,
     )
-    rngs = {"mlm": rng, "dropout": jax.random.fold_in(rng, 1)} if train \
+    # 'performer' redraws FAVOR+ random features every step (the per-step
+    # form of performer-pytorch's feature_redraw_interval; unbiased)
+    rngs = {"mlm": rng, "dropout": jax.random.fold_in(rng, 1),
+            "performer": jax.random.fold_in(rng, 2)} if train \
         else None
 
     if wants_coords:

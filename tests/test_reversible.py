@@ -142,3 +142,114 @@ class TestReversibleConv:
         assert np.isfinite(float(val))
         finite = [bool(jnp.isfinite(g).all()) for g in jax.tree.leaves(grads)]
         assert all(finite)
+
+
+class TestReversibleDropout:
+    """Dropout through the reversible trunk (reference reversible.py:26-56
+    RNG record/replay, done as deterministic fold_in key derivation)."""
+
+    def _trunk(self, depth=2, d=16):
+        x, m, pair_mask, msa_mask = make_inputs(jax.random.PRNGKey(0), d=d)
+        trunk = ReversibleEvoformer(dim=d, depth=depth, heads=2,
+                                    dim_head=8, attn_dropout=0.1,
+                                    ff_dropout=0.1)
+        params = trunk.init(jax.random.PRNGKey(1), x, m, mask=pair_mask,
+                            msa_mask=msa_mask)
+        return trunk, params, (x, m, pair_mask, msa_mask)
+
+    @pytest.mark.quick
+    def test_grads_match_plain_autodiff_with_dropout(self):
+        """The custom_vjp (invert + replay) gradient at dropout 0.1 must
+        equal plain autodiff through the same couplings with the SAME
+        keys — the matched-keys gradient-parity check."""
+        from alphafold2_tpu.model.reversible import _layer_keys
+
+        trunk, params, (x, m, pair_mask, msa_mask) = self._trunk(depth=2)
+        stacked = params["params"]["rev_layers"]
+        cfg = layer_cfg(16, 2, 8, attn_dropout=0.1, ff_dropout=0.1)
+        mask_f = pair_mask.astype(jnp.float32)
+        msa_f = msa_mask.astype(jnp.float32)
+        key = jax.random.PRNGKey(7)
+        streams = (x, x, m, m)
+
+        def loss_custom(p):
+            out = _run_reversible(cfg, p, streams, mask_f, msa_f, key)
+            return sum((o ** 2).sum() for o in out)
+
+        def loss_naive(p):
+            keys = _layer_keys(key, p)
+            s = streams
+            for i in range(2):
+                lp = jax.tree.map(lambda t, i=i: t[i], p)
+                s = _layer_fwd(cfg, lp, s, mask_f, msa_f, keys[i])
+            return sum((o ** 2).sum() for o in s)
+
+        # same masks -> identical primal values
+        np.testing.assert_allclose(float(loss_custom(stacked)),
+                                   float(loss_naive(stacked)), rtol=1e-5)
+        g1 = jax.grad(loss_custom)(stacked)
+        g2 = jax.grad(loss_naive)(stacked)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4)
+
+    def test_dropout_active_and_reproducible(self):
+        from conftest import perturb_params
+
+        trunk, params, (x, m, pair_mask, msa_mask) = self._trunk()
+        # off the zero-init point, where the coupling deltas are nonzero
+        params = perturb_params(params, jax.random.PRNGKey(11))
+        det = trunk.apply(params, x, m, mask=pair_mask, msa_mask=msa_mask,
+                          deterministic=True)
+        r1 = trunk.apply(params, x, m, mask=pair_mask, msa_mask=msa_mask,
+                         deterministic=False,
+                         rngs={"dropout": jax.random.PRNGKey(3)})
+        r1b = trunk.apply(params, x, m, mask=pair_mask, msa_mask=msa_mask,
+                          deterministic=False,
+                          rngs={"dropout": jax.random.PRNGKey(3)})
+        r2 = trunk.apply(params, x, m, mask=pair_mask, msa_mask=msa_mask,
+                         deterministic=False,
+                         rngs={"dropout": jax.random.PRNGKey(4)})
+        assert float(jnp.abs(r1[0] - det[0]).max()) > 1e-6  # active
+        np.testing.assert_array_equal(np.asarray(r1[0]),
+                                      np.asarray(r1b[0]))  # same key
+        assert float(jnp.abs(r1[0] - r2[0]).max()) > 1e-6   # fresh key
+
+    def test_attn_dropout_alone_is_active(self):
+        """Regression: attn_dropout must reach the attention modules
+        (it was silently inert — the blocks declared but never forwarded
+        their dropout field)."""
+        from conftest import perturb_params
+
+        x, m, pair_mask, msa_mask = make_inputs(jax.random.PRNGKey(0))
+        trunk = ReversibleEvoformer(dim=16, depth=1, heads=2, dim_head=8,
+                                    attn_dropout=0.3, ff_dropout=0.0)
+        params = perturb_params(
+            trunk.init(jax.random.PRNGKey(1), x, m, mask=pair_mask,
+                       msa_mask=msa_mask), jax.random.PRNGKey(2))
+        det = trunk.apply(params, x, m, mask=pair_mask,
+                          msa_mask=msa_mask, deterministic=True)
+        sto = trunk.apply(params, x, m, mask=pair_mask,
+                          msa_mask=msa_mask, deterministic=False,
+                          rngs={"dropout": jax.random.PRNGKey(3)})
+        assert float(jnp.abs(sto[0] - det[0]).max()) > 1e-6
+
+    def test_evoformer_flag_lifted(self):
+        """Evoformer(reversible=True, dropout>0) now trains: loss finite,
+        grads nonzero, deterministic path still exact."""
+        from alphafold2_tpu.model.evoformer import Evoformer
+
+        x, m, pair_mask, msa_mask = make_inputs(jax.random.PRNGKey(0))
+        ev = Evoformer(dim=16, depth=2, heads=2, dim_head=8,
+                       reversible=True, attn_dropout=0.1, ff_dropout=0.1)
+        params = ev.init(jax.random.PRNGKey(1), x, m, mask=pair_mask,
+                         msa_mask=msa_mask)
+
+        def loss(p, key):
+            xo, mo = ev.apply(p, x, m, mask=pair_mask, msa_mask=msa_mask,
+                              deterministic=False, rngs={"dropout": key})
+            return (xo ** 2).sum() + (mo ** 2).sum()
+
+        val, g = jax.value_and_grad(loss)(params, jax.random.PRNGKey(2))
+        assert np.isfinite(float(val))
+        assert sum(float(jnp.abs(l).sum()) for l in jax.tree.leaves(g)) > 0
