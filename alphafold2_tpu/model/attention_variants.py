@@ -109,7 +109,8 @@ def orthogonal_random_features(key, nb_features: int, dim: int):
     return w * norms
 
 
-def favor_softmax_features(x, proj, is_query: bool, eps: float = 1e-4):
+def favor_softmax_features(x, proj, is_query: bool, eps: float = 1e-4,
+                           mask=None):
     """Positive softmax-kernel features phi(x) (FAVOR+, Choromanski et al.
     2021 eq. 5): phi(x) = exp(Wx - ||x||^2/2 - c) / sqrt(m), giving the
     unbiased estimator E[phi(q)^T phi(k)] = exp(q . k).
@@ -117,15 +118,24 @@ def favor_softmax_features(x, proj, is_query: bool, eps: float = 1e-4):
     x: (..., n, d) already scaled by d^-1/4 (so q.k carries the 1/sqrt(d)
     softmax temperature). Stabilizer c: per-token max for queries (cancels
     in the attention ratio), global max for keys (uniform scale, also
-    cancels)."""
+    cancels). `mask` (..., n) excludes padded tokens from the key max —
+    a single garbage key above the valid maximum would otherwise push
+    every real phi(k) to the eps floor; masked rows are also pinned at c
+    so exp cannot overflow before the caller zeroes them."""
     m = proj.shape[0]
     u = x @ proj.T                                     # (..., n, m)
     sq = (x * x).sum(-1, keepdims=True) / 2.0
     h = u - sq
+    if mask is not None:
+        h = jnp.where(mask[..., None], h, -jnp.inf)
     if is_query:
-        c = jax.lax.stop_gradient(h.max(-1, keepdims=True))
+        c = jax.lax.stop_gradient(
+            jnp.max(jnp.where(jnp.isfinite(h), h, -1e30), -1,
+                    keepdims=True))
     else:
-        c = jax.lax.stop_gradient(h.max())
+        c = jax.lax.stop_gradient(
+            jnp.max(jnp.where(jnp.isfinite(h), h, -1e30)))
+    h = jnp.where(jnp.isfinite(h), h, c - 100.0)  # masked -> exp ~ 0
     return (jnp.exp(h - c) + eps) / jnp.sqrt(m)
 
 
@@ -169,10 +179,12 @@ class PerformerAttention(nn.Module):
         proj = orthogonal_random_features(feat_key, self.nb_features,
                                           self.dim_head)
 
-        phi_q = favor_softmax_features(q, proj, is_query=True)
-        phi_k = favor_softmax_features(k, proj, is_query=False)
-
         kmask = context_mask if context is not None else mask
+        kmask4 = None if kmask is None else kmask[:, None, :]
+        phi_q = favor_softmax_features(q, proj, is_query=True)
+        phi_k = favor_softmax_features(k, proj, is_query=False,
+                                       mask=kmask4)
+
         if kmask is not None:
             w = kmask[:, None, :, None]
             phi_k = phi_k * w
@@ -197,10 +209,11 @@ class MemoryCompressedAttention(nn.Module):
     dim_head: int = 64
     compress_ratio: int = 2
     gating: bool = True
+    dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, mask=None):
+    def __call__(self, x, mask=None, deterministic: bool = True):
         dense = _dense_factory(self.dtype)
         q, k, v = _qkv(dense, x, x, self.heads, self.dim_head)
         r = self.compress_ratio
@@ -227,6 +240,7 @@ class MemoryCompressedAttention(nn.Module):
         if mask is not None:
             dots = jnp.where(mask[:, None, :, None], dots, MASK_VALUE)
         attn = jnn.softmax(dots, axis=-1)
+        attn = nn.Dropout(self.dropout)(attn, deterministic=deterministic)
         out = jnp.einsum("bhij,bhjd->bhid", attn, v)
 
         inner = self.heads * self.dim_head
@@ -266,16 +280,19 @@ class KroneckerAttention(nn.Module):
     dim: int
     heads: int = 8
     dim_head: int = 64
+    dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, context_2d, mask=None, context_mask=None):
+    def __call__(self, x, context_2d, mask=None, context_mask=None,
+                 deterministic: bool = True):
         from alphafold2_tpu.model.primitives import Attention
         pooled, token_mask = kronecker_pool_2d(context_2d, context_mask)
         return Attention(dim=self.dim, heads=self.heads,
-                         dim_head=self.dim_head, dtype=self.dtype,
-                         name="attn")(
-            x, mask=mask, context=pooled, context_mask=token_mask)
+                         dim_head=self.dim_head, dropout=self.dropout,
+                         dtype=self.dtype, name="attn")(
+            x, mask=mask, context=pooled, context_mask=token_mask,
+            deterministic=deterministic)
 
 
 # README-era defaults (reference README.md:305-307): 1d+2d kernel mix
@@ -380,18 +397,20 @@ class BlockSparseAttention(nn.Module):
     block: int = 32
     num_global: int = 1
     window: int = 1
+    dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, mask=None):
+    def __call__(self, x, mask=None, deterministic: bool = True):
         from alphafold2_tpu.model.primitives import Attention
         from alphafold2_tpu.ops.attention import pallas_attention_enabled
         n = x.shape[-2]
         attn = Attention(dim=self.dim, heads=self.heads,
-                         dim_head=self.dim_head, dtype=self.dtype,
-                         name="attn")
+                         dim_head=self.dim_head, dropout=self.dropout,
+                         dtype=self.dtype, name="attn")
 
-        if pallas_attention_enabled() and n % self.block == 0:
+        if pallas_attention_enabled() and n % self.block == 0 and \
+                (self.dropout == 0.0 or deterministic):
             from alphafold2_tpu.ops.block_sparse import (
                 block_sparse_attention)
             block_pattern = block_sparse_block_pattern(
@@ -411,4 +430,5 @@ class BlockSparseAttention(nn.Module):
         pattern = block_sparse_mask(n, self.block, self.num_global,
                                     self.window)
         bias = jnp.where(pattern, 0.0, MASK_VALUE)[None, None]
-        return attn(x, mask=mask, attn_bias=bias)
+        return attn(x, mask=mask, attn_bias=bias,
+                    deterministic=deterministic)

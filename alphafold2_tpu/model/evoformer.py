@@ -148,8 +148,8 @@ class MsaAttentionBlock(nn.Module):
             )(x, mask=mask, edges=pairwise_repr,
               deterministic=deterministic) + x
         else:
-            x = self._row_variant_attn(x, mask, pairwise_repr,
-                                       pair_mask) + x
+            x = self._row_variant_attn(x, mask, pairwise_repr, pair_mask,
+                                       deterministic) + x
         x = AxialAttention(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
             row_attn=False, col_attn=True, dropout=self.dropout,
@@ -157,11 +157,14 @@ class MsaAttentionBlock(nn.Module):
         )(x, mask=mask, deterministic=deterministic) + x
         return shard_msa(x)
 
-    def _row_variant_attn(self, x, mask, pairwise_repr, pair_mask):
+    def _row_variant_attn(self, x, mask, pairwise_repr, pair_mask,
+                          deterministic=True):
         """Residue-axis attention via an efficient variant: alignment rows
         fold into batch (as AxialAttention does), pre-LN applied here (the
         variants are bare attention modules; AxialAttention normalizes
-        internally)."""
+        internally). `dropout` reaches the softmax-matrix variants
+        (sparse/compress/kron); the linear variants have no attention
+        matrix to drop entries from (performer-pytorch likewise)."""
         from alphafold2_tpu.model.attention_variants import (
             BlockSparseAttention,
             LinearAttention,
@@ -180,7 +183,8 @@ class MsaAttentionBlock(nn.Module):
         if self.row_variant == "sparse":
             out = BlockSparseAttention(
                 block=self.sparse_block, num_global=self.sparse_num_global,
-                window=self.sparse_window, **kw)(hf, mask=mf)
+                window=self.sparse_window, dropout=self.dropout, **kw)(
+                    hf, mask=mf, deterministic=deterministic)
         elif self.row_variant == "linear":
             if self.linear_attn_kind == "favor":
                 from alphafold2_tpu.model.attention_variants import (
@@ -192,7 +196,9 @@ class MsaAttentionBlock(nn.Module):
                 out = LinearAttention(**kw)(hf, mask=mf)
         elif self.row_variant == "compress":
             out = MemoryCompressedAttention(
-                compress_ratio=self.kv_compress_ratio, **kw)(hf, mask=mf)
+                compress_ratio=self.kv_compress_ratio,
+                dropout=self.dropout, **kw)(
+                    hf, mask=mf, deterministic=deterministic)
         elif self.row_variant == "kron":
             assert pairwise_repr is not None, \
                 "row_variant='kron' needs the pair representation"
@@ -201,8 +207,14 @@ class MsaAttentionBlock(nn.Module):
             # rows (repeat matches the row-major fold of x above)
             pooled = jnp.repeat(pooled, rows, axis=0)
             tmask = jnp.repeat(tmask, rows, axis=0)
-            out = Attention(**kw)(hf, mask=mf, context=pooled,
-                                  context_mask=tmask)
+            if mf is None:
+                # Attention only honors context_mask alongside a query
+                # mask; synthesize all-ones so padded pooled tokens are
+                # still excluded when msa_mask is absent
+                mf = jnp.ones((b * rows, n), dtype=bool)
+            out = Attention(dropout=self.dropout, **kw)(
+                hf, mask=mf, context=pooled, context_mask=tmask,
+                deterministic=deterministic)
         else:
             raise ValueError(f"unknown row_variant {self.row_variant!r}")
         return out.reshape(b, rows, n, d)
@@ -503,6 +515,13 @@ class Evoformer(nn.Module):
             assert self.pipeline_stages <= 1 and not self.reversible, \
                 "the efficient-attention menu is not supported with " \
                 "pipeline_stages>1 or reversible=True"
+            # refuse-rather-than-silently-drop: the variant row attention
+            # does not ring-parallelize; ring_attention would silently
+            # all-gather the residue axis it was enabled to keep sharded
+            assert not self.ring_attention, \
+                "the efficient-attention menu is not supported with " \
+                "ring_attention=True (the variant row track is not " \
+                "ring-parallel)"
         # refuse-rather-than-silently-drop: pp regroups the scan-stacked
         # params, so it needs the scanned trunk (and depth to stage over)
         if self.pipeline_stages > 1:

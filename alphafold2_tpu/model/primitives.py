@@ -240,6 +240,11 @@ class Attention(nn.Module):
             # the materialized repeat
             attn_bias = jnp.repeat(attn_bias, attn_bias_repeat, axis=0)
 
+        # the two attention contractions route to the AMX host GEMM on the
+        # CPU fallback path (ops/cpu_gemm.py; exact XLA einsums otherwise)
+        from alphafold2_tpu.ops.cpu_gemm import (amx_attention_dots,
+                                                 amx_attention_out)
+
         if tie_dim is not None:
             # global-query attention: average queries across the tied rows
             # (the paper's MSAColumnGlobalAttention; reference
@@ -250,7 +255,7 @@ class Attention(nn.Module):
             dots = jnp.einsum("bhid,brhjd->brhij", q, k)
             dots = dots.reshape(-1, *dots.shape[2:])
         else:
-            dots = jnp.einsum("bhid,bhjd->bhij", q, k)
+            dots = amx_attention_dots(q, k)
 
         if attn_bias is not None:
             dots = dots + attn_bias.astype(dots.dtype)
@@ -261,7 +266,7 @@ class Attention(nn.Module):
         attn = jnn.softmax(dots, axis=-1)
         attn = self._drop(attn, deterministic=deterministic)
 
-        out = jnp.einsum("bhij,bhjd->bhid", attn, v)
+        out = amx_attention_out(attn, v)
         return self.finish(out, x)
 
 

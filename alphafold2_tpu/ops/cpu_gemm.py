@@ -97,6 +97,9 @@ def _ensure_registered() -> bool:
         return False
     jax.ffi.register_ffi_target(
         "af2_amx_gemm", jax.ffi.pycapsule(lib.Af2AmxGemm), platform="cpu")
+    jax.ffi.register_ffi_target(
+        "af2_amx_gemm_tb", jax.ffi.pycapsule(lib.Af2AmxGemmTb),
+        platform="cpu")
     _registered = True
     return True
 
@@ -145,17 +148,88 @@ def _amx_matmul_fwd(a, b):
 
 def _amx_matmul_bwd(res, g):
     a, b = res
-    swap = (-1, -2) if a.ndim == 2 else (0, 2, 1)
-    bt = jnp.transpose(b, swap)
-    at = jnp.transpose(a, swap)
-    da = (_ffi_gemm(g, bt) if _eligible(g.shape, bt.shape, g.dtype, bt.dtype)
-          else jnp.matmul(g, bt))
+    # da = g @ b^T: the tb kernel reads b [..,K,N] as the transposed
+    # operand directly (no XLA transpose)
+    if (b.dtype == jnp.float32 and g.dtype == jnp.float32
+            and b.shape[-1] % 32 == 0 and b.shape[-2] % 16 == 0):
+        da = _ffi_gemm_tb(g, b)
+    else:
+        da = jnp.matmul(g, jnp.swapaxes(b, -1, -2))
+    at = jnp.swapaxes(a, -1, -2)
     db = (_ffi_gemm(at, g) if _eligible(at.shape, g.shape, at.dtype, g.dtype)
           else jnp.matmul(at, g))
     return da, db
 
 
 amx_matmul.defvjp(_amx_matmul_fwd, _amx_matmul_bwd)
+
+
+def _ffi_gemm_tb(a, bt):
+    """C = a @ bt^T with bt stored [.., N, K] — af2_amx_gemm_tb packs the
+    transposed operand straight into VNNI tiles (no XLA transpose)."""
+    out_shape = a.shape[:-1] + bt.shape[-2:-1]
+    return jax.ffi.ffi_call(
+        "af2_amx_gemm_tb",
+        jax.ShapeDtypeStruct(out_shape, jnp.float32),
+        vmap_method="sequential",
+    )(a, bt)
+
+
+# batched form is the same op — the kernel takes [G,M,K]x[G,K,N] natively
+amx_bmm = amx_matmul
+
+
+@jax.custom_vjp
+def amx_bmm_tb(a, bt):
+    """Batched a[G,M,K] @ bt[G,N,K]^T — the q @ k^T shape of attention
+    logits, with k consumed in its natural [tokens, head_dim] layout."""
+    return _ffi_gemm_tb(a, bt)
+
+
+def _amx_bmm_tb_fwd(a, bt):
+    return _ffi_gemm_tb(a, bt), (a, bt)
+
+
+def _amx_bmm_tb_bwd(res, g):
+    a, bt = res
+    # da = g @ bt (natural); dbt = g^T @ a (one XLA transpose of g)
+    da = (_ffi_gemm(g, bt) if _eligible(g.shape, bt.shape, g.dtype,
+                                        bt.dtype) else jnp.matmul(g, bt))
+    gt = jnp.swapaxes(g, -1, -2)
+    dbt = (_ffi_gemm(gt, a) if _eligible(gt.shape, a.shape, gt.dtype,
+                                         a.dtype) else jnp.matmul(gt, a))
+    return da, dbt
+
+
+amx_bmm_tb.defvjp(_amx_bmm_tb_fwd, _amx_bmm_tb_bwd)
+
+
+def amx_attention_dots(q, k):
+    """einsum('bhid,bhjd->bhij') via the AMX tb kernel when enabled and
+    aligned (d % 32 == 0, j % 16 == 0, f32); exact XLA einsum otherwise.
+
+    The backward routes through AMX too (custom_vjp above)."""
+    b, h, i, d = q.shape
+    j = k.shape[-2]
+    if (amx_dense_enabled() and q.dtype == jnp.float32
+            and k.dtype == jnp.float32 and d % 32 == 0 and j % 16 == 0
+            and b * h * i >= 32):
+        out = amx_bmm_tb(q.reshape(b * h, i, d), k.reshape(b * h, j, d))
+        return out.reshape(b, h, i, j)
+    return jnp.einsum("bhid,bhjd->bhij", q, k)
+
+
+def amx_attention_out(attn, v):
+    """einsum('bhij,bhjd->bhid') via the AMX kernel when enabled and
+    aligned (j % 32 == 0, d % 16 == 0, f32); exact XLA einsum otherwise."""
+    b, h, i, j = attn.shape
+    d = v.shape[-1]
+    if (amx_dense_enabled() and attn.dtype == jnp.float32
+            and v.dtype == jnp.float32 and j % 32 == 0 and d % 16 == 0
+            and b * h * i >= 32):
+        out = amx_bmm(attn.reshape(b * h, i, j), v.reshape(b * h, j, d))
+        return out.reshape(b, h, i, d)
+    return jnp.einsum("bhij,bhjd->bhid", attn, v)
 
 
 def amx_dense_dot_general(lhs, rhs, dimension_numbers, precision=None,

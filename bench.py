@@ -65,8 +65,11 @@ _CPU_TINY = dict(dim=64, depth=2, seq_len=64, warmup=1, iters=3)
 #                                   f32 with rounding converts — bf16 is pure
 #                                   overhead off-TPU)
 #   f32 + XNN greedy + fast-math:   12.3 s/iter
-#   + AMX Dense (ops/cpu_gemm.py):   9.8 s/iter   (native/amx_gemm.cc)
-# The TPU phase keeps bf16 (the production dtype on the MXU).
+#   + AMX Dense (ops/cpu_gemm.py):   9.8 s/iter   (native/amx_gemm.cc,
+#                                   ~400 GFLOP/s vs ~100 for XLA:CPU's dot)
+#   + AMX attention einsums:         9.0 s/iter   (batched + transposed-B)
+# Full config with the complete recipe: 104.2 s/step = vs_baseline 1.545
+# (torch-CPU 160.9 s). The TPU phase keeps bf16 (the MXU dtype).
 _CPU_XLA_FLAGS = (
     "--xla_cpu_experimental_xnn_graph_fusion_mode=XNN_GRAPH_FUSION_MODE_GREEDY"
     " --xla_cpu_enable_fast_math=true"
@@ -344,8 +347,8 @@ def _parent_main() -> int:
         print("bench: default platform unreachable or too slow; "
               "falling back to CPU", file=sys.stderr, flush=True)
         cpu_env = _cpu_env()
-        # cpu-full worst case ~500s uncontended (f32+AMX recipe: ~90s
-        # compile + ~70s XNN extraction + 3 steps at ~105s); the 900s cap
+        # cpu-full worst case ~440s uncontended (f32+AMX recipe: ~20s
+        # warm-cache / ~120s cold compile + 3 steps at ~105s); the 900s cap
         # leaves contention headroom while the deadline math still closes:
         # probe 60 + 900 + mid 300 + tiny 80 < total - 30
         ladder = [

@@ -77,6 +77,33 @@ void pack_a(const float* A, int lda, int m0, int rows, int K, bf16* out) {
     }
 }
 
+// Bt[N, K] (B stored transposed) -> the same VNNI tile layout as pack_b:
+// bpack[kb][r][2c+j] = B[kb*32+2r+j][n0+c] = Bt[n0+c][kb*32+2r+j].
+// Per tile this is a 16x16 dword transpose of the bf16-pair columns;
+// gathers keep it simple (pack is O(KN), the GEMM is O(MKN)).
+void pack_b_trans(const float* Bt, int ldb, int K, int n0, bf16* out) {
+  const int kb_n = K / 32;
+  const __m512i vidx = _mm512_mullo_epi32(
+      _mm512_set_epi32(15, 14, 13, 12, 11, 10, 9, 8, 7, 6, 5, 4, 3, 2, 1,
+                       0),
+      _mm512_set1_epi32(ldb));
+  for (int kb = 0; kb < kb_n; kb++)
+    for (int r = 0; r < 16; r++) {
+      // column pair (2r, 2r+1) of rows n0..n0+15
+      const float* base0 = Bt + (size_t)n0 * ldb + kb * 32 + 2 * r;
+      __m512 c0 = _mm512_i32gather_ps(vidx, base0, 4);
+      __m512 c1 = _mm512_i32gather_ps(vidx, base0 + 1, 4);
+      __m512bh bh = _mm512_cvtne2ps_pbh(c1, c0);  // low 16 = c0, high = c1
+      __m512i x = (__m512i)bh;
+      __m256i lo = _mm512_castsi512_si256(x);
+      __m256i hi = _mm512_extracti64x4_epi64(x, 1);
+      __m512i lo512 = _mm512_cvtepu16_epi32(lo);
+      __m512i hi512 = _mm512_slli_epi32(_mm512_cvtepu16_epi32(hi), 16);
+      _mm512_storeu_si512(out + ((size_t)kb * 16 + r) * 32,
+                          _mm512_or_si512(lo512, hi512));
+    }
+}
+
 // B[K, n0:n0+16] -> VNNI tiles bpack[kb][r][2c+j] = B[kb*32+2r+j][n0+c].
 void pack_b(const float* B, int ldb, int K, int n0, bf16* out) {
   const int kb_n = K / 32;
@@ -140,16 +167,21 @@ void block_2x2(const bf16* apack, const bf16* bp0, const bf16* bp1, float* C,
   }
 }
 
-// Full GEMM; K % 32 == 0, N % 16 == 0, any M.
+// Full GEMM; K % 32 == 0, N % 16 == 0, any M. trans_b: B passed [N, K].
 void gemm(const float* A, const float* B, float* C, int64_t M, int64_t N,
-          int64_t K) {
+          int64_t K, bool trans_b = false) {
   const int kb_n = (int)(K / 32);
   static thread_local std::vector<bf16> bpack;
   static thread_local std::vector<bf16> apack;
   bpack.resize((size_t)K * N);
   apack.resize((size_t)32 * K);
-  for (int64_t n0 = 0; n0 < N; n0 += 16)
-    pack_b(B, (int)N, (int)K, (int)n0, bpack.data() + (size_t)n0 * K);
+  for (int64_t n0 = 0; n0 < N; n0 += 16) {
+    if (trans_b)
+      pack_b_trans(B, (int)K, (int)K, (int)n0,
+                   bpack.data() + (size_t)n0 * K);
+    else
+      pack_b(B, (int)N, (int)K, (int)n0, bpack.data() + (size_t)n0 * K);
+  }
   for (int64_t m0 = 0; m0 < M; m0 += 32) {
     const int rows = (int)std::min<int64_t>(32, M - m0);
     pack_a(A, (int)K, (int)m0, rows, (int)K, apack.data());
@@ -166,9 +198,10 @@ void gemm(const float* A, const float* B, float* C, int64_t M, int64_t N,
 
 namespace ffi = xla::ffi;
 
-// a: [M, K] or [G, M, K]; b: [K, N] or [G, K, N] (G = batch of GEMMs).
-ffi::Error GemmImpl(ffi::Buffer<ffi::F32> a, ffi::Buffer<ffi::F32> b,
-                    ffi::ResultBuffer<ffi::F32> c) {
+// a: [M, K] or [G, M, K]; b: [K, N] or [G, K, N] (G = batch of GEMMs);
+// trans_b: b is [N, K] / [G, N, K] instead.
+ffi::Error GemmRun(ffi::Buffer<ffi::F32>& a, ffi::Buffer<ffi::F32>& b,
+                   ffi::ResultBuffer<ffi::F32>& c, bool trans_b) {
   if (!amx_request_permission())
     return ffi::Error(ffi::ErrorCode::kFailedPrecondition,
                       "AMX tile permission unavailable");
@@ -182,8 +215,10 @@ ffi::Error GemmImpl(ffi::Buffer<ffi::F32> a, ffi::Buffer<ffi::F32> b,
   const int64_t G = batched ? adims[0] : 1;
   const int64_t M = adims[batched ? 1 : 0];
   const int64_t K = adims[batched ? 2 : 1];
-  const int64_t N = bdims[batched ? 2 : 1];
-  if (bdims[batched ? 1 : 0] != K || (batched && bdims[0] != G))
+  const int64_t bd0 = bdims[batched ? 1 : 0];
+  const int64_t bd1 = bdims[batched ? 2 : 1];
+  const int64_t N = trans_b ? bd0 : bd1;
+  if ((trans_b ? bd1 : bd0) != K || (batched && bdims[0] != G))
     return ffi::Error(ffi::ErrorCode::kInvalidArgument,
                       "af2_amx_gemm operand shape mismatch");
   if (K % 32 || N % 16)
@@ -192,14 +227,30 @@ ffi::Error GemmImpl(ffi::Buffer<ffi::F32> a, ffi::Buffer<ffi::F32> b,
   cfg_tiles();
   for (int64_t g = 0; g < G; g++)
     gemm(a.typed_data() + g * M * K, b.typed_data() + g * K * N,
-         c->typed_data() + g * M * N, M, N, K);
+         c->typed_data() + g * M * N, M, N, K, trans_b);
   _tile_release();
   return ffi::Error::Success();
+}
+
+ffi::Error GemmImpl(ffi::Buffer<ffi::F32> a, ffi::Buffer<ffi::F32> b,
+                    ffi::ResultBuffer<ffi::F32> c) {
+  return GemmRun(a, b, c, /*trans_b=*/false);
+}
+
+ffi::Error GemmTbImpl(ffi::Buffer<ffi::F32> a, ffi::Buffer<ffi::F32> b,
+                      ffi::ResultBuffer<ffi::F32> c) {
+  return GemmRun(a, b, c, /*trans_b=*/true);
 }
 
 }  // namespace
 
 XLA_FFI_DEFINE_HANDLER_SYMBOL(Af2AmxGemm, GemmImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(Af2AmxGemmTb, GemmTbImpl,
                               ffi::Ffi::Bind()
                                   .Arg<ffi::Buffer<ffi::F32>>()
                                   .Arg<ffi::Buffer<ffi::F32>>()

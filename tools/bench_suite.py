@@ -11,6 +11,7 @@ Configs (BASELINE.md "Benchmark configs to measure"):
     (the ESM seq-embed preprocessing is host-side and not timed here)
   3 EGNN structure module end-to-end, 64-res, backbone coords
   4 SE3-style refiner, refinement_iters=4, reversible trunk
+  5 flagship: depth-48 trunk, 384-res, 3x recycling, pair-sharded mesh
   fold: folds/hour/chip at 256-res with 3 recycles (predict_coords IPA)
 
 Usage:
@@ -145,13 +146,109 @@ def config_fold(tiny, iters):
             "folds_per_hour_per_chip": round(3600.0 / sec, 1)}
 
 
+def config_5(tiny, iters):
+    """BASELINE config 5 — the flagship: depth-48 Evoformer, 384-res,
+    3x recycling, pair representation sharded over the mesh's (i, j)
+    axes when the platform offers >1 device (the v4-32 row of
+    BASELINE.md, scaled to whatever is attached).
+
+    Emits train-step time, AOT peak-memory analysis of the compiled
+    step (pairs with tools/memory_probe.py's depth sweep), and the
+    3-recycle fold time. On a 1-core CPU fallback the full-size step
+    would run for hours, so timing is skipped there with a stated
+    reason — the memory analysis (compile-only) still lands.
+    """
+    import contextlib
+
+    from alphafold2_tpu.parallel import make_mesh, use_mesh
+
+    l = 32 if tiny else 384
+    depth = 4 if tiny else 48
+    dim = 64 if tiny else 256
+    model = Alphafold2(dim=dim, depth=depth, heads=8, dim_head=64,
+                       predict_coords=True, structure_module_depth=2,
+                       dtype=jnp.bfloat16)
+    batch = synthetic_batch(jax.random.PRNGKey(0), batch=1, seq_len=l,
+                            msa_depth=5, with_coords=True)
+
+    ndev = len(jax.devices())
+    mesh = None
+    if ndev >= 4 and ndev % 2 == 0:
+        mesh = make_mesh(1, 2, ndev // 2)   # (i=2, j=ndev/2) pair grid
+    elif ndev == 2:
+        mesh = make_mesh(1, 2, 1)
+    ctx = use_mesh(mesh) if mesh is not None else contextlib.nullcontext()
+
+    entry = {"config": f"5_flagship_depth{depth}_{l}res",
+             "mesh": None if mesh is None else
+             {k: int(v) for k, v in mesh.shape.items()}}
+    with ctx:
+        params = model.init(
+            {"params": jax.random.PRNGKey(1), "mlm": jax.random.PRNGKey(2)},
+            batch["seq"], msa=batch["msa"], mask=batch["mask"],
+            msa_mask=batch["msa_mask"], train=True)
+        state = TrainState.create(apply_fn=model.apply, params=params,
+                                  tx=adam(3e-4), rng=jax.random.PRNGKey(3))
+        step = jax.jit(make_train_step(model), donate_argnums=(0,))
+        compiled = step.lower(state, batch).compile()
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            for k in ("temp_size_in_bytes", "argument_size_in_bytes",
+                      "output_size_in_bytes"):
+                v = getattr(mem, k, None)
+                if v is not None:
+                    entry[k.replace("_in_bytes", "_gb")] = round(
+                        v / 2**30, 3)
+
+        is_cpu = jax.default_backend() == "cpu"
+        if tiny or not is_cpu:
+            # time with the ALREADY-compiled step/state — a second init +
+            # re-jit of the largest model in the suite would double its
+            # dominant cost
+            st = state
+            for _ in range(1):
+                st, metrics = step(st, batch)
+            jax.block_until_ready(metrics["loss"])
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                st, metrics = step(st, batch)
+            jax.block_until_ready(metrics["loss"])
+            entry["train_step_ms"] = round(
+                (time.perf_counter() - t0) / iters * 1e3, 2)
+
+            import functools
+            run = jax.jit(functools.partial(fold, model, num_recycles=3))
+            # st.params, not params: the donated train step above consumed
+            # the original param buffers
+            fparams = st.params
+            res = run(fparams, batch["seq"], msa=batch["msa"],
+                      mask=batch["mask"], msa_mask=batch["msa_mask"])
+            jax.block_until_ready(res.coords if hasattr(res, "coords")
+                                  else res.distogram)
+            t0 = time.perf_counter()
+            for _ in range(max(1, iters // 2)):
+                res = run(fparams, batch["seq"], msa=batch["msa"],
+                          mask=batch["mask"], msa_mask=batch["msa_mask"])
+            jax.block_until_ready(res.coords if hasattr(res, "coords")
+                                  else res.distogram)
+            entry["fold_3recycle_seconds"] = round(
+                (time.perf_counter() - t0) / max(1, iters // 2), 3)
+        else:
+            entry["train_step_ms"] = None
+            entry["skipped"] = ("full-size depth-48/384res step timing "
+                                "skipped on the 1-core CPU fallback "
+                                "(estimated hours/step); memory analysis "
+                                "above is the compile-only artifact")
+    return entry
+
+
 CONFIGS = {"1": config_1, "2": config_2, "3": config_3, "4": config_4,
-           "fold": config_fold}
+           "5": config_5, "fold": config_fold}
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="1,2,3,4,fold")
+    ap.add_argument("--configs", default="1,2,3,4,5,fold")
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--tiny", action="store_true")
     args = ap.parse_args()
