@@ -170,9 +170,15 @@ class Attention(nn.Module):
         i.e. (b, h, ..., n, dh); x: the attention input."""
         out = jnp.moveaxis(out, 1, -2).reshape(
             *x.shape[:-1], self.heads * self.dim_head)
+        return self._gate_and_project(out, x)
+
+    def _gate_and_project(self, out_merged, x):
+        """The tail after head merge — ONE owner for the gating semantics
+        so the XLA/Pallas/ring paths (via finish) and the token-major AMX
+        path cannot diverge."""
         if self.gating:
-            out = out * jnn.sigmoid(self._gating(x))
-        return self._to_out(out)
+            out_merged = out_merged * jnn.sigmoid(self._gating(x))
+        return self._to_out(out_merged)
 
     def __call__(
         self,
@@ -240,10 +246,19 @@ class Attention(nn.Module):
             # the materialized repeat
             attn_bias = jnp.repeat(attn_bias, attn_bias_repeat, axis=0)
 
-        # the two attention contractions route to the AMX host GEMM on the
-        # CPU fallback path (ops/cpu_gemm.py; exact XLA einsums otherwise)
+        # the attention contractions route to the AMX host GEMM on the CPU
+        # fallback path (ops/cpu_gemm.py; exact XLA einsums otherwise).
+        # When eligible, the NATURAL-layout ops consume q/k/v with heads
+        # minor to tokens ([b, n, h, dh], as the projections produce them
+        # modulo one cancelled moveaxis round-trip) and emit the output
+        # token-major — no [b,n,h,d]<->[b,h,n,d] transposes materialize
+        # around the custom calls (XLA folds the two inverse moveaxes
+        # away; an FFI boundary, unlike XLA's own dot, cannot absorb a
+        # layout change).
         from alphafold2_tpu.ops.cpu_gemm import (amx_attention_dots,
-                                                 amx_attention_out)
+                                                 amx_attention_natural_ok,
+                                                 amx_attention_out,
+                                                 amx_attn_av, amx_attn_qk)
 
         if tie_dim is not None:
             # global-query attention: average queries across the tied rows
@@ -254,8 +269,12 @@ class Attention(nn.Module):
             k = k.reshape(b, tie_dim, *k.shape[1:])
             dots = jnp.einsum("bhid,brhjd->brhij", q, k)
             dots = dots.reshape(-1, *dots.shape[2:])
+            natural = False
         else:
-            dots = amx_attention_dots(q, k)
+            q_n, k_n, v_n = (jnp.moveaxis(t, 1, -2) for t in (q, k, v))
+            natural = amx_attention_natural_ok(q_n, k_n)
+            dots = amx_attn_qk(q_n, k_n) if natural \
+                else amx_attention_dots(q, k)
 
         if attn_bias is not None:
             dots = dots + attn_bias.astype(dots.dtype)
@@ -266,6 +285,10 @@ class Attention(nn.Module):
         attn = jnn.softmax(dots, axis=-1)
         attn = self._drop(attn, deterministic=deterministic)
 
+        if natural:
+            out = amx_attn_av(attn, v_n)          # (b, n, h, dh)
+            return self._gate_and_project(
+                out.reshape(*x.shape[:-1], h * dh), x)
         out = amx_attention_out(attn, v)
         return self.finish(out, x)
 

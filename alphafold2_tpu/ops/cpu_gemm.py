@@ -100,6 +100,12 @@ def _ensure_registered() -> bool:
     jax.ffi.register_ffi_target(
         "af2_amx_gemm_tb", jax.ffi.pycapsule(lib.Af2AmxGemmTb),
         platform="cpu")
+    jax.ffi.register_ffi_target(
+        "af2_amx_attn_qk", jax.ffi.pycapsule(lib.Af2AmxAttnQk),
+        platform="cpu")
+    jax.ffi.register_ffi_target(
+        "af2_amx_attn_av", jax.ffi.pycapsule(lib.Af2AmxAttnAv),
+        platform="cpu")
     _registered = True
     return True
 
@@ -202,6 +208,82 @@ def _amx_bmm_tb_bwd(res, g):
 
 
 amx_bmm_tb.defvjp(_amx_bmm_tb_fwd, _amx_bmm_tb_bwd)
+
+
+def _ffi_attn_qk(q, k):
+    """q[B,N,H,D] x k[B,M,H,D] -> [B,H,N,M], heads minor to tokens on
+    both inputs — no transposes materialize around the custom call."""
+    b, n, h, _ = q.shape
+    m = k.shape[1]
+    return jax.ffi.ffi_call(
+        "af2_amx_attn_qk",
+        jax.ShapeDtypeStruct((b, h, n, m), jnp.float32),
+        vmap_method="sequential",
+    )(q, k)
+
+
+def _ffi_attn_av(p, v):
+    """probs[B,H,N,M] x v[B,M,H,D] -> [B,N,H,D] (token-major out)."""
+    b, h, n, _ = p.shape
+    d = v.shape[-1]
+    return jax.ffi.ffi_call(
+        "af2_amx_attn_av",
+        jax.ShapeDtypeStruct((b, n, h, d), jnp.float32),
+        vmap_method="sequential",
+    )(p, v)
+
+
+@jax.custom_vjp
+def amx_attn_qk(q, k):
+    """Natural-layout attention logits on the AMX tiles. The two
+    attention ops are each other's duals, so every gradient is again one
+    of the two kernels; only the probs-sized cotangent transposes."""
+    return _ffi_attn_qk(q, k)
+
+
+def _amx_attn_qk_fwd(q, k):
+    return _ffi_attn_qk(q, k), (q, k)
+
+
+def _amx_attn_qk_bwd(res, g):
+    q, k = res
+    dq = _ffi_attn_av(g, k)
+    dk = _ffi_attn_av(jnp.swapaxes(g, -1, -2), q)
+    return dq, dk
+
+
+amx_attn_qk.defvjp(_amx_attn_qk_fwd, _amx_attn_qk_bwd)
+
+
+@jax.custom_vjp
+def amx_attn_av(p, v):
+    """Natural-layout probs @ v on the AMX tiles (see amx_attn_qk)."""
+    return _ffi_attn_av(p, v)
+
+
+def _amx_attn_av_fwd(p, v):
+    return _ffi_attn_av(p, v), (p, v)
+
+
+def _amx_attn_av_bwd(res, g):
+    p, v = res
+    dp = _ffi_attn_qk(g, v)
+    dv = _ffi_attn_av(jnp.swapaxes(p, -1, -2), g)
+    return dp, dv
+
+
+amx_attn_av.defvjp(_amx_attn_av_fwd, _amx_attn_av_bwd)
+
+
+def amx_attention_natural_ok(q_nhd, k_nhd) -> bool:
+    """True when the whole natural-layout attention path (qk, av, and
+    both backward duals) is AMX-eligible for these [B,tokens,H,D]
+    operands: D and both token counts 32-aligned, f32, flag on."""
+    n, d = q_nhd.shape[1], q_nhd.shape[3]
+    m = k_nhd.shape[1]
+    return (amx_dense_enabled()
+            and q_nhd.dtype == jnp.float32 and k_nhd.dtype == jnp.float32
+            and d % 32 == 0 and n % 32 == 0 and m % 32 == 0)
 
 
 def amx_attention_dots(q, k):

@@ -167,9 +167,12 @@ void block_2x2(const bf16* apack, const bf16* bp0, const bf16* bp1, float* C,
   }
 }
 
-// Full GEMM; K % 32 == 0, N % 16 == 0, any M. trans_b: B passed [N, K].
-void gemm(const float* A, const float* B, float* C, int64_t M, int64_t N,
-          int64_t K, bool trans_b = false) {
+// Full GEMM with explicit leading dimensions (strided rows let callers
+// hand in interior slices of rank-4 tensors, e.g. one attention head of
+// a [b, n, heads, d] block without any transpose). K % 32 == 0,
+// N % 16 == 0, any M. trans_b: B passed [N, K] with row stride ldb.
+void gemm_ld(const float* A, int lda, const float* B, int ldb, float* C,
+             int ldc, int64_t M, int64_t N, int64_t K, bool trans_b) {
   const int kb_n = (int)(K / 32);
   static thread_local std::vector<bf16> bpack;
   static thread_local std::vector<bf16> apack;
@@ -177,23 +180,28 @@ void gemm(const float* A, const float* B, float* C, int64_t M, int64_t N,
   apack.resize((size_t)32 * K);
   for (int64_t n0 = 0; n0 < N; n0 += 16) {
     if (trans_b)
-      pack_b_trans(B, (int)K, (int)K, (int)n0,
-                   bpack.data() + (size_t)n0 * K);
+      pack_b_trans(B, ldb, (int)K, (int)n0, bpack.data() + (size_t)n0 * K);
     else
-      pack_b(B, (int)N, (int)K, (int)n0, bpack.data() + (size_t)n0 * K);
+      pack_b(B, ldb, (int)K, (int)n0, bpack.data() + (size_t)n0 * K);
   }
   for (int64_t m0 = 0; m0 < M; m0 += 32) {
     const int rows = (int)std::min<int64_t>(32, M - m0);
-    pack_a(A, (int)K, (int)m0, rows, (int)K, apack.data());
+    pack_a(A, lda, (int)m0, rows, (int)K, apack.data());
     int64_t n0 = 0;
     for (; n0 + 32 <= N; n0 += 32)
       block_2x2(apack.data(), bpack.data() + (size_t)n0 * K,
-                bpack.data() + (size_t)(n0 + 16) * K, C, (int)N, (int)m0,
+                bpack.data() + (size_t)(n0 + 16) * K, C, ldc, (int)m0,
                 rows, (int)n0, kb_n);
     if (n0 < N)  // odd 16-column tail
       block_2x2(apack.data(), bpack.data() + (size_t)n0 * K, nullptr, C,
-                (int)N, (int)m0, rows, (int)n0, kb_n);
+                ldc, (int)m0, rows, (int)n0, kb_n);
   }
+}
+
+void gemm(const float* A, const float* B, float* C, int64_t M, int64_t N,
+          int64_t K, bool trans_b = false) {
+  gemm_ld(A, (int)K, B, trans_b ? (int)K : (int)N, C, (int)N, M, N, K,
+          trans_b);
 }
 
 namespace ffi = xla::ffi;
@@ -232,6 +240,73 @@ ffi::Error GemmRun(ffi::Buffer<ffi::F32>& a, ffi::Buffer<ffi::F32>& b,
   return ffi::Error::Success();
 }
 
+// q [B,N,H,D] x k [B,M,H,D] -> logits [B,H,N,M]: per-(batch, head) GEMM
+// over interior slices — heads stay minor to tokens, so the caller never
+// materializes a [B,H,N,D] transpose (the attention layout the model
+// actually carries).
+ffi::Error AttnQkImpl(ffi::Buffer<ffi::F32> q, ffi::Buffer<ffi::F32> k,
+                      ffi::ResultBuffer<ffi::F32> c) {
+  if (!amx_request_permission())
+    return ffi::Error(ffi::ErrorCode::kFailedPrecondition,
+                      "AMX tile permission unavailable");
+  auto qd = q.dimensions();
+  auto kd = k.dimensions();
+  if (qd.size() != 4 || kd.size() != 4)
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "af2_amx_attn_qk expects rank-4 [B,N,H,D] operands");
+  const int64_t B = qd[0], N = qd[1], H = qd[2], D = qd[3];
+  const int64_t M = kd[1];
+  if (kd[0] != B || kd[2] != H || kd[3] != D)
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "af2_amx_attn_qk operand shape mismatch");
+  if (D % 32 || M % 16)
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "af2_amx_attn_qk requires D % 32 == 0, M % 16 == 0");
+  cfg_tiles();
+  const int ld = (int)(H * D);
+  for (int64_t ib = 0; ib < B; ib++)
+    for (int64_t ih = 0; ih < H; ih++)
+      gemm_ld(q.typed_data() + ib * N * H * D + ih * D, ld,
+              k.typed_data() + ib * M * H * D + ih * D, ld,
+              c->typed_data() + (ib * H + ih) * N * M, (int)M,
+              N, M, D, /*trans_b=*/true);
+  _tile_release();
+  return ffi::Error::Success();
+}
+
+// probs [B,H,N,M] x v [B,M,H,D] -> out [B,N,H,D]: the dual of AttnQk —
+// the output lands directly in the model's token-major layout (C rows
+// strided by H*D), so no un-transpose follows the attention either.
+ffi::Error AttnAvImpl(ffi::Buffer<ffi::F32> p, ffi::Buffer<ffi::F32> v,
+                      ffi::ResultBuffer<ffi::F32> c) {
+  if (!amx_request_permission())
+    return ffi::Error(ffi::ErrorCode::kFailedPrecondition,
+                      "AMX tile permission unavailable");
+  auto pd = p.dimensions();
+  auto vd = v.dimensions();
+  if (pd.size() != 4 || vd.size() != 4)
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "af2_amx_attn_av expects rank-4 operands");
+  const int64_t B = pd[0], H = pd[1], N = pd[2], M = pd[3];
+  const int64_t D = vd[3];
+  if (vd[0] != B || vd[1] != M || vd[2] != H)
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "af2_amx_attn_av operand shape mismatch");
+  if (M % 32 || D % 16)
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "af2_amx_attn_av requires M % 32 == 0, D % 16 == 0");
+  cfg_tiles();
+  const int ld = (int)(H * D);
+  for (int64_t ib = 0; ib < B; ib++)
+    for (int64_t ih = 0; ih < H; ih++)
+      gemm_ld(p.typed_data() + (ib * H + ih) * N * M, (int)M,
+              v.typed_data() + ib * M * H * D + ih * D, ld,
+              c->typed_data() + ib * N * H * D + ih * D, ld,
+              N, D, M, /*trans_b=*/false);
+  _tile_release();
+  return ffi::Error::Success();
+}
+
 ffi::Error GemmImpl(ffi::Buffer<ffi::F32> a, ffi::Buffer<ffi::F32> b,
                     ffi::ResultBuffer<ffi::F32> c) {
   return GemmRun(a, b, c, /*trans_b=*/false);
@@ -251,6 +326,18 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(Af2AmxGemm, GemmImpl,
                                   .Ret<ffi::Buffer<ffi::F32>>());
 
 XLA_FFI_DEFINE_HANDLER_SYMBOL(Af2AmxGemmTb, GemmTbImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(Af2AmxAttnQk, AttnQkImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(Af2AmxAttnAv, AttnAvImpl,
                               ffi::Ffi::Bind()
                                   .Arg<ffi::Buffer<ffi::F32>>()
                                   .Arg<ffi::Buffer<ffi::F32>>()

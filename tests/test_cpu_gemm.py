@@ -213,3 +213,46 @@ class TestBatchedAndAttention:
         cpu_gemm.use_amx_dense(False)
         out_xla = attn.apply(params, x)
         assert 0.0 < _rel_err(out_amx, out_xla) < 3e-2
+
+    def test_natural_layout_attention_ops(self):
+        """amx_attn_qk/amx_attn_av consume token-major [B,N,H,D] operands
+        (no transposes around the FFI boundary) and are each other's
+        backward duals."""
+        _amx_or_skip()
+        k1, k2 = jax.random.split(jax.random.PRNGKey(11))
+        q = jax.random.normal(k1, (2, 64, 4, 32), jnp.float32)
+        k = jax.random.normal(k2, (2, 96, 4, 32), jnp.float32)
+        v = jax.random.normal(k1, (2, 96, 4, 32), jnp.float32)
+        dots = cpu_gemm.amx_attn_qk(q, k)
+        want = jnp.einsum("bnhd,bmhd->bhnm", q, k)
+        assert 0.0 < _rel_err(dots, want) < 2e-2
+        p = jax.nn.softmax(want, -1)
+        out = cpu_gemm.amx_attn_av(p, v)
+        wout = jnp.einsum("bhnm,bmhd->bnhd", p, v)
+        assert 0.0 < _rel_err(out, wout) < 2e-2
+        # gradients (dual-kernel backward)
+        dq1, dk1 = jax.grad(
+            lambda q, k: (cpu_gemm.amx_attn_qk(q, k) ** 2).sum(),
+            (0, 1))(q, k)
+        dq2, dk2 = jax.grad(
+            lambda q, k: (jnp.einsum("bnhd,bmhd->bhnm", q, k) ** 2).sum(),
+            (0, 1))(q, k)
+        assert _rel_err(dq1, dq2) < 5e-2 and _rel_err(dk1, dk2) < 5e-2
+        dp1, dv1 = jax.grad(
+            lambda p, v: (cpu_gemm.amx_attn_av(p, v) ** 2).sum(),
+            (0, 1))(p, v)
+        dp2, dv2 = jax.grad(
+            lambda p, v: (jnp.einsum("bhnm,bmhd->bnhd", p, v) ** 2).sum(),
+            (0, 1))(p, v)
+        assert _rel_err(dp1, dp2) < 5e-2 and _rel_err(dv1, dv2) < 5e-2
+
+    def test_natural_eligibility_gate(self):
+        _amx_or_skip()
+        ok = jnp.zeros((1, 64, 2, 32), jnp.float32)
+        assert cpu_gemm.amx_attention_natural_ok(ok, ok)
+        # misaligned token count -> whole natural path declines
+        bad_n = jnp.zeros((1, 48, 2, 32), jnp.float32)
+        assert not cpu_gemm.amx_attention_natural_ok(bad_n, ok)
+        # misaligned head dim
+        bad_d = jnp.zeros((1, 64, 2, 48), jnp.float32)
+        assert not cpu_gemm.amx_attention_natural_ok(bad_d, bad_d)
