@@ -44,6 +44,12 @@ import jax.numpy as jnp  # noqa: E402
 
 _enable_compile_cache()
 
+# BENCH_DTYPE override, mirroring bench.py: the production dtype is bf16
+# (TPU MXU); the CPU fallback recipe runs f32 + AMX (see bench.py's
+# _CPU_XLA_FLAGS comment) — bf16 on XLA:CPU is emulated in f32 with
+# rounding converts and the AMX router is f32-only.
+_DTYPE = jnp.dtype(os.environ.get("BENCH_DTYPE", "bfloat16"))
+
 from alphafold2_tpu import Alphafold2  # noqa: E402
 from alphafold2_tpu.data.synthetic import synthetic_batch  # noqa: E402
 from alphafold2_tpu.predict import fold  # noqa: E402
@@ -71,7 +77,7 @@ def _train_step_ms(model, batch, iters, warmup=1):
 def config_1(tiny, iters):
     l = 32 if tiny else 128
     model = Alphafold2(dim=64 if tiny else 256, depth=2, heads=8,
-                       dim_head=64, dtype=jnp.bfloat16)
+                       dim_head=64, dtype=_DTYPE)
     batch = synthetic_batch(jax.random.PRNGKey(0), batch=1, seq_len=l,
                             msa_depth=5, with_coords=True)
     return {"config": "1_distogram_128res",
@@ -82,7 +88,7 @@ def config_2(tiny, iters):
     l = 32 if tiny else 128
     dim = 64 if tiny else 256
     model = Alphafold2(dim=dim, depth=2, heads=8, dim_head=64,
-                       predict_angles=True, dtype=jnp.bfloat16)
+                       predict_angles=True, dtype=_DTYPE)
     # with_angles: theta/phi/omega bucket targets so the anglegram CE
     # loss (and its backward) is actually part of the timed step
     batch = synthetic_batch(jax.random.PRNGKey(0), batch=1, seq_len=l,
@@ -97,7 +103,7 @@ def config_3(tiny, iters):
     model = Alphafold2(dim=32 if tiny else 128, depth=2, heads=8,
                        dim_head=64, predict_coords=True,
                        structure_module_type="egnn",
-                       structure_module_depth=2, dtype=jnp.bfloat16)
+                       structure_module_depth=2, dtype=_DTYPE)
     batch = synthetic_batch(jax.random.PRNGKey(0), batch=1, seq_len=l,
                             msa_depth=5, with_coords=True)
     return {"config": "3_egnn_end2end_64res",
@@ -111,7 +117,7 @@ def config_4(tiny, iters):
                        structure_module_type="se3",
                        structure_module_depth=2,
                        structure_module_refinement_iters=4,
-                       reversible=True, dtype=jnp.bfloat16)
+                       reversible=True, dtype=_DTYPE)
     batch = synthetic_batch(jax.random.PRNGKey(0), batch=1, seq_len=l,
                             msa_depth=5, with_coords=True)
     return {"config": "4_se3_refine_reversible",
@@ -122,7 +128,7 @@ def config_fold(tiny, iters):
     l = 32 if tiny else 256
     model = Alphafold2(dim=64 if tiny else 256, depth=2, heads=8,
                        dim_head=64, predict_coords=True,
-                       structure_module_depth=2, dtype=jnp.bfloat16)
+                       structure_module_depth=2, dtype=_DTYPE)
     batch = synthetic_batch(jax.random.PRNGKey(0), batch=1, seq_len=l,
                             msa_depth=5, with_coords=False)
     params = model.init(jax.random.PRNGKey(1), batch["seq"],
@@ -167,7 +173,7 @@ def config_5(tiny, iters):
     dim = 64 if tiny else 256
     model = Alphafold2(dim=dim, depth=depth, heads=8, dim_head=64,
                        predict_coords=True, structure_module_depth=2,
-                       dtype=jnp.bfloat16)
+                       dtype=_DTYPE)
     batch = synthetic_batch(jax.random.PRNGKey(0), batch=1, seq_len=l,
                             msa_depth=5, with_coords=True)
 
