@@ -38,16 +38,15 @@ from __graft_entry__ import (_enable_compile_cache, force_cpu_fallback,  # noqa:
 if not jax_backends_initialized() and \
         os.environ.get("BENCH_NO_FALLBACK") != "1" and not tiny_op_probe():
     # same CPU recipe as bench.py's _cpu_env: f32 activations + AMX Dense
-    # + XNN greedy/fast-math flags — all still read after this point
-    # (XLA_FLAGS at backend init, AF2_CPU_AMX/BENCH_DTYPE at trace time)
+    # + the SHARED flag constant (one owner — a drifted copy here would
+    # silently benchmark a different compiler configuration). All still
+    # take effect after this point: XLA_FLAGS at backend init,
+    # AF2_CPU_AMX/BENCH_DTYPE at trace time.
+    from bench import _CPU_XLA_FLAGS
     os.environ.setdefault("BENCH_DTYPE", "float32")
     os.environ.setdefault("AF2_CPU_AMX", "1")
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + (
-        " --xla_cpu_experimental_xnn_graph_fusion_mode="
-        "XNN_GRAPH_FUSION_MODE_GREEDY"
-        " --xla_cpu_enable_fast_math=true"
-        " --xla_cpu_fast_math_honor_nans=false"
-        " --xla_cpu_fast_math_honor_infs=false")).strip()
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " +
+                               _CPU_XLA_FLAGS).strip()
     force_cpu_fallback("bench_suite: default platform unreachable")
 
 import jax  # noqa: E402
