@@ -116,25 +116,26 @@ def favor_softmax_features(x, proj, is_query: bool, eps: float = 1e-4,
     unbiased estimator E[phi(q)^T phi(k)] = exp(q . k).
 
     x: (..., n, d) already scaled by d^-1/4 (so q.k carries the 1/sqrt(d)
-    softmax temperature). Stabilizer c: per-token max for queries (cancels
-    in the attention ratio), global max for keys (uniform scale, also
-    cancels). `mask` (..., n) excludes padded tokens from the key max —
-    a single garbage key above the valid maximum would otherwise push
-    every real phi(k) to the eps floor; masked rows are also pinned at c
-    so exp cannot overflow before the caller zeroes them."""
+    softmax temperature). Stabilizer c: per-token max for queries, per
+    ATTENTION INSTANCE (last two axes: tokens x features, i.e. one c per
+    batch/head slice) for keys — both cancel in the attention ratio. A
+    coarser global key max would let one high-magnitude batch entry crush
+    every other entry's features toward the eps floor (performer-pytorch
+    likewise uses amax over (-1, -2)). `mask` (..., n) excludes padded
+    tokens from the key max; masked rows are pinned near c so exp cannot
+    overflow before the caller zeroes them."""
     m = proj.shape[0]
     u = x @ proj.T                                     # (..., n, m)
     sq = (x * x).sum(-1, keepdims=True) / 2.0
     h = u - sq
     if mask is not None:
         h = jnp.where(mask[..., None], h, -jnp.inf)
+    finite = jnp.where(jnp.isfinite(h), h, -1e30)
     if is_query:
-        c = jax.lax.stop_gradient(
-            jnp.max(jnp.where(jnp.isfinite(h), h, -1e30), -1,
-                    keepdims=True))
+        c = jax.lax.stop_gradient(finite.max(-1, keepdims=True))
     else:
         c = jax.lax.stop_gradient(
-            jnp.max(jnp.where(jnp.isfinite(h), h, -1e30)))
+            jnp.max(finite, axis=(-1, -2), keepdims=True))
     h = jnp.where(jnp.isfinite(h), h, c - 100.0)  # masked -> exp ~ 0
     return (jnp.exp(h - c) + eps) / jnp.sqrt(m)
 
@@ -175,7 +176,13 @@ class PerformerAttention(nn.Module):
         if self.has_rng("performer"):
             feat_key = self.make_rng("performer")
         else:
-            feat_key = jax.random.PRNGKey(0)
+            # deterministic fallback, distinct per module path (helps
+            # unrolled trunks; a scanned trunk shares one module, so
+            # per-layer independence there comes from supplying the
+            # 'performer' rng — the train loop and predict.fold both do)
+            import zlib
+            path = "/".join(self.scope.path) if self.scope else ""
+            feat_key = jax.random.PRNGKey(zlib.crc32(path.encode()))
         proj = orthogonal_random_features(feat_key, self.nb_features,
                                           self.dim_head)
 
@@ -409,6 +416,17 @@ class BlockSparseAttention(nn.Module):
                          dim_head=self.dim_head, dropout=self.dropout,
                          dtype=self.dtype, name="attn")
 
+        if pallas_attention_enabled() and n % self.block == 0 and \
+                not (self.dropout == 0.0 or deterministic):
+            # refuse-to-be-silent: the Pallas kernel has no dropout, so a
+            # dropout-active training trace pays full dense n^2 attention
+            import warnings
+            warnings.warn(
+                "BlockSparseAttention: dropout>0 under training falls "
+                "back to DENSE masked attention (the Pallas block-"
+                "skipping kernel has no dropout) — the sparse FLOP "
+                "savings do not apply to these steps", RuntimeWarning,
+                stacklevel=2)
         if pallas_attention_enabled() and n % self.block == 0 and \
                 (self.dropout == 0.0 or deterministic):
             from alphafold2_tpu.ops.block_sparse import (

@@ -46,7 +46,13 @@ def fold(
         coords, ret = model.apply(
             params, seq, msa=msa, mask=mask, msa_mask=msa_mask,
             recyclables=recyclables, return_aux_logits=True,
-            return_recyclables=True, **extra)
+            return_recyclables=True,
+            # a deterministic 'performer' rng: under the trunk scan its
+            # split_rngs give each layer an INDEPENDENT FAVOR+ projection
+            # at inference (per-layer estimator errors average out instead
+            # of adding coherently); unused collections are harmless for
+            # models without Performer layers
+            rngs={"performer": jax.random.PRNGKey(0)}, **extra)
         return coords, ret
 
     # first pass has no recyclables (params cover both traces via the
