@@ -223,3 +223,34 @@ class TestPerformer:
         r1 = mod.apply(params, x, rngs={"performer": jax.random.PRNGKey(3)})
         r2 = mod.apply(params, x, rngs={"performer": jax.random.PRNGKey(4)})
         assert float(jnp.abs(r1 - r2).max()) > 1e-6
+
+    def test_favor_batch_isolation(self):
+        """Regression: the key stabilizer is per attention instance, so a
+        high-magnitude batch entry must not degrade a low-scale entry's
+        approximation (a global key max crushed the cold entry's features
+        toward the eps floor)."""
+        from alphafold2_tpu.model.attention_variants import (
+            favor_softmax_features, orthogonal_random_features)
+
+        d, n, m = 32, 16, 2048
+        kq, kk = jax.random.split(jax.random.PRNGKey(2))
+        scale = d ** 0.25
+        q_cold = jax.random.normal(kq, (1, n, d)) * 0.3
+        k_cold = jax.random.normal(kk, (1, n, d)) * 0.3
+        q_hot, k_hot = q_cold * 6.0, k_cold * 6.0  # ~tens of nats hotter
+        proj = orthogonal_random_features(jax.random.PRNGKey(3), m, d)
+
+        def cold_err(qb, kb):
+            pq = favor_softmax_features(qb / scale, proj, is_query=True)
+            pk = favor_softmax_features(kb / scale, proj, is_query=False)
+            num = pq @ jnp.swapaxes(pk, -1, -2)
+            approx = num / num.sum(-1, keepdims=True)
+            exact = jax.nn.softmax(
+                qb @ jnp.swapaxes(kb, -1, -2) / jnp.sqrt(d), axis=-1)
+            return float(jnp.abs(approx - exact)[0].max())
+
+        alone = cold_err(q_cold, k_cold)
+        batched = cold_err(jnp.concatenate([q_cold, q_hot]),
+                           jnp.concatenate([k_cold, k_hot]))
+        # cold entry's error must be unchanged by the hot neighbor
+        assert batched < alone * 1.5 + 1e-3, (alone, batched)
