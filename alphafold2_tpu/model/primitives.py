@@ -25,6 +25,8 @@ in via `alphafold2_tpu.ops` once it beats the XLA baseline.
 
 from __future__ import annotations
 
+import warnings
+
 from typing import Optional
 
 import jax.numpy as jnp
@@ -214,8 +216,18 @@ class Attention(nn.Module):
         # path. Both backends share the gating/projection tail below.
         from alphafold2_tpu.ops.attention import (
             fused_attention, pallas_attention_enabled)
-        if pallas_attention_enabled() and tie_dim is None and \
-                (self.dropout == 0.0 or deterministic):
+        use_pallas = pallas_attention_enabled() and tie_dim is None
+        if use_pallas and self.dropout > 0.0 and not deterministic:
+            # refuse-don't-drop convention (evoformer.py menu): the fused
+            # kernel has no dropout; say so instead of silently slowing
+            warnings.warn(
+                "Pallas fused attention is enabled but attention dropout "
+                f"({self.dropout}) is active in a training trace; this "
+                "layer falls back to the XLA attention path. Set "
+                "attn_dropout=0.0 or run deterministic to keep the "
+                "kernel.", stacklevel=2)
+            use_pallas = False
+        if use_pallas:
             b_all = q.shape[0]
             n_q, n_k = q.shape[-2], k.shape[-2]
             if attn_bias is not None:
@@ -309,8 +321,10 @@ class AxialAttention(nn.Module):
     instead of letting GSPMD all-gather the full attended axis
     (SURVEY.md §5.7 hard-part #1). Same params either way (the ring path
     reuses the inner Attention's projections), so the flag is purely an
-    execution-strategy switch. Falls back to the dense path for
-    global-query (tie_dim) attention and dropout-active traces.
+    execution-strategy switch. Falls back to the dense path only for
+    global-query (tie_dim) attention; training-time attention dropout
+    runs inside the ring (per-device/key-shard fold_in masks, see
+    parallel/ring.py) rather than disabling it.
     """
 
     dim: int
@@ -350,7 +364,7 @@ class AxialAttention(nn.Module):
                 return None
         return mesh
 
-    def _ring_forward(self, x, edges, mask, mesh):
+    def _ring_forward(self, x, edges, mask, mesh, dropout_key=None):
         """Ring-parallel axial attention over the sharded attended axis.
 
         Reuses the inner Attention's projections/tail so the params tree
@@ -380,18 +394,20 @@ class AxialAttention(nn.Module):
                             name="edges_to_attn_bias")(edges)
             bias = bias.transpose(0, 3, 1, 2)  # (b, heads, i, j)
 
+        drop = dict(dropout_rate=self.dropout if dropout_key is not None
+                    else 0.0, dropout_key=dropout_key)
         ax_h, ax_w = self.ring_axes
         if self.row_attn:
             out = pair_row_attention_sharded(
                 q, k, v, bias, mesh, i_axis=ax_h, j_axis=ax_w,
-                mask=mask)
+                mask=mask, **drop)
 
         else:
             swap = lambda t: t.swapaxes(2, 3)  # (b, h, W, H, dh)
             out = pair_row_attention_sharded(
                 swap(q), swap(k), swap(v), bias, mesh,
                 i_axis=ax_w, j_axis=ax_h,
-                mask=None if mask is None else mask.swapaxes(1, 2))
+                mask=None if mask is None else mask.swapaxes(1, 2), **drop)
             out = out.swapaxes(2, 3)
 
         return attn.finish(out, x)
@@ -404,11 +420,15 @@ class AxialAttention(nn.Module):
         b, height, width, d = x.shape
         x = LayerNorm(dtype=self.dtype)(x)
 
-        ring_mesh = None
-        if self.dropout == 0.0 or deterministic:
-            ring_mesh = self._ring_mesh(height, width)
+        # the ring path stays active under training-time dropout (round-4
+        # VERDICT #5 — it used to silently de-ring): the mask is drawn
+        # inside the ring from per-(device, key-shard) fold_in keys
+        ring_mesh = self._ring_mesh(height, width)
         if ring_mesh is not None:
-            return self._ring_forward(x, edges, mask, ring_mesh)
+            drop_key = None
+            if self.dropout > 0.0 and not deterministic:
+                drop_key = self.make_rng("dropout")
+            return self._ring_forward(x, edges, mask, ring_mesh, drop_key)
 
         if self.col_attn:
             axial_dim = width

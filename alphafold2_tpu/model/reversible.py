@@ -38,6 +38,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import linen as nn
 
 from alphafold2_tpu.model.attention_variants import (
@@ -250,7 +251,12 @@ def _run_bwd(cfg, res, g):
                                    (stacked_params, keys), reverse=True)
     zero_mask = None if mask is None else jnp.zeros_like(mask)
     zero_msa = None if msa_mask is None else jnp.zeros_like(msa_mask)
-    return dps, d_in, zero_mask, zero_msa, None
+    # the PRNG key is an integer-typed operand: its documented cotangent
+    # type is a float0 zero, not None (None happens to pass under current
+    # JAX but is not contract — ADVICE r4)
+    zero_key = None if key is None else \
+        np.zeros(np.shape(key), dtype=jax.dtypes.float0)
+    return dps, d_in, zero_mask, zero_msa, zero_key
 
 
 _run_reversible.defvjp(_run_fwd, _run_bwd)

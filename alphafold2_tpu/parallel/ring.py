@@ -99,6 +99,59 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+def _as_key_data(key) -> jnp.ndarray:
+    """PRNG key -> raw uint32 data (shard_map-friendly replicated operand)."""
+    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        return jax.random.key_data(key)
+    return key
+
+
+def _device_dropout_key(key_data, coords):
+    """Per-device base dropout key: fold each mesh coordinate of the
+    device into the replicated base key. `coords` are traced axis_index
+    values (skipping axes the operand is not sharded over), so every
+    device derives an independent mask stream — the reversible trunk's
+    fold_in recipe (model/reversible.py) applied to the mesh."""
+    k = jax.random.wrap_key_data(key_data)
+    for c in coords:
+        k = jax.random.fold_in(k, c)
+    return k
+
+
+def pair_row_dropout_mask(
+    key, rate: float, *, b: int, h: int, j_blocks: int,
+    il: int, jl: int, i_blocks: int | None = 1,
+    data_coord: int | None = None,
+):
+    """Dense replay of the ring kernel's dropout mask derivation, for
+    parity tests: returns the full (b, h, I, J_q, J_k) keep mask that a
+    mesh run of `pair_row_attention_sharded` with the same `key`
+    realizes. `i_blocks=None` mirrors an unsharded row axis (i coord not
+    folded); an int mirrors an i mesh axis of that size (folded even at
+    size 1, matching the kernel). Shares `_device_dropout_key` with the
+    kernel so the derivation cannot drift; what the parity test then
+    checks independently is the ring's *distribution* semantics
+    (undropped row_sum normalization, 1/(1-rate) scaling, gradient
+    flow)."""
+    kd = _as_key_data(key)
+    rows = []
+    for ic in range(i_blocks or 1):
+        cols = []
+        for jc in range(j_blocks):
+            coords = [] if data_coord is None else [data_coord]
+            coords += ([] if i_blocks is None else [ic]) + [jc]
+            dev = _device_dropout_key(kd, coords)
+            blocks = [
+                jax.random.bernoulli(
+                    jax.random.fold_in(dev, ks), 1.0 - rate,
+                    (b, h, il, jl, jl))
+                for ks in range(j_blocks)
+            ]
+            cols.append(jnp.concatenate(blocks, axis=-1))
+        rows.append(jnp.concatenate(cols, axis=-2))
+    return jnp.concatenate(rows, axis=2)
+
+
 def pair_row_attention_sharded(
     q: jnp.ndarray,      # (b, h, I, J, d) global, pre-scaled
     k: jnp.ndarray,
@@ -109,6 +162,8 @@ def pair_row_attention_sharded(
     j_axis: str = "j",
     mask: Optional[jnp.ndarray] = None,   # (b, I, J) per-row key validity
     data_axis: Optional[str] = "data",
+    dropout_rate: float = 0.0,
+    dropout_key=None,             # PRNG key; required when rate > 0
 ) -> jnp.ndarray:
     """Row attention over the J axis of a sharded 2-D map, ring-parallel
     (SURVEY.md §5.7 hard-part #1).
@@ -132,7 +187,20 @@ def pair_row_attention_sharded(
     dim sharded inside the shard_map; without it the data-parallel batch
     would be all-gathered (and redundantly computed) across the data
     axis for the duration of the ring.
+
+    Training-time attention-prob dropout runs INSIDE the ring (round-4
+    VERDICT #5 — it used to silently disable the ring): each device
+    folds its mesh coordinates into `dropout_key`, then folds the global
+    key-shard index per ring step, and Bernoulli-drops the unnormalized
+    softmax numerator while `row_sum` accumulates UNDROPPED — exactly
+    the dense semantics `out = dropout(softmax(logits)) @ v` with
+    1/(1-rate) scaling, since the softmax normalizer is independent of
+    which post-softmax terms dropout zeroes.
     """
+    if dropout_rate > 0.0 and dropout_key is None:
+        raise ValueError("pair_row_attention_sharded: dropout_rate > 0 "
+                         "requires dropout_key")
+
     def ax(name, dim=None):
         if name is None or name not in mesh.axis_names:
             return None
@@ -146,19 +214,31 @@ def pair_row_attention_sharded(
     mask_spec = P(da, ia, None)               # rows local, key axis whole
     has_bias = bias is not None
 
+    has_mask = mask is not None
+    has_drop = dropout_rate > 0.0
+
     args = [q, k, v]
     in_specs = [spec, spec, spec]
     if has_bias:
         args.append(bias)
         in_specs.append(bias_spec)
-    if mask is not None:
+    if has_mask:
         args.append(mask)
         in_specs.append(mask_spec)
+    if has_drop:
+        args.append(_as_key_data(dropout_key))
+        in_specs.append(P(None))              # replicated; devices fold
+                                              # their own mesh coords in
 
     def kernel(qi, ki, vi, *rest):
         rest = list(rest)
         bi = rest.pop(0) if has_bias else None
-        mi = rest.pop(0) if rest else None
+        mi = rest.pop(0) if has_mask else None
+        dev_key = None
+        if has_drop:
+            coords = [jax.lax.axis_index(a) for a in (da, ia) if a]
+            coords.append(jax.lax.axis_index(j_axis))
+            dev_key = _device_dropout_key(rest.pop(0), coords)
         b, h, il, jl, d = qi.shape
         n_shards = jax.lax.axis_size(j_axis)
         my_idx = jax.lax.axis_index(j_axis)
@@ -189,8 +269,16 @@ def pair_row_attention_sharded(
             new_max = jnp.maximum(row_max, logits.max(-1))
             corr = jnp.exp(row_max - new_max)
             p = jnp.exp(logits - new_max[..., None])
+            p_av = p
+            if dev_key is not None:
+                # drop the numerator only; row_sum stays undropped so the
+                # final acc/row_sum equals dense dropout(softmax(..)) @ v
+                keep = jax.random.bernoulli(
+                    jax.random.fold_in(dev_key, shard),
+                    1.0 - dropout_rate, p.shape)
+                p_av = p * keep / (1.0 - dropout_rate)
             acc2 = acc * corr[..., None] + jnp.einsum(
-                "bhiqk,bhikd->bhiqd", p, v_cur.astype(jnp.float32))
+                "bhiqk,bhikd->bhiqd", p_av, v_cur.astype(jnp.float32))
             sum2 = row_sum * corr + p.sum(-1)
             return (acc2, new_max, sum2,
                     jax.lax.ppermute(k_cur, j_axis, perm),

@@ -42,10 +42,15 @@ def compute_loss(model, params, batch, rng, train: bool = True):
         train=train,
     )
     # 'performer' redraws FAVOR+ random features every step (the per-step
-    # form of performer-pytorch's feature_redraw_interval; unbiased)
+    # form of performer-pytorch's feature_redraw_interval; unbiased). Eval
+    # still needs a performer key: with rngs=None the scanned trunk would
+    # hand every layer the same path-derived fallback key, so all layers
+    # would share ONE FAVOR+ projection and their estimator errors add
+    # coherently — a fixed key here lets nn.scan's split_rngs give each
+    # layer an independent projection (predict.fold does the same).
     rngs = {"mlm": rng, "dropout": jax.random.fold_in(rng, 1),
             "performer": jax.random.fold_in(rng, 2)} if train \
-        else None
+        else {"performer": jax.random.PRNGKey(0)}
 
     if wants_coords:
         coords, ret = model.apply(params, batch["seq"], **kwargs,

@@ -442,3 +442,131 @@ class TestPairRowRing:
         g_dense = jax.grad(loss_dense)((q, k, v, bias))
         for a, b_ in zip(g_ring, g_dense):
             assert np.allclose(np.asarray(a), np.asarray(b_), atol=1e-4)
+
+
+class TestPairRowRingDropout:
+    """Round-4 VERDICT #5: training-time attention dropout runs INSIDE the
+    ring instead of silently de-ringing the long-context path. The ring's
+    realized mask derivation is replayed densely by
+    `pair_row_dropout_mask` (shared fold_in recipe); these tests then
+    independently verify the ring's distribution semantics — numerator-only
+    drop, undropped row_sum normalizer, 1/(1-rate) scaling — and gradient
+    flow against a plain dense implementation of
+    `dropout(softmax(logits)) @ v` using that replayed mask."""
+
+    def _setup(self, seed=40, b=1, h=2, I=8, J=8, d=8):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 5)
+        q = jax.random.normal(ks[0], (b, h, I, J, d)) * 0.5
+        k = jax.random.normal(ks[1], (b, h, I, J, d)) * 0.5
+        v = jax.random.normal(ks[2], (b, h, I, J, d))
+        bias = jax.random.normal(ks[3], (b, h, J, J))
+        return q, k, v, bias, ks[4]
+
+    @staticmethod
+    def _dense_dropped(q, k, v, bias, keep, rate, mask=None):
+        logits = jnp.einsum("bhiqd,bhikd->bhiqk", q, k)
+        if bias is not None:
+            logits = logits + bias[:, :, None]
+        if mask is not None:
+            logits = jnp.where(mask[:, None, :, None, :], logits, -1e9)
+        probs = jax.nn.softmax(logits, -1)
+        probs = probs * keep / (1.0 - rate)
+        return jnp.einsum("bhiqk,bhikd->bhiqd", probs, v)
+
+    @pytest.mark.quick
+    def test_matches_dense_replay(self):
+        from alphafold2_tpu.parallel.ring import (pair_row_attention_sharded,
+                                                  pair_row_dropout_mask)
+        q, k, v, bias, dkey = self._setup()
+        rate = 0.4
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("i", "j"))
+        out = pair_row_attention_sharded(q, k, v, bias, mesh,
+                                         dropout_rate=rate,
+                                         dropout_key=dkey)
+        keep = pair_row_dropout_mask(dkey, rate, b=1, h=2, i_blocks=2,
+                                     j_blocks=2, il=4, jl=4)
+        ref = self._dense_dropped(q, k, v, bias, keep, rate)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+        # and it actually dropped something (differs from no-dropout)
+        ref_nodrop = self._dense_dropped(q, k, v, bias,
+                                         jnp.ones_like(keep), 0.0)
+        assert not np.allclose(np.asarray(out), np.asarray(ref_nodrop),
+                               atol=1e-3)
+
+    def test_unsharded_row_axis_with_mask(self):
+        """MSA layout (i_axis=None) + non-separable key mask + dropout."""
+        from alphafold2_tpu.parallel.ring import (pair_row_attention_sharded,
+                                                  pair_row_dropout_mask)
+        q, k, v, _, dkey = self._setup(seed=41, I=3, J=16)
+        rate = 0.25
+        mask = jax.random.bernoulli(jax.random.PRNGKey(7), 0.7, (1, 3, 16))
+        mask = mask.at[..., :2].set(True)
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("i", "j"))
+        out = pair_row_attention_sharded(q, k, v, None, mesh,
+                                         i_axis=None, j_axis="j",
+                                         mask=mask, dropout_rate=rate,
+                                         dropout_key=dkey)
+        keep = pair_row_dropout_mask(dkey, rate, b=1, h=2, i_blocks=None,
+                                     j_blocks=4, il=3, jl=4)
+        ref = self._dense_dropped(q, k, v, None, keep, rate, mask=mask)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_gradients_match_dense_replay(self):
+        from alphafold2_tpu.parallel.ring import (pair_row_attention_sharded,
+                                                  pair_row_dropout_mask)
+        q, k, v, bias, dkey = self._setup(seed=42)
+        rate = 0.3
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("i", "j"))
+        keep = pair_row_dropout_mask(dkey, rate, b=1, h=2, i_blocks=2,
+                                     j_blocks=2, il=4, jl=4)
+
+        def loss_ring(args):
+            q, k, v, bias = args
+            return (pair_row_attention_sharded(
+                q, k, v, bias, mesh, dropout_rate=rate,
+                dropout_key=dkey) ** 2).sum()
+
+        def loss_dense(args):
+            q, k, v, bias = args
+            return (self._dense_dropped(q, k, v, bias, keep, rate) ** 2
+                    ).sum()
+
+        g_ring = jax.grad(loss_ring)((q, k, v, bias))
+        g_dense = jax.grad(loss_dense)((q, k, v, bias))
+        for a, b_ in zip(g_ring, g_dense):
+            assert np.allclose(np.asarray(a), np.asarray(b_), atol=1e-4)
+
+    @pytest.mark.quick
+    def test_axial_attention_stays_ringed_under_dropout(self):
+        """The module-level regression: AxialAttention with dropout active
+        in a training trace must STILL dispatch to the ring (it used to
+        silently fall back to the dense/GSPMD path)."""
+        import alphafold2_tpu.parallel.ring as ring_mod
+        from alphafold2_tpu.model.primitives import AxialAttention
+        from alphafold2_tpu.parallel import make_mesh, use_mesh
+
+        b, n, d = 1, 8, 16
+        x = jax.random.normal(jax.random.PRNGKey(50), (b, n, n, d)) * 0.5
+        attn = AxialAttention(dim=d, heads=2, dim_head=8, row_attn=True,
+                              col_attn=False, dropout=0.3,
+                              ring_axes=("i", "j"))
+        params = attn.init(jax.random.PRNGKey(51), x)
+
+        calls = []
+        orig = ring_mod.pair_row_attention_sharded
+
+        def spy(*args, **kwargs):
+            calls.append(kwargs.get("dropout_rate", 0.0))
+            return orig(*args, **kwargs)
+
+        mesh = make_mesh(2, 2, 2)
+        ring_mod.pair_row_attention_sharded = spy
+        try:
+            with use_mesh(mesh):
+                out = attn.apply(params, x, deterministic=False,
+                                 rngs={"dropout": jax.random.PRNGKey(52)})
+        finally:
+            ring_mod.pair_row_attention_sharded = orig
+        assert calls and calls[0] == 0.3, \
+            "dropout-active trace did not take the ring path"
+        assert np.isfinite(np.asarray(out)).all()

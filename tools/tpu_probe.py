@@ -1,0 +1,171 @@
+"""Round-long TPU tunnel probe daemon (VERDICT round-4 item #1).
+
+The tunnel's observed failure modes (rounds 2-4): the tiny-op probe times
+out, or — half-wedged — tiny-op passes and the model compile hangs. This
+daemon spreads cheap probes across the whole round so a briefly-live
+tunnel is caught, logs EVERY attempt with timestamps to
+tools/tpu_probe_log.json (the committed evidence either way), and on the
+first success immediately spends the window running the prepared on-chip
+suite in priority order:
+
+  1. python bench.py                       -> tools/tpu_bench_live.json
+  2. BENCH_PALLAS=1 python bench.py        -> tools/tpu_bench_pallas.json
+  3. python tools/bench_blocksparse.py     -> tools/tpu_blocksparse.json
+  4. python tools/bench_suite.py (on-chip) -> tools/tpu_bench_suite.json
+
+Artifacts land in tools/ (never auto-committed — the foreground session
+commits them); tools/TPU_WOKE is touched as a flag. Runs until killed or
+--max-hours elapses.
+
+Usage: python tools/tpu_probe.py [--interval 600] [--max-hours 11]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+LOG = os.path.join(_REPO, "tools", "tpu_probe_log.json")
+WOKE = os.path.join(_REPO, "tools", "TPU_WOKE")
+
+
+def _load_log() -> dict:
+    if os.path.exists(LOG):
+        try:
+            with open(LOG) as f:
+                return json.load(f)
+        except Exception:
+            pass
+    return {"probes": [], "runs": []}
+
+
+def _save_log(log: dict) -> None:
+    tmp = LOG + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(log, f, indent=1)
+    os.replace(tmp, LOG)
+
+
+def _probe(timeout_s: int = 90) -> tuple[bool, float]:
+    from __graft_entry__ import tiny_op_probe
+    t0 = time.monotonic()
+    ok = tiny_op_probe(timeout_s=timeout_s)
+    return ok, round(time.monotonic() - t0, 1)
+
+
+def _run(cmd: list[str], env_extra: dict, timeout_s: float, out_path: str,
+         log: dict, label: str) -> bool:
+    """Run one on-chip command; capture its last JSON line to out_path."""
+    env = dict(os.environ)
+    env.update(env_extra)
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, env=env, cwd=_REPO, capture_output=True,
+                              text=True, timeout=timeout_s)
+        note, rc = "done", proc.returncode
+        stdout = proc.stdout
+    except subprocess.TimeoutExpired as e:
+        note, rc = f"timeout after {timeout_s:.0f}s", -1
+        stdout = (e.stdout.decode(errors="replace")
+                  if isinstance(e.stdout, bytes) else (e.stdout or ""))
+    payload = None
+    for line in reversed((stdout or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                payload = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    def _is_tpu(p) -> bool:
+        plat = (p or {}).get("platform") or ""
+        return "tpu" in plat or plat == "axon"
+
+    wrote = False
+    if payload is not None:
+        # write-once-if-better: never clobber a previously captured
+        # on-chip artifact with a CPU-fallback/skipped payload from a
+        # later, degraded window
+        existing = None
+        if os.path.exists(out_path):
+            try:
+                with open(out_path) as f:
+                    existing = json.load(f)
+            except Exception:
+                existing = None
+        if _is_tpu(payload) or not _is_tpu(existing):
+            with open(out_path, "w") as f:
+                json.dump(payload, f, indent=1)
+            wrote = True
+    log["runs"].append({
+        "label": label, "ts": time.time(),
+        "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "cmd": " ".join(cmd), "rc": rc, "note": note,
+        "seconds": round(time.time() - t0, 1),
+        "artifact": out_path if wrote else None,
+        "platform": (payload or {}).get("platform"),
+        "value": (payload or {}).get("value"),
+    })
+    _save_log(log)
+    # success for our purposes = a JSON artifact whose platform is the TPU
+    return _is_tpu(payload)
+
+
+def _on_chip_suite(log: dict) -> None:
+    t = os.path.join(_REPO, "tools")
+    py = sys.executable
+    _run([py, "bench.py"], {"BENCH_TIMEOUT_S": "1500",
+                            "BENCH_NO_FALLBACK": "1"},
+         1520, os.path.join(t, "tpu_bench_live.json"), log, "bench-tpu")
+    _run([py, "bench.py"], {"BENCH_PALLAS": "1", "BENCH_TIMEOUT_S": "1200",
+                            "BENCH_NO_FALLBACK": "1"},
+         1220, os.path.join(t, "tpu_bench_pallas.json"), log, "bench-pallas")
+    _run([py, os.path.join(t, "bench_blocksparse.py")], {},
+         1200, os.path.join(t, "tpu_blocksparse.json"), log, "blocksparse")
+    _run([py, os.path.join(t, "bench_suite.py"), "--configs", "1,2"], {},
+         2400, os.path.join(t, "tpu_bench_suite.json"), log, "suite-onchip")
+    with open(WOKE, "w") as f:
+        f.write(time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=600.0,
+                    help="seconds between probes")
+    ap.add_argument("--max-hours", type=float, default=11.0)
+    ap.add_argument("--once", action="store_true",
+                    help="single probe, no loop")
+    args = ap.parse_args()
+
+    log = _load_log()
+    t_end = time.monotonic() + args.max_hours * 3600
+    while True:
+        ok, latency = _probe()
+        log["probes"].append({
+            "ts": time.time(),
+            "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "ok": ok, "latency_s": latency,
+        })
+        _save_log(log)
+        print(f"probe ok={ok} latency={latency}s "
+              f"({len(log['probes'])} total)", flush=True)
+        if ok:
+            _on_chip_suite(log)
+            # keep probing afterwards (cheaper cadence) in case a later,
+            # longer window allows a re-run of anything that timed out
+            args.interval = max(args.interval, 900.0)
+        if args.once or time.monotonic() > t_end:
+            break
+        time.sleep(args.interval)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
