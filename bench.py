@@ -35,8 +35,13 @@ Fallback numbers are labeled with their true config in `metric` plus
 `platform`/`config_scaled`/`matmul` fields; `vs_baseline` still lands when
 tools/reference_baseline.json has a matched-config torch measurement.
 
-Each child also reports achieved TFLOP/s (XLA cost_analysis flops /
-step-time) and, on TPU, MFU vs the chip's bf16 peak (SURVEY.md §6).
+Each child also reports achieved TFLOP/s and, on TPU, MFU vs the chip's
+bf16 peak (SURVEY.md §6). FLOPs are ANALYTIC (3x the forward contraction
+count from alphafold2_tpu/utils/flops.py, custom kernels disabled during
+the counting trace) — NOT XLA cost_analysis, which cannot see through
+AMX FFI / pallas_call custom calls and so under-reports exactly when the
+fast path is engaged; cost_analysis is still emitted as a diagnostic
+field (`xla_cost_analysis_tflops`).
 """
 
 from __future__ import annotations
@@ -122,8 +127,12 @@ def _lookup_baseline(cfg: dict):
 # child: one measurement on the ambient platform
 # --------------------------------------------------------------------------
 
-def _flops_of(compiled) -> float | None:
-    """Total FLOPs of the compiled step from XLA's cost analysis."""
+def _xla_flops_of(compiled) -> float | None:
+    """XLA cost_analysis flops — DIAGNOSTIC ONLY. It cannot see through
+    custom calls (AMX FFI, pallas_call), so it under-reports exactly when
+    the fast path is engaged (observed r03->r04: reported tflops fell 10x
+    while the step got 2x faster). The number of record is the analytic
+    count from alphafold2_tpu.utils.flops (round-4 VERDICT #2)."""
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
@@ -185,7 +194,12 @@ def _child_main() -> int:
                               tx=adam(3e-4), rng=jax.random.PRNGKey(2))
     step = jax.jit(make_train_step(model), donate_argnums=(0,))
     compiled = step.lower(state, batch).compile()
-    flops = _flops_of(compiled)
+    # analytic model FLOPs (3x forward contraction count, custom kernels
+    # disabled for the counting trace): identical across AMX/Pallas/XLA
+    # runs of one config by construction — the MFU numerator
+    from alphafold2_tpu.utils.flops import train_step_flops
+    flops = train_step_flops(model, params, batch)
+    xla_flops = _xla_flops_of(compiled)
 
     for _ in range(cfg["warmup"]):
         state, metrics = step(state, batch)
@@ -200,7 +214,8 @@ def _child_main() -> int:
     platform = jax.default_backend()
     ref_s = _lookup_baseline(cfg)
     tflops = round(flops / (ms / 1e3) / 1e12, 3) if flops else None
-    is_tpu = platform == "axon" or "tpu" in platform
+    from __graft_entry__ import is_tpu_platform
+    is_tpu = is_tpu_platform(platform)
     mfu = (round(flops / (ms / 1e3) / _TPU_PEAK_FLOPS, 4)
            if (flops and is_tpu) else None)
 
@@ -224,6 +239,9 @@ def _child_main() -> int:
         "warmup": cfg["warmup"],
         "iters": cfg["iters"],
         "tflops": tflops,
+        "flops_model": "analytic-3x-forward (utils/flops.py)",
+        "xla_cost_analysis_tflops": (
+            round(xla_flops / (ms / 1e3) / 1e12, 3) if xla_flops else None),
         "mfu": mfu,
         "config_scaled": (cfg["dim"], cfg["depth"], cfg["seq_len"]) !=
                          (_FULL["dim"], _FULL["depth"], _FULL["seq_len"]),

@@ -46,11 +46,11 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from __graft_entry__ import _enable_compile_cache
+    from __graft_entry__ import _enable_compile_cache, is_tpu_platform
     _enable_compile_cache()
 
     platform = jax.default_backend()
-    on_tpu = platform == "axon" or "tpu" in platform
+    on_tpu = is_tpu_platform(platform)
     if not on_tpu:
         print(json.dumps({
             "skipped": True, "platform": platform,

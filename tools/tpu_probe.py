@@ -62,7 +62,9 @@ def _probe(timeout_s: int = 90) -> tuple[bool, float]:
 
 def _run(cmd: list[str], env_extra: dict, timeout_s: float, out_path: str,
          log: dict, label: str) -> bool:
-    """Run one on-chip command; capture its last JSON line to out_path."""
+    """Run one on-chip command; persist ALL its JSON output lines (a
+    multi-config suite prints one per config) plus a raw-stdout sidecar,
+    so nothing from a rare live-tunnel window is lost."""
     env = dict(os.environ)
     env.update(env_extra)
     t0 = time.time()
@@ -75,21 +77,28 @@ def _run(cmd: list[str], env_extra: dict, timeout_s: float, out_path: str,
         note, rc = f"timeout after {timeout_s:.0f}s", -1
         stdout = (e.stdout.decode(errors="replace")
                   if isinstance(e.stdout, bytes) else (e.stdout or ""))
-    payload = None
-    for line in reversed((stdout or "").splitlines()):
+    payloads = []
+    for line in (stdout or "").splitlines():
         line = line.strip()
         if line.startswith("{"):
             try:
-                payload = json.loads(line)
-                break
+                payloads.append(json.loads(line))
             except json.JSONDecodeError:
                 continue
+    payload = payloads[-1] if payloads else None
+
+    from __graft_entry__ import is_tpu_platform
+
     def _is_tpu(p) -> bool:
-        plat = (p or {}).get("platform") or ""
-        return "tpu" in plat or plat == "axon"
+        return is_tpu_platform((p or {}).get("platform"))
 
     wrote = False
-    if payload is not None:
+    if payloads:
+        # raw stdout sidecar: the artifact can never silently drop
+        # evidence the subprocess printed (a multi-config suite emits
+        # one JSON line PER config)
+        with open(out_path + ".stdout.txt", "w") as f:
+            f.write(stdout or "")
         # write-once-if-better: never clobber a previously captured
         # on-chip artifact with a CPU-fallback/skipped payload from a
         # later, degraded window
@@ -100,9 +109,13 @@ def _run(cmd: list[str], env_extra: dict, timeout_s: float, out_path: str,
                     existing = json.load(f)
             except Exception:
                 existing = None
-        if _is_tpu(payload) or not _is_tpu(existing):
+        exist_list = existing if isinstance(existing, list) else \
+            [existing] if existing else []
+        if any(map(_is_tpu, payloads)) or \
+                not any(map(_is_tpu, exist_list)):
             with open(out_path, "w") as f:
-                json.dump(payload, f, indent=1)
+                json.dump(payloads if len(payloads) > 1 else payload,
+                          f, indent=1)
             wrote = True
     log["runs"].append({
         "label": label, "ts": time.time(),
@@ -114,23 +127,42 @@ def _run(cmd: list[str], env_extra: dict, timeout_s: float, out_path: str,
         "value": (payload or {}).get("value"),
     })
     _save_log(log)
-    # success for our purposes = a JSON artifact whose platform is the TPU
-    return _is_tpu(payload)
+    # success for our purposes = any JSON payload whose platform is the TPU
+    return any(map(_is_tpu, payloads))
 
 
-def _on_chip_suite(log: dict) -> None:
+def _on_chip_suite(log: dict, budget_s: float) -> None:
+    """Run the prepared on-chip commands in priority order, skipping any
+    whose timeout no longer fits the remaining --max-hours budget (so the
+    daemon cannot overrun the round boundary by a suite length)."""
     t = os.path.join(_REPO, "tools")
     py = sys.executable
-    _run([py, "bench.py"], {"BENCH_TIMEOUT_S": "1500",
+    t_stop = time.monotonic() + budget_s
+    plan = [
+        ([py, "bench.py"], {"BENCH_TIMEOUT_S": "1500",
                             "BENCH_NO_FALLBACK": "1"},
-         1520, os.path.join(t, "tpu_bench_live.json"), log, "bench-tpu")
-    _run([py, "bench.py"], {"BENCH_PALLAS": "1", "BENCH_TIMEOUT_S": "1200",
+         1520, os.path.join(t, "tpu_bench_live.json"), "bench-tpu"),
+        ([py, "bench.py"], {"BENCH_PALLAS": "1", "BENCH_TIMEOUT_S": "1200",
                             "BENCH_NO_FALLBACK": "1"},
-         1220, os.path.join(t, "tpu_bench_pallas.json"), log, "bench-pallas")
-    _run([py, os.path.join(t, "bench_blocksparse.py")], {},
-         1200, os.path.join(t, "tpu_blocksparse.json"), log, "blocksparse")
-    _run([py, os.path.join(t, "bench_suite.py"), "--configs", "1,2"], {},
-         2400, os.path.join(t, "tpu_bench_suite.json"), log, "suite-onchip")
+         1220, os.path.join(t, "tpu_bench_pallas.json"), "bench-pallas"),
+        ([py, os.path.join(t, "bench_blocksparse.py")], {},
+         1200, os.path.join(t, "tpu_blocksparse.json"), "blocksparse"),
+        ([py, os.path.join(t, "bench_suite.py"), "--configs", "1,2"], {},
+         2400, os.path.join(t, "tpu_bench_suite.json"), "suite-onchip"),
+    ]
+    for cmd, env_extra, timeout_s, out_path, label in plan:
+        remaining = t_stop - time.monotonic()
+        if remaining < min(timeout_s, 300):
+            log["runs"].append({
+                "label": label, "ts": time.time(),
+                "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                "note": f"skipped: {remaining:.0f}s budget left",
+                "rc": None, "seconds": 0, "artifact": None,
+                "platform": None, "value": None, "cmd": " ".join(cmd)})
+            _save_log(log)
+            continue
+        _run(cmd, env_extra, min(timeout_s, remaining), out_path, log,
+             label)
     with open(WOKE, "w") as f:
         f.write(time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
 
@@ -157,11 +189,11 @@ def main() -> int:
         print(f"probe ok={ok} latency={latency}s "
               f"({len(log['probes'])} total)", flush=True)
         if ok:
-            _on_chip_suite(log)
+            _on_chip_suite(log, budget_s=t_end - time.monotonic())
             # keep probing afterwards (cheaper cadence) in case a later,
             # longer window allows a re-run of anything that timed out
             args.interval = max(args.interval, 900.0)
-        if args.once or time.monotonic() > t_end:
+        if args.once or time.monotonic() + args.interval > t_end:
             break
         time.sleep(args.interval)
     return 0
