@@ -222,8 +222,8 @@ class Alphafold2(nn.Module):
             e = e.astype(self.dtype)
             if e.shape[-1] != self.dim:
                 e = Dense(self.dim, param_dtype=jnp.float32,
-                             dtype=self.dtype,
-                             name=f"{prefix}_{e.shape[-1]}")(e)
+                          dtype=self.dtype,
+                          name=f"{prefix}_{e.shape[-1]}")(e)
             return e
 
         x_single = embed_tokens(seq)
@@ -262,7 +262,7 @@ class Alphafold2(nn.Module):
                 msa_mask = jnp.ones_like(msa, dtype=bool)
         elif embedds is not None:
             m = Dense(self.dim, param_dtype=jnp.float32, dtype=self.dtype,
-                         name="embedd_project")(embedds.astype(self.dtype))
+                      name="embedd_project")(embedds.astype(self.dtype))
             if msa_mask is None:
                 msa_mask = jnp.ones(embedds.shape[:-1], dtype=bool)
         else:
@@ -271,7 +271,7 @@ class Alphafold2(nn.Module):
 
         # pairwise representation by outer sum (reference alphafold2.py:715-717)
         x_pair_proj = Dense(self.dim * 2, param_dtype=jnp.float32,
-                               dtype=self.dtype, name="to_pairwise_repr")(
+                            dtype=self.dtype, name="to_pairwise_repr")(
                                    x_single)
         x_left, x_right = jnp.split(x_pair_proj, 2, axis=-1)
         x = x_left[:, :, None, :] + x_right[:, None, :, :]  # (b, i, j, d)
@@ -314,7 +314,7 @@ class Alphafold2(nn.Module):
         if templates_feats is not None:
             num_templates = templates_feats.shape[1]
             t = Dense(self.dim, param_dtype=jnp.float32, dtype=self.dtype,
-                         name="to_template_embed")(
+                      name="to_template_embed")(
                              templates_feats.astype(self.dtype))
             t_mask_crossed = templates_mask[:, :, :, None] & \
                 templates_mask[:, :, None, :]
@@ -423,7 +423,7 @@ class Alphafold2(nn.Module):
                 # embedd_project ran only on the (msa-absent, embedds-given)
                 # path; create it otherwise
                 Dense(self.dim, param_dtype=jnp.float32, dtype=self.dtype,
-                         name="embedd_project")(zf(1, 1, 1, self.num_embedds))
+                      name="embedd_project")(zf(1, 1, 1, self.num_embedds))
             # projector coverage for every known pretrained-LM width plus
             # the configured num_embedds (skip widths this trace created)
             widths = {constants.MSA_EMBED_DIM, constants.PROTTRAN_EMBED_DIM,
@@ -433,12 +433,12 @@ class Alphafold2(nn.Module):
             for w in sorted(widths):
                 if w != seq_w:
                     Dense(self.dim, param_dtype=jnp.float32,
-                             dtype=self.dtype,
-                             name=f"seq_embed_project_{w}")(zf(1, 1, w))
+                          dtype=self.dtype,
+                          name=f"seq_embed_project_{w}")(zf(1, 1, w))
                 if w != msa_w:
                     Dense(self.dim, param_dtype=jnp.float32,
-                             dtype=self.dtype,
-                             name=f"msa_embed_project_{w}")(zf(1, 1, 1, w))
+                          dtype=self.dtype,
+                          name=f"msa_embed_project_{w}")(zf(1, 1, 1, w))
             if not (train and original_msa is not None):
                 mlm(zf(1, 1, 1, self.dim), jnp.zeros((1, 1, 1), jnp.int32),
                     jnp.ones((1, 1, 1), bool))
@@ -453,7 +453,7 @@ class Alphafold2(nn.Module):
                              jnp.zeros((1, 1, 1), jnp.int32))
             if templates_feats is None:
                 t_d = Dense(self.dim, param_dtype=jnp.float32,
-                               dtype=self.dtype, name="to_template_embed")(
+                            dtype=self.dtype, name="to_template_embed")(
                                    zf(1, 1, 1, self.templates_dim))
                 t_d = PairwiseAttentionBlock(
                     dim=self.dim, heads=self.heads, dim_head=self.dim_head,
@@ -464,10 +464,10 @@ class Alphafold2(nn.Module):
                               zf(1, 1, self.dim), context=zf(1, 1, self.dim))
             if templates_angles is None:
                 a = Dense(self.dim, param_dtype=jnp.float32,
-                             dtype=self.dtype, name="template_angle_mlp_in")(
+                          dtype=self.dtype, name="template_angle_mlp_in")(
                                  zf(1, 1, 1, self.templates_angles_feats_dim))
                 Dense(self.dim, param_dtype=jnp.float32, dtype=self.dtype,
-                         name="template_angle_mlp_out")(jax.nn.gelu(a))
+                      name="template_angle_mlp_out")(jax.nn.gelu(a))
             if extra_msa is None:
                 Evoformer(dim=self.dim, depth=self.extra_msa_evoformer_layers,
                           heads=self.heads, dim_head=self.dim_head,
@@ -521,10 +521,10 @@ class Alphafold2(nn.Module):
         # (reference alphafold2.py:843-851); fp32 island from here on
         single_msa_repr_row = m[:, 0]
         single_repr = Dense(self.dim, param_dtype=jnp.float32,
-                               name="msa_to_single_repr_dim")(
+                            name="msa_to_single_repr_dim")(
                                    single_msa_repr_row.astype(jnp.float32))
         pairwise_repr = Dense(self.dim, param_dtype=jnp.float32,
-                                 name="trunk_to_pairwise_repr_dim")(
+                              name="trunk_to_pairwise_repr_dim")(
                                      x.astype(jnp.float32))
 
         if self.structure_module_type == "ipa":
@@ -557,7 +557,7 @@ class Alphafold2(nn.Module):
         # confidence head always built (cheap Dense(1)) so one params tree
         # serves every return configuration
         confidence = Dense(1, param_dtype=jnp.float32,
-                              name="lddt_linear")(single_out)
+                           name="lddt_linear")(single_out)
         ret_kwargs["confidence"] = confidence
 
         if return_recyclables:

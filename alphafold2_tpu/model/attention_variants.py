@@ -350,8 +350,8 @@ class MultiKernelConvBlock(nn.Module):
                     name=f"conv_{kh}x{kw}_d{d}")(h))
         h = jnn.gelu(sum(branches) / len(branches))
         out = Dense(self.dim, kernel_init=zeros_init(),
-                       bias_init=zeros_init(), dtype=self.dtype,
-                       param_dtype=jnp.float32, name="proj_out")(h)
+                    bias_init=zeros_init(), dtype=self.dtype,
+                    param_dtype=jnp.float32, name="proj_out")(h)
         if mask is not None:
             out = out * mask[..., None].astype(out.dtype)
         return out

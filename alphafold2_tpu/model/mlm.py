@@ -88,7 +88,7 @@ class MLM(nn.Module):
         """CE loss over replaced positions (reference mlm.py:86-92).
         seq_embed: (b, m, n, d); original_seq/replaced_mask: (b, m, n)."""
         logits = Dense(self.num_tokens, param_dtype=jnp.float32,
-                          name="to_logits")(seq_embed.astype(jnp.float32))
+                       name="to_logits")(seq_embed.astype(jnp.float32))
         logp = jax.nn.log_softmax(logits, axis=-1)
         labels = jax.nn.one_hot(original_seq, self.num_tokens,
                                 dtype=logp.dtype)

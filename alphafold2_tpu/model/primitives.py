@@ -93,13 +93,13 @@ class FeedForward(nn.Module):
     def __call__(self, x, deterministic: bool = True):
         x = LayerNorm(dtype=self.dtype)(x)
         x = Dense(self.dim * self.mult * 2, dtype=self.dtype,
-                     param_dtype=jnp.float32)(x)
+                  param_dtype=jnp.float32)(x)
         x = GEGLU()(x)
         x = nn.Dropout(self.dropout, deterministic=deterministic)(x)
         # zero-initialized output projection: the block starts as identity
         # w.r.t. the residual stream (reference init_zero_, alphafold2.py:90)
         x = Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
-                     kernel_init=zeros_init(), bias_init=zeros_init())(x)
+                  kernel_init=zeros_init(), bias_init=zeros_init())(x)
         return x
 
 
@@ -390,8 +390,8 @@ class AxialAttention(nn.Module):
         bias = None
         if self.accept_edges and edges is not None:
             bias = Dense(self.heads, use_bias=False, dtype=self.dtype,
-                            param_dtype=jnp.float32,
-                            name="edges_to_attn_bias")(edges)
+                         param_dtype=jnp.float32,
+                         name="edges_to_attn_bias")(edges)
             bias = bias.transpose(0, 3, 1, 2)  # (b, heads, i, j)
 
         drop = dict(dropout_rate=self.dropout if dropout_key is not None
@@ -445,8 +445,8 @@ class AxialAttention(nn.Module):
             # (b, i, j, d) -> per-head bias (b, heads, i, j), tiled over the
             # folded axis (reference alphafold2.py:214-217, :246-248)
             bias = Dense(self.heads, use_bias=False, dtype=self.dtype,
-                            param_dtype=jnp.float32,
-                            name="edges_to_attn_bias")(edges)
+                         param_dtype=jnp.float32,
+                         name="edges_to_attn_bias")(edges)
             attn_bias = bias.transpose(0, 3, 1, 2)  # (b, heads, i, j)
 
         tie_dim = axial_dim if self.global_query_attn else None
@@ -543,9 +543,9 @@ class OuterMean(nn.Module):
         hidden = self.hidden_dim or self.dim
         x = LayerNorm(dtype=self.dtype)(x)
         left = Dense(hidden, dtype=self.dtype, param_dtype=jnp.float32,
-                        name="left_proj")(x)
+                     name="left_proj")(x)
         right = Dense(hidden, dtype=self.dtype, param_dtype=jnp.float32,
-                         name="right_proj")(x)
+                      name="right_proj")(x)
 
         if mask is not None:
             m = mask.astype(x.dtype)  # (b, m, n)
@@ -564,4 +564,4 @@ class OuterMean(nn.Module):
             outer = outer / x.shape[1]
 
         return Dense(self.dim, dtype=self.dtype, param_dtype=jnp.float32,
-                        name="proj_out")(outer)
+                     name="proj_out")(outer)

@@ -77,7 +77,7 @@ class EGNNLayer(nn.Module):
         # equivariant coordinate update, zero-init scale so the layer starts
         # as identity on coordinates
         coor_w = Dense(1, param_dtype=jnp.float32, use_bias=False,
-                          kernel_init=zeros_init(), name="coor_mlp")(msg)
+                       kernel_init=zeros_init(), name="coor_mlp")(msg)
         coor_w = jnp.tanh(coor_w) * self.coor_clamp
         denom = jnp.maximum(
             (mask.astype(x.dtype).sum(-1) - 1.0)[:, None, None]
@@ -115,11 +115,11 @@ class EnAttentionLayer(nn.Module):
 
         hn = LayerNorm(name="norm")(h)
         q = Dense(inner, use_bias=False, param_dtype=jnp.float32,
-                     name="to_q")(hn).reshape(b, n, nh, hd)
+                  name="to_q")(hn).reshape(b, n, nh, hd)
         k = Dense(inner, use_bias=False, param_dtype=jnp.float32,
-                     name="to_k")(hn).reshape(b, n, nh, hd)
+                  name="to_k")(hn).reshape(b, n, nh, hd)
         v = Dense(inner, use_bias=False, param_dtype=jnp.float32,
-                     name="to_v")(hn).reshape(b, n, nh, hd)
+                  name="to_v")(hn).reshape(b, n, nh, hd)
 
         rel = x[:, :, None, :] - x[:, None, :, :]
         dist2 = _safe_norm2(rel)
@@ -127,7 +127,7 @@ class EnAttentionLayer(nn.Module):
         logits = jnp.einsum("bihd,bjhd->bhij", q, k) * (hd ** -0.5)
         # distance-aware bias (+ optional pair-rep edge bias)
         dist_bias = Dense(nh, param_dtype=jnp.float32,
-                             name="dist_to_bias")(jnp.log(dist2))
+                          name="dist_to_bias")(jnp.log(dist2))
         logits = logits + dist_bias.transpose(0, 3, 1, 2)
         if edges is not None:
             logits = logits + Dense(
@@ -142,12 +142,12 @@ class EnAttentionLayer(nn.Module):
 
         out = jnp.einsum("bhij,bjhd->bihd", attn, v).reshape(b, n, inner)
         h = h + Dense(self.dim, param_dtype=jnp.float32,
-                         kernel_init=zeros_init(), bias_init=zeros_init(),
-                         name="to_out")(out)
+                      kernel_init=zeros_init(), bias_init=zeros_init(),
+                      name="to_out")(out)
 
         # equivariant coordinate update weighted by mean attention
         coor_w = Dense(1, use_bias=False, param_dtype=jnp.float32,
-                          kernel_init=zeros_init(), name="coor_mlp")(
+                       kernel_init=zeros_init(), name="coor_mlp")(
                               attn.mean(1)[..., None])
         coor_w = jnp.tanh(coor_w) * self.coor_clamp
         x = x + (rel / jnp.sqrt(dist2) * coor_w).sum(axis=2) / max(n - 1, 1)

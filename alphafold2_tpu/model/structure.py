@@ -101,7 +101,7 @@ class InvariantPointAttention(nn.Module):
         logits = scalar_logits + point_logits
         if pairwise_repr is not None:
             pair_bias = Dense(h, use_bias=False, param_dtype=jnp.float32,
-                                 name="pairwise_to_bias")(pairwise_repr)
+                              name="pairwise_to_bias")(pairwise_repr)
             logits = logits + pair_bias.transpose(0, 3, 1, 2)
         logits = logits * w_l
 
@@ -132,8 +132,8 @@ class InvariantPointAttention(nn.Module):
         # zero-init final projection (reference zero-inits ipa attn to_out,
         # alphafold2.py:615)
         return Dense(self.dim, param_dtype=jnp.float32,
-                        kernel_init=zeros_init(), bias_init=zeros_init(),
-                        name="to_out")(out)
+                     kernel_init=zeros_init(), bias_init=zeros_init(),
+                     name="to_out")(out)
 
 
 class IPABlock(nn.Module):
@@ -159,10 +159,10 @@ class IPABlock(nn.Module):
         ff = x
         for i in range(self.ff_num_layers - 1):
             ff = Dense(hidden, param_dtype=jnp.float32,
-                          name=f"ff_{i}")(ff)
+                       name=f"ff_{i}")(ff)
             ff = jax.nn.relu(ff)
         ff = Dense(self.dim, param_dtype=jnp.float32,
-                      name=f"ff_{self.ff_num_layers - 1}")(ff)
+                   name=f"ff_{self.ff_num_layers - 1}")(ff)
         x = x + ff
         return LayerNorm(name="ff_norm")(x)
 
@@ -188,7 +188,7 @@ class StructureModule(nn.Module):
 
         block = IPABlock(dim=self.dim, heads=self.heads, name="ipa_block")
         to_update = Dense(6, param_dtype=jnp.float32,
-                             name="to_quaternion_update")
+                          name="to_quaternion_update")
         init = Rigid.identity((b, n), dtype=jnp.float32)
         quaternions, translations = init.quaternions, init.translations
 
@@ -219,7 +219,7 @@ class StructureModule(nn.Module):
                 "...c,...cd->...d", dt, frames.rotations)
 
         points_local = Dense(3, param_dtype=jnp.float32,
-                                name="to_points")(x)
+                             name="to_points")(x)
         frames = Rigid(quaternions, translations)
         coords = frames.apply_single(points_local)
 
