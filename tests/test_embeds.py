@@ -15,7 +15,8 @@ import pytest
 import torch
 
 from alphafold2_tpu import Alphafold2, constants
-from alphafold2_tpu.embeds import (ProtT5EmbedWrapper, ProtTranEmbedWrapper)
+from alphafold2_tpu.embeds import (ESMEmbedWrapper, MSAEmbedWrapper,
+                                   ProtT5EmbedWrapper, ProtTranEmbedWrapper)
 
 
 class _FakeT5Tokenizer:
@@ -135,3 +136,223 @@ class TestProtTranWrapper:
         assert emb.shape == (1, 5, 4)
         # CLS (position 0) dropped: first kept position is 1
         np.testing.assert_allclose(emb[0, :, 0], np.arange(1.0, 6.0))
+
+
+# ---------------------------------------------------------------------------
+# Recorded-convention goldens (VERDICT r4 #9)
+# ---------------------------------------------------------------------------
+#
+# The classes above verify slicing against *hand-rolled* fakes; these pin
+# it against *recorded* conventions: tests/goldens/embed_tokenizers.json
+# transcribes the published vocabularies and special-token layouts of
+# ESM-1b, the MSA Transformer, ProtBert and ProtT5 (BOS/EOS placement is
+# exactly where the reference wrappers had subtle bugs). Each replay
+# tokenizer below consults ONLY the golden data, asserts its encoding of
+# the golden sequence reproduces the golden token ids verbatim, and the
+# test then checks the wrapper keeps exactly `residue_positions`.
+
+import json
+import os
+
+from alphafold2_tpu.data.featurize import tokenize
+from alphafold2_tpu.embeds import ESMEmbedWrapper, MSAEmbedWrapper
+
+_GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "goldens",
+                            "embed_tokenizers.json")
+with open(_GOLDEN_PATH) as f:
+    GOLD = json.load(f)
+
+
+def _esm_tokenize_one(text: str, vocab: dict, prepend_bos: bool,
+                      append_eos: bool) -> list:
+    """Replay of ESM Alphabet.tokenize: greedy match of <...> specials,
+    otherwise per-character lookup."""
+    ids = []
+    i = 0
+    while i < len(text):
+        if text[i] == "<":
+            j = text.index(">", i) + 1
+            ids.append(vocab[text[i:j]])
+            i = j
+        else:
+            ids.append(vocab[text[i]])
+            i += 1
+    if prepend_bos:
+        ids = [vocab["<cls>"]] + ids
+    if append_eos:
+        ids = ids + [vocab["<eos>"]]
+    return ids
+
+
+def _position_token_reps(toks: "torch.Tensor", dim: int = 2):
+    """Hidden state encoding (position, token id) so tests can see which
+    encoder positions a wrapper keeps."""
+    b, n = toks.shape[0], toks.shape[-1]
+    flat = toks.reshape(-1, n)
+    reps = torch.zeros((flat.shape[0], n, dim), dtype=torch.float32)
+    reps[:, :, 0] = torch.arange(n, dtype=torch.float32)[None, :]
+    reps[:, :, 1] = flat.float()
+    return reps.reshape(*toks.shape, dim)
+
+
+class TestTokenizerGoldens:
+    SEQ = GOLD["sequence"]
+
+    def test_internal_tokenize_roundtrip(self):
+        """Wrapper text prep starts from detokenize(tokenize(seq))."""
+        from alphafold2_tpu.data.featurize import detokenize
+        assert detokenize(tokenize(self.SEQ)) == self.SEQ
+
+    def _esm_backend(self, g, vocab, repr_layer):
+        class _Converter:
+            def __call__(self, data):
+                rows = [_esm_tokenize_one(s, vocab, g["prepend_bos"],
+                                          g["append_eos"]) for _, s in data]
+                return None, None, torch.tensor(rows, dtype=torch.long)
+
+        class _Model:
+            def eval(self):
+                return self
+
+            def __call__(self, toks, repr_layers=None, return_contacts=False):
+                return {"representations":
+                        {repr_layer: _position_token_reps(toks)}}
+
+        return _Model(), _Converter()
+
+    def test_esm1b_keeps_residues_drops_bos_and_eos(self):
+        g = GOLD["esm1b"]
+        vocab = g["vocab"]
+        # the replay reproduces the recorded encoding exactly
+        got = _esm_tokenize_one(self.SEQ, vocab, g["prepend_bos"],
+                                g["append_eos"])
+        assert got == g["token_ids"]
+
+        w = ESMEmbedWrapper(alphafold2=None)
+        w._backend = self._esm_backend(g, vocab, ESMEmbedWrapper.REPR_LAYER)
+        emb, _ = w.embed_batch(tokenize(self.SEQ)[None])
+        np.testing.assert_allclose(
+            emb[0, :, 0], np.asarray(g["residue_positions"], np.float32))
+        # kept positions carry residue token ids only — BOS (<cls>) and
+        # the trailing <eos> ESM-1b appends are both outside the slice
+        np.testing.assert_allclose(
+            emb[0, :, 1], np.asarray([vocab[c] for c in self.SEQ],
+                                     np.float32))
+
+    def test_esm_pad_token_survives_text_prep(self):
+        """'_' padding must reach ESM as the '<pad>' special (id 1), not
+        as an unknown character."""
+        g = GOLD["esm1b"]
+        text = self.SEQ + "<pad>"
+        ids = _esm_tokenize_one(text, g["vocab"], g["prepend_bos"],
+                                g["append_eos"])
+        assert ids[len(self.SEQ) + 1] == g["vocab"]["<pad>"]
+
+        w = ESMEmbedWrapper(alphafold2=None)
+        w._backend = self._esm_backend(g, g["vocab"],
+                                       ESMEmbedWrapper.REPR_LAYER)
+        toks = tokenize(self.SEQ + "_")[None]
+        emb, _ = w.embed_batch(toks)
+        # padded slot still occupies one encoder position (id 1 = <pad>)
+        assert emb.shape[1] == toks.shape[-1]
+        assert emb[0, -1, 1] == g["vocab"]["<pad>"]
+
+    def test_msa_transformer_no_eos_row_layout(self):
+        g = GOLD["msa_transformer"]
+        vocab = GOLD["esm1b"]["vocab"]
+        got = _esm_tokenize_one(self.SEQ, vocab, g["prepend_bos"],
+                                g["append_eos"])
+        assert got == g["token_ids"]
+
+        class _MsaConverter:
+            def __call__(self, data):
+                rows = [_esm_tokenize_one(s, vocab, g["prepend_bos"],
+                                          g["append_eos"]) for _, s in data]
+                # MSABatchConverter returns (1, R, L+1)
+                return None, None, torch.tensor([rows], dtype=torch.long)
+
+        class _MsaModel:
+            def eval(self):
+                return self
+
+            def __call__(self, toks, repr_layers=None):
+                return {"representations":
+                        {MSAEmbedWrapper.REPR_LAYER:
+                         _position_token_reps(toks)}}
+
+        w = MSAEmbedWrapper(alphafold2=None)
+        w._backend = (_MsaModel(), _MsaConverter())
+        msa = np.stack([tokenize(self.SEQ), tokenize(self.SEQ)])[None]
+        seq_emb, msa_emb = w.embed_batch(None, msa)
+        assert msa_emb.shape[:3] == (1, 2, len(self.SEQ))
+        for r in range(2):
+            np.testing.assert_allclose(
+                msa_emb[0, r, :, 0],
+                np.asarray(g["residue_positions"], np.float32))
+        # seq embedding is the query row (reference embeds.py:70-73)
+        np.testing.assert_allclose(seq_emb[0], msa_emb[0, 0])
+
+    def test_prot_bert_cls_sep_framing(self):
+        g = GOLD["prot_bert"]
+        vocab = g["vocab"]
+
+        def encode(text):
+            ids = [vocab["[CLS]"]] + [vocab[c] for c in text.split()] \
+                + [vocab["[SEP]"]]
+            return ids
+
+        assert encode(" ".join(self.SEQ)) == g["token_ids"]
+
+        class _Tok:
+            def __call__(self, texts, return_tensors="pt", padding=True):
+                return {"input_ids": torch.tensor(
+                    [encode(t) for t in texts], dtype=torch.long)}
+
+        class _Bert:
+            def __call__(self, **enc):
+                class R:
+                    last_hidden_state = _position_token_reps(
+                        enc["input_ids"])
+                return R()
+
+        w = ProtTranEmbedWrapper(alphafold2=None)
+        w._backend = (_Bert(), _Tok())
+        emb, _ = w.embed_batch(tokenize(self.SEQ)[None])
+        np.testing.assert_allclose(
+            emb[0, :, 0], np.asarray(g["residue_positions"], np.float32))
+        np.testing.assert_allclose(
+            emb[0, :, 1], np.asarray([vocab[c] for c in self.SEQ],
+                                     np.float32))
+
+    def test_prot_t5_no_bos_trailing_eos(self):
+        g = GOLD["prot_t5"]
+        vocab = g["vocab"]
+
+        def encode(text):
+            return [vocab[c] for c in text.split()] + [vocab["</s>"]]
+
+        assert encode(" ".join(self.SEQ)) == g["token_ids"]
+
+        class _Tok:
+            def batch_encode_plus(self, texts, add_special_tokens=True,
+                                  padding=True, return_tensors="pt"):
+                ids = torch.tensor([encode(t) for t in texts],
+                                   dtype=torch.long)
+                return {"input_ids": ids,
+                        "attention_mask": torch.ones_like(ids)}
+
+        class _T5:
+            def __call__(self, input_ids=None, attention_mask=None):
+                class R:
+                    last_hidden_state = _position_token_reps(input_ids)
+                return R()
+
+        w = ProtT5EmbedWrapper(alphafold2=None)
+        w._backend = (_T5(), _Tok())
+        emb, _ = w.embed_batch(tokenize(self.SEQ)[None])
+        # T5 has no CLS: position 0 is residue 0; only </s> is dropped
+        np.testing.assert_allclose(
+            emb[0, :, 0], np.asarray(g["residue_positions"], np.float32))
+        np.testing.assert_allclose(
+            emb[0, :, 1], np.asarray([vocab[c] for c in self.SEQ],
+                                     np.float32))
