@@ -201,14 +201,20 @@ def _child_main() -> int:
     flops = train_step_flops(model, params, batch)
     xla_flops = _xla_flops_of(compiled)
 
+    # Barrier discipline: under the axon tunnel block_until_ready was
+    # observed returning before device completion (r05: 3.9 ms "steps" =
+    # 736 TFLOP/s on a 197-peak chip). device_get of the loss cannot
+    # complete before the computation that produces it, and the steps are
+    # chained through `state`, so one final fetch serializes the whole
+    # timed window; its single tunnel round-trip amortizes over `iters`.
     for _ in range(cfg["warmup"]):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    float(jax.device_get(metrics["loss"]))
 
     t0 = time.perf_counter()
     for _ in range(cfg["iters"]):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    loss_val = float(jax.device_get(metrics["loss"]))
     ms = (time.perf_counter() - t0) / cfg["iters"] * 1e3
 
     platform = jax.default_backend()
@@ -239,6 +245,7 @@ def _child_main() -> int:
         "warmup": cfg["warmup"],
         "iters": cfg["iters"],
         "tflops": tflops,
+        "loss": round(loss_val, 4),
         "flops_model": "analytic-3x-forward (utils/flops.py)",
         "xla_cost_analysis_tflops": (
             round(xla_flops / (ms / 1e3) / 1e12, 3) if xla_flops else None),

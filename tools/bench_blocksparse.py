@@ -98,12 +98,26 @@ def main():
             block_sparse_attention, pattern=pattern, block=block))
 
         def timeit(fn, *args):
-            out = fn(*args)
-            jax.block_until_ready(out)
+            # Measurement discipline (r05, both lessons tunnel-taught):
+            # (a) block_until_ready can return before device completion
+            #     under axon — close the window with a device_get of a
+            #     scalar reduction instead (a transfer cannot complete
+            #     before the compute it depends on);
+            # (b) per-call dispatch costs ~3.5 ms through the tunnel and
+            #     swamps ms-scale kernels — run the whole window as ONE
+            #     dispatch: a lax.scan of `iters` chained applications
+            #     (output feeds back as q, serializing on-device).
+            @jax.jit
+            def window(q0, rest):
+                def body(q, _):
+                    return fn(q, *rest), None
+                out, _ = jax.lax.scan(body, q0, None, length=iters)
+                return jnp.sum(out.astype(jnp.float32))
+
+            float(jax.device_get(window(args[0], args[1:])))  # warm
             t0 = time.perf_counter()
-            for _ in range(iters):
-                out = fn(*args)
-            jax.block_until_ready(out)
+            s = window(args[0], args[1:])
+            float(jax.device_get(s))
             return (time.perf_counter() - t0) / iters * 1e3
 
         dense_ms = timeit(dense, q, k, v, bias)

@@ -74,13 +74,15 @@ def _train_step_ms(model, batch, iters, warmup=1):
     state = TrainState.create(apply_fn=model.apply, params=params,
                               tx=adam(3e-4), rng=jax.random.PRNGKey(3))
     step = jax.jit(make_train_step(model), donate_argnums=(0,))
+    # device_get, not block_until_ready: under the axon tunnel the
+    # latter was observed returning before device completion (r05)
     for _ in range(warmup):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    float(jax.device_get(metrics["loss"]))
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    float(jax.device_get(metrics["loss"]))
     return (time.perf_counter() - t0) / iters * 1e3
 
 
@@ -150,12 +152,12 @@ def config_fold(tiny, iters):
                                     num_recycles=3))
     res = run(params, batch["seq"], msa=batch["msa"], mask=batch["mask"],
               msa_mask=batch["msa_mask"])
-    jax.block_until_ready(res.coords)
+    jax.device_get(res.coords)
     t0 = time.perf_counter()
     for _ in range(iters):
         res = run(params, batch["seq"], msa=batch["msa"],
                   mask=batch["mask"], msa_mask=batch["msa_mask"])
-    jax.block_until_ready(res.coords)
+    jax.device_get(res.coords)
     sec = (time.perf_counter() - t0) / iters
     return {"config": f"fold_{l}res_3recycles",
             "fold_seconds": round(sec, 4),
@@ -224,11 +226,11 @@ def config_5(tiny, iters):
             st = state
             for _ in range(1):
                 st, metrics = step(st, batch)
-            jax.block_until_ready(metrics["loss"])
+            float(jax.device_get(metrics["loss"]))
             t0 = time.perf_counter()
             for _ in range(iters):
                 st, metrics = step(st, batch)
-            jax.block_until_ready(metrics["loss"])
+            float(jax.device_get(metrics["loss"]))
             entry["train_step_ms"] = round(
                 (time.perf_counter() - t0) / iters * 1e3, 2)
 
@@ -239,14 +241,14 @@ def config_5(tiny, iters):
             fparams = st.params
             res = run(fparams, batch["seq"], msa=batch["msa"],
                       mask=batch["mask"], msa_mask=batch["msa_mask"])
-            jax.block_until_ready(res.coords if hasattr(res, "coords")
-                                  else res.distogram)
+            jax.device_get(res.coords if hasattr(res, "coords")
+                           else res.distogram)
             t0 = time.perf_counter()
             for _ in range(max(1, iters // 2)):
                 res = run(fparams, batch["seq"], msa=batch["msa"],
                           mask=batch["mask"], msa_mask=batch["msa_mask"])
-            jax.block_until_ready(res.coords if hasattr(res, "coords")
-                                  else res.distogram)
+            jax.device_get(res.coords if hasattr(res, "coords")
+                           else res.distogram)
             entry["fold_3recycle_seconds"] = round(
                 (time.perf_counter() - t0) / max(1, iters // 2), 3)
         else:
