@@ -417,11 +417,13 @@ class Evoformer(nn.Module):
         over its (depth/S, ...) param slice with per-block remat, the same
         compute as the nn.scan path. Activations (x, m) plus the masks
         ride the pipeline as one microbatched tree; masks pass through
-        stages unchanged. The in-model GSPMD constraints (shard_pair/
-        shard_msa) are disabled inside the shard_map body — within a
-        stage the spatial axes are whole; pp composes with dp (the
-        microbatch batch dim shards over the data axis), not with the
-        2-D pair sharding.
+        stages unchanged. The pipeline's shard_map is manual ONLY over
+        the `pipe`/`data` axes; the mesh's `i`/`j` axes stay auto, so the
+        in-model GSPMD constraints (shard_pair/shard_msa) keep 2-D
+        sharding the pair tensor INSIDE each stage — pp composes with
+        both dp (microbatch batch dim over `data`) and the pair sharding
+        that makes flagship crops fit (VERDICT r4 #4; the constraint
+        specs drop the manual axis names via use_mesh's manual_axes).
         """
         import jax
 
@@ -460,8 +462,25 @@ class Evoformer(nn.Module):
         stacked = jax.tree.map(
             lambda p: p.reshape(s_count, depth_per, *p.shape[1:]), params)
 
+        # bf16 under the pipeline is TPU-only: on XLA:CPU the partial-auto
+        # lowering emits `psum_invariant` all-reduces whose reduction body
+        # has a ROOT copy, and the CPU-only AllReducePromotion pass
+        # crashes cloning those in bf16 ("Invalid binary instruction
+        # opcode copy", r05). CPU also merely emulates bf16 in f32, so
+        # widening to f32 there is strictly better; on TPU the promotion
+        # pass does not exist and both the configured block dtype and the
+        # activation dtype pass through untouched (no casts, numerics
+        # identical to the scan path).
+        act_dtype = x.dtype
+        on_cpu = jax.default_backend() == "cpu"
+        stage_kwargs = dict(block_kwargs)
+        if on_cpu and stage_kwargs.get("dtype") == jnp.bfloat16:
+            stage_kwargs["dtype"] = jnp.float32
+        boundary_dtype = jnp.float32 \
+            if (on_cpu and act_dtype == jnp.bfloat16) else act_dtype
+
         block = nn.remat(EvoformerBlock, static_argnums=(5,),
-                         prevent_cse=False)(**block_kwargs, parent=None)
+                         prevent_cse=False)(**stage_kwargs, parent=None)
 
         def stage_fn(stage_params, act):
             xi, mi, pmask, mmask = act[:4]
@@ -473,7 +492,11 @@ class Evoformer(nn.Module):
             def body(carry, pj):
                 p, j = pj
                 xi, mi = carry
-                with use_mesh(None):   # constraints are no-ops in-stage
+                # in-stage constraints stay LIVE for the auto (i, j)
+                # axes; pipe/data are manual in the enclosing shard_map
+                # and get dropped from the specs
+                with use_mesh(mesh, manual_axes=frozenset(
+                        {PIPE_AXIS, DATA_AXIS})):
                     if has_dropout:
                         lk = jax.random.fold_in(
                             mb_key, s_idx * depth_per + j)
@@ -487,7 +510,8 @@ class Evoformer(nn.Module):
 
             (xi, mi), _ = jax.lax.scan(
                 body, (xi, mi), (stage_params, jnp.arange(depth_per)))
-            return (xi, mi, pmask, mmask) + act[4:]
+            return (xi.astype(boundary_dtype), mi.astype(boundary_dtype),
+                    pmask, mmask) + act[4:]
 
         # masks ride as float tensors (one activation tree, one dtype
         # rule per leaf); materialized when absent so the tree is static
@@ -496,14 +520,16 @@ class Evoformer(nn.Module):
         mmask = jnp.ones(m.shape[:3], jnp.float32) if msa_mask is None \
             else msa_mask.astype(jnp.float32)
         xs = jax.tree.map(lambda t: microbatch(t, m_count),
-                          (x, m, pmask, mmask))
+                          (x.astype(boundary_dtype),
+                           m.astype(boundary_dtype), pmask, mmask))
         if has_dropout:
             mb_keys = jax.vmap(lambda i: jax.random.key_data(
                 jax.random.fold_in(base_key, i)))(jnp.arange(m_count))
             xs = xs + (mb_keys[:, None],)   # (M, 1, key_words)
         out = pipeline_apply(stage_fn, stacked, xs, mesh,
                              data_axis=DATA_AXIS)
-        x, m = unmicrobatch(out[0]), unmicrobatch(out[1])
+        x = unmicrobatch(out[0]).astype(act_dtype)
+        m = unmicrobatch(out[1]).astype(act_dtype)
         return x, m
 
     @nn.compact

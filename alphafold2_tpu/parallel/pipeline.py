@@ -143,14 +143,31 @@ def pipeline_apply(
 
         (_, outputs), _ = jax.lax.scan(
             tick, (state0, outputs0), jnp.arange(ticks))
-        # results live on the last ring position; replicate them
-        outputs = jax.tree.map(
-            lambda o: jax.lax.psum(
-                jnp.where(idx == s_count - 1, o, jnp.zeros_like(o)),
-                axis_name), outputs)
+        # results live on the last ring position; replicate them. psum in
+        # f32: XLA:CPU's AllReducePromotion pass crashes cloning bf16
+        # all-reduces that reach it from the partial-auto lowering
+        # ("Invalid binary instruction opcode copy", observed r05), and
+        # a bf16 sum-of-one-nonzero loses nothing by running wider.
+        def _replicate(o):
+            of = o.astype(jnp.float32) if o.dtype == jnp.bfloat16 else o
+            r = jax.lax.psum(
+                jnp.where(idx == s_count - 1, of, jnp.zeros_like(of)),
+                axis_name)
+            return r.astype(o.dtype)
+
+        outputs = jax.tree.map(_replicate, outputs)
         return outputs
 
+    # Manual only over the pipe (and data) axes: any other mesh axes
+    # (the pair tensor's `i`/`j`) stay AUTO, so GSPMD keeps honoring
+    # in-stage `with_sharding_constraint`s — pipeline parallelism
+    # composes with the 2-D pair sharding instead of collapsing it
+    # (VERDICT r4 #4).
+    manual = {axis_name}
+    if data_axis is not None and data_axis in mesh.axis_names:
+        manual.add(data_axis)
     fn = jax.shard_map(spmd, mesh=mesh,
                        in_specs=(param_specs, x_specs),
-                       out_specs=x_specs)
+                       out_specs=x_specs,
+                       axis_names=frozenset(manual))
     return fn(stacked_params, xs)

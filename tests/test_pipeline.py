@@ -225,6 +225,41 @@ class TestModelPipeline:
             assert bool(jnp.isfinite(metrics["loss"]))
             assert int(new_state.step) == 1
 
+    def test_evoformer_pp_composes_with_pair_sharding(self):
+        """VERDICT r4 #4: pp x 2-D pair sharding. The pipeline shard_map
+        is manual over (pipe, data) ONLY; `i`/`j` stay auto, so in-stage
+        shard_pair/shard_msa constraints keep 2-D sharding the pair
+        tensor. Mesh (pipe=2, i=2, j=2): exactness vs the plain trunk,
+        and the compiled HLO carries both the stage-hop permutes and the
+        pair re-shard collectives."""
+        import re
+
+        from alphafold2_tpu.model.evoformer import Evoformer
+        from alphafold2_tpu.parallel import make_mesh, use_mesh
+
+        x, msa, pmask, msa_mask = self._inputs(jax.random.PRNGKey(64),
+                                               b=2)
+        kw = dict(dim=32, depth=4, heads=2, dim_head=16)
+        plain = Evoformer(**kw)
+        pp = Evoformer(**kw, pipeline_stages=2)
+        params = plain.init(jax.random.PRNGKey(65), x, msa,
+                            mask=pmask, msa_mask=msa_mask)
+        xo, mo = plain.apply(params, x, msa, mask=pmask,
+                             msa_mask=msa_mask)
+
+        mesh = make_mesh(1, 2, 2, pipe=2)
+        with use_mesh(mesh):
+            f = jax.jit(lambda p: pp.apply(p, x, msa, mask=pmask,
+                                           msa_mask=msa_mask))
+            hlo = f.lower(params).compile().as_text()
+            xp, mp = f(params)
+        assert np.allclose(np.asarray(xo), np.asarray(xp), atol=2e-5)
+        assert np.allclose(np.asarray(mo), np.asarray(mp), atol=2e-5)
+        colls = set(re.findall(
+            r"all-gather|all-to-all|collective-permute", hlo))
+        assert "collective-permute" in colls      # pipeline stage hops
+        assert colls & {"all-gather", "all-to-all"}  # i/j re-shards
+
 
 class TestPipelineDropout:
     """Dropout through the GPipe trunk: per-(microbatch, layer) keys
