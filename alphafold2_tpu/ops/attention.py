@@ -113,7 +113,7 @@ def _attn_kernel(*refs, scale, has_bias, has_qm, has_km):
     o_ref[0] = out.astype(o_ref.dtype)
 
 
-def fused_attention(
+def _fused_attention_pallas(
     q: jnp.ndarray,              # (B, Nq, D)
     k: jnp.ndarray,              # (B, Nk, D)
     v: jnp.ndarray,              # (B, Nk, D)
@@ -126,15 +126,8 @@ def fused_attention(
     block_q: int = 128,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Fused bias+mask+softmax+matmul attention.
-
-    Batch layout: B = batch * bias_repeat * heads with head fastest, i.e.
-    flat index i = (batch * bias_repeat + fold) * heads + head. `bias`
-    covers (batch, heads) and is replayed over the folded middle axis via
-    the index map; masks cover (batch * bias_repeat) and are shared
-    across heads. N and D should be multiples of the TPU lane/sublane
-    tiling (128 / 8); callers pad crops accordingly.
-    """
+    """The raw pallas_call (forward only — no AD rule; use
+    `fused_attention`)."""
     b, n, d = q.shape
     nk = k.shape[1]
     # largest power-of-two block <= block_q that divides n, so any sequence
@@ -182,6 +175,94 @@ def fused_attention(
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         interpret=interpret,
     )(*args)
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_attention_vjp(heads, bias_repeat, block_q, interpret):
+    """custom_vjp wrapper: Pallas forward, XLA-recompute backward.
+
+    The kernel stores only the (N, D) output, so the backward recomputes
+    attention through `attention_reference` under jax.vjp — the same
+    recompute-in-backward trade `jax.checkpoint` makes, with XLA free to
+    fuse the recomputation. Grads flow to q/k/v and the (unrepeated)
+    bias; masks get symbolic-zero cotangents."""
+
+    def run(q, k, v, bias, q_mask, k_mask):
+        return _fused_attention_pallas(
+            q, k, v, bias, q_mask, k_mask, heads=heads,
+            bias_repeat=bias_repeat, block_q=block_q, interpret=interpret)
+
+    f = jax.custom_vjp(run)
+
+    def fwd(q, k, v, bias, q_mask, k_mask):
+        return run(q, k, v, bias, q_mask, k_mask), \
+            (q, k, v, bias, q_mask, k_mask)
+
+    def bwd(res, g):
+        import numpy as np
+        q, k, v, bias, q_mask, k_mask = res
+        if bias is None:
+            ref = lambda q, k, v: attention_reference(
+                q, k, v, q_mask=q_mask, k_mask=k_mask, heads=heads,
+                bias_repeat=bias_repeat)
+            _, vjp = jax.vjp(ref, q, k, v)
+            dq, dk, dv = vjp(g)
+            dbias = None
+        else:
+            ref = lambda q, k, v, bias: attention_reference(
+                q, k, v, bias=bias, q_mask=q_mask, k_mask=k_mask,
+                heads=heads, bias_repeat=bias_repeat)
+            _, vjp = jax.vjp(ref, q, k, v, bias)
+            dq, dk, dv, dbias = vjp(g)
+
+        def zero_cot(x):
+            if x is None:
+                return None
+            if jnp.issubdtype(x.dtype, jnp.inexact):
+                return jnp.zeros_like(x)
+            return np.zeros(np.shape(x), dtype=jax.dtypes.float0)
+
+        return dq, dk, dv, dbias, zero_cot(q_mask), zero_cot(k_mask)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def fused_attention(
+    q: jnp.ndarray,              # (B, Nq, D)
+    k: jnp.ndarray,              # (B, Nk, D)
+    v: jnp.ndarray,              # (B, Nk, D)
+    bias=None,                   # (Bb, Nq, Nk) additive, optional
+    q_mask=None,                 # (B // heads, Nq) bool/0-1, optional
+    k_mask=None,                 # (B // heads, Nk) bool/0-1, optional
+    *,
+    heads: int = 1,
+    bias_repeat: int = 1,
+    block_q: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused bias+mask+softmax+matmul attention (differentiable).
+
+    Batch layout: B = batch * bias_repeat * heads with head fastest, i.e.
+    flat index i = (batch * bias_repeat + fold) * heads + head. `bias`
+    covers (batch, heads) and is replayed over the folded middle axis via
+    the index map; masks cover (batch * bias_repeat) and are shared
+    across heads. N and D should be multiples of the TPU lane/sublane
+    tiling (128 / 8); callers pad crops accordingly.
+
+    Degenerate tiles (Nq or Nk < 8 — e.g. the 1x1 pair maps the model's
+    init-time branch coverage traces) fall back to the XLA reference:
+    Mosaic lowers their dots to vector multi_reductions with loop-carried
+    accumulators and refuses ("only constant accumulators supported",
+    observed on-chip r05), and such shapes gain nothing from the kernel.
+    """
+    n, nk = q.shape[1], k.shape[1]
+    if n < 8 or nk < 8:
+        return attention_reference(q, k, v, bias=bias, q_mask=q_mask,
+                                   k_mask=k_mask, heads=heads,
+                                   bias_repeat=bias_repeat)
+    return _fused_attention_vjp(heads, bias_repeat, block_q, interpret)(
+        q, k, v, bias, q_mask, k_mask)
 
 
 def attention_reference(q, k, v, bias=None, q_mask=None, k_mask=None,

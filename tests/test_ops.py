@@ -308,3 +308,69 @@ class TestBlockSparseKernel:
         # bf16 disagreement on O(1) outputs
         assert np.allclose(np.asarray(out, jnp.float32),
                            np.asarray(ref, jnp.float32), atol=5e-2)
+
+
+class TestFusedAttentionGrad:
+    """The kernel's custom_vjp (r05): Pallas forward, XLA-recompute
+    backward — grads must match plain autodiff of the reference, and the
+    train path through the model must differentiate (the round-4 kernel
+    had no AD rule at all, so BENCH_PALLAS could never take a train
+    step)."""
+
+    def test_grads_match_reference(self):
+        q, k, v, bias = make_inputs(jax.random.PRNGKey(7))
+        qm = jnp.ones((q.shape[0], q.shape[1])).at[:, -3:].set(0.0)
+
+        def f_kernel(q, k, v, bias):
+            out = ops_attn.fused_attention(q, k, v, bias, q_mask=qm,
+                                           k_mask=qm, interpret=True)
+            return jnp.sum(out * out)
+
+        def f_ref(q, k, v, bias):
+            out = ops_attn.attention_reference(q, k, v, bias, q_mask=qm,
+                                               k_mask=qm)
+            return jnp.sum(out * out)
+
+        gk = jax.grad(f_kernel, argnums=(0, 1, 2, 3))(q, k, v, bias)
+        gr = jax.grad(f_ref, argnums=(0, 1, 2, 3))(q, k, v, bias)
+        for a, b in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_unrepeated_bias_grad_sums_over_fold(self):
+        """d_bias must accumulate over the folded axial axis the index
+        map replays the bias across."""
+        b, rep, h, n, d = 1, 3, 2, 16, 8
+        key = jax.random.PRNGKey(8)
+        ks = jax.random.split(key, 4)
+        q = jax.random.normal(ks[0], (b * rep * h, n, d)) * 0.5
+        k = jax.random.normal(ks[1], (b * rep * h, n, d)) * 0.5
+        v = jax.random.normal(ks[2], (b * rep * h, n, d))
+        bias = jax.random.normal(ks[3], (b * h, n, n))
+
+        def f_kernel(bias):
+            out = ops_attn.fused_attention(q, k, v, bias, heads=h,
+                                           bias_repeat=rep, interpret=True)
+            return jnp.sum(out * out)
+
+        def f_ref(bias):
+            out = ops_attn.attention_reference(q, k, v, bias, heads=h,
+                                               bias_repeat=rep)
+            return jnp.sum(out * out)
+
+        np.testing.assert_allclose(
+            np.asarray(jax.grad(f_kernel)(bias)),
+            np.asarray(jax.grad(f_ref)(bias)), rtol=1e-4, atol=1e-5)
+
+    def test_degenerate_tiles_fall_back(self):
+        """Nq/Nk < 8 (e.g. 1x1 init-coverage pair maps) route to the XLA
+        reference — Mosaic refuses those dots on-chip (r05)."""
+        q = jnp.ones((4, 1, 16))
+        k = jnp.ones((4, 1, 16))
+        v = jnp.ones((4, 1, 16))
+        # interpret=False on a CPU host: would fail inside pallas_call,
+        # so passing proves the fallback took the XLA path
+        out = ops_attn.fused_attention(q, k, v, interpret=False)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(
+                ops_attn.attention_reference(q, k, v)), atol=1e-6)
