@@ -1,0 +1,179 @@
+"""1H22 memorization demo: prove the structure path LEARNS (VERDICT r4 #3).
+
+Round-4's artifact trained at crop 64 / 0 recycles but scored at 72 res /
+3 recycles — a protocol mismatch that left eval RMSD at 8.3 A, a whisker
+above random init. This runner aligns the protocols: train on the FULL
+72-residue 1H22 fixture (tests/data/1h22_head.pdb, the reference
+notebooks' own validation protein, notebooks/data/1h22_protein.pdb) at
+0 recycles, and score the SAME configuration (plus a 3-recycle contrast
+row). An overfit fixture must reach crystal-memorization accuracy —
+target Kabsch RMSD < 2 A, TM > 0.8 — or the structure path doesn't train.
+
+Also reports confidence calibration: Pearson correlation and MAE between
+the per-residue predicted lDDT (confidence head, trained by
+train/losses.lddt_confidence_loss) and the realized per-residue lDDT of
+the final prediction.
+
+Usage: python examples/train_1h22.py [--steps 3000] [--out-dir examples]
+Writes: examples/ckpt_1h22_full/ (orbax), examples/eval_1h22_metrics.json,
+        examples/train_1h22_full_log.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PDB = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "data", "1h22_head.pdb")
+
+
+def _metrics(geometry, pred, ca_true, mask, confidence):
+    per_res_lddt = geometry.lddt_ca(ca_true, pred, mask=mask)[0]
+    m = np.asarray(mask[0], bool)
+    conf = np.asarray(confidence[0])[m]
+    real = np.asarray(per_res_lddt)[m]
+    if conf.std() > 1e-6 and real.std() > 1e-6:
+        pearson = float(np.corrcoef(conf, real)[0, 1])
+    else:  # memorized fixture: both near-constant; correlation undefined
+        pearson = None
+    return {
+        "kabsch_rmsd": float(geometry.kabsch_rmsd(pred, ca_true,
+                                                  mask=mask)[0]),
+        "tm_score": float(geometry.kabsch_tm(pred, ca_true, mask=mask)[0]),
+        "gdt_ts": float(geometry.kabsch_gdt(pred, ca_true, mask=mask)[0]),
+        "lddt": float(real.mean()),
+        "mean_confidence": float(conf.mean()),
+        "confidence_lddt_pearson": pearson,
+        "confidence_lddt_mae": float(np.abs(conf - real).mean()),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=3000)
+    ap.add_argument("--log-every", type=int, default=50)
+    ap.add_argument("--eval-every", type=int, default=250)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--target-rmsd", type=float, default=1.0,
+                    help="early-stop once eval RMSD@0rec drops below this")
+    ap.add_argument("--out-dir", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__))))
+    args = ap.parse_args(argv)
+
+    from alphafold2_tpu import Alphafold2
+    from alphafold2_tpu.core import geometry
+    from alphafold2_tpu.data import native
+    from alphafold2_tpu.predict import fold
+    from alphafold2_tpu.train import (CheckpointManager, TrainState, adam,
+                                      make_train_step)
+
+    with open(PDB) as f:
+        seq_tok, coords14, atom_mask = native.parse_pdb(f.read())
+    n = len(seq_tok)
+    seq = jnp.asarray(seq_tok)[None]
+    mask = jnp.asarray(atom_mask[:, 1])[None]       # CA resolved
+    ca_true = jnp.asarray(coords14[:, 1])[None]     # (1, n, 3)
+
+    # same architecture as round-4's demo (examples/eval_1h22.json), but
+    # float32 (CPU host: XLA:CPU emulates bf16) and FULL-length training
+    model = Alphafold2(dim=64, depth=2, heads=4, dim_head=16,
+                       predict_coords=True, structure_module_depth=2,
+                       dtype=jnp.float32)
+    batch = {"seq": seq, "msa": seq[:, None], "mask": mask,
+             "msa_mask": mask[:, None], "coords": ca_true}
+
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "mlm": jax.random.PRNGKey(1)},
+        seq, msa=batch["msa"], mask=mask, msa_mask=batch["msa_mask"],
+        train=True)
+    state = TrainState.create(apply_fn=model.apply, params=params,
+                              tx=adam(args.lr), rng=jax.random.PRNGKey(2))
+    step = jax.jit(make_train_step(model), donate_argnums=(0,))
+
+    import functools
+    run_fold = jax.jit(functools.partial(fold, model, num_recycles=0))
+
+    log_path = os.path.join(args.out_dir, "train_1h22_full_log.jsonl")
+    ckpt_dir = os.path.join(args.out_dir, "ckpt_1h22_full")
+    t0 = time.time()
+    best = None
+    with open(log_path, "w") as log:
+        for i in range(args.steps):
+            state, metrics = step(state, batch)
+            if i % args.log_every == 0 or i == args.steps - 1:
+                row = {k: round(float(v), 4) for k, v in metrics.items()}
+                row["step"] = i
+                row["elapsed_s"] = round(time.time() - t0, 1)
+                log.write(json.dumps(row) + "\n")
+                log.flush()
+                print(row, flush=True)
+            if (i and i % args.eval_every == 0) or i == args.steps - 1:
+                res = run_fold(state.params, seq, msa=batch["msa"],
+                               mask=mask, msa_mask=batch["msa_mask"])
+                rmsd = float(geometry.kabsch_rmsd(res.coords, ca_true,
+                                                  mask=mask)[0])
+                print({"step": i, "eval_rmsd_0rec": round(rmsd, 3)},
+                      flush=True)
+                log.write(json.dumps({"step": i,
+                                      "eval_rmsd_0rec": round(rmsd, 3)})
+                          + "\n")
+                log.flush()
+                best = rmsd if best is None else min(best, rmsd)
+                if rmsd < args.target_rmsd:
+                    print(f"early stop at step {i}: rmsd {rmsd:.3f}")
+                    break
+
+    CheckpointManager(ckpt_dir).save(state)
+
+    # ---- final scoring: protocol-aligned (0 recycles) + 3-rec contrast
+    res0 = run_fold(state.params, seq, msa=batch["msa"], mask=mask,
+                    msa_mask=batch["msa_mask"])
+    run_fold3 = jax.jit(functools.partial(fold, model, num_recycles=3))
+    res3 = run_fold3(state.params, seq, msa=batch["msa"], mask=mask,
+                     msa_mask=batch["msa_mask"])
+
+    # random-init contrast, same fold path
+    rnd_params = model.init(
+        {"params": jax.random.PRNGKey(42), "mlm": jax.random.PRNGKey(43)},
+        seq, msa=batch["msa"], mask=mask, msa_mask=batch["msa_mask"],
+        train=True)
+    res_rnd = run_fold(rnd_params, seq, msa=batch["msa"], mask=mask,
+                       msa_mask=batch["msa_mask"])
+
+    out = {
+        "n_residues": n,
+        "protocol": "train full-length @0 recycles; headline eval "
+                    "@0 recycles (matched); recycles_3 row is the "
+                    "UNtrained-recycling contrast",
+        "train_steps": int(state.step),
+        "headline": _metrics(geometry, res0.coords, ca_true, mask,
+                             res0.confidence),
+        "recycles_3": _metrics(geometry, res3.coords, ca_true, mask,
+                               res3.confidence),
+        "random_init_baseline": _metrics(geometry, res_rnd.coords, ca_true,
+                                         mask, res_rnd.confidence),
+        "checkpoint": ckpt_dir,
+        "log": log_path,
+        "config": {"dim": 64, "depth": 2, "heads": 4, "dim_head": 16,
+                   "structure_module_depth": 2, "dtype": "float32",
+                   "lr": args.lr, "full_length": n, "msa_depth": 1},
+    }
+    path = os.path.join(args.out_dir, "eval_1h22_metrics.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out, indent=2))
+    return out
+
+
+if __name__ == "__main__":
+    main()
