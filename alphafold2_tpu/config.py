@@ -31,6 +31,7 @@ class ModelConfig:
     structure_module_heads: int = 1
     structure_module_type: str = "ipa"
     structure_module_refinement_iters: int = 0
+    structure_module_refinement: str = "residue"   # 'residue' | 'egnn-atom'
     reversible: bool = False
     ring_attention: bool = False
     pipeline_stages: int = 1          # GPipe trunk stages (mesh pipe axis)

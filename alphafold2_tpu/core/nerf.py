@@ -268,12 +268,29 @@ def sidechain_container(
     k = constants.NUM_COORDS_PER_RES
 
     if a == 1:
-        # CA-only input: synthesize a virtual N/C frame along the chain
+        # CA-only input: synthesize a virtual N/C frame along the chain.
+        # The end residues must not collapse (N==CA) or go collinear
+        # (N, CA, C on the chain step) — either degenerates their NeRF
+        # frame, whose eps-regularized directions are NOT rotation-
+        # equivariant (caught by the atom-refiner equivariance test,
+        # r05). They borrow the ADJACENT step instead, so their virtual
+        # N/C generically span a plane, and the construction stays a
+        # function of difference vectors only (translation/rotation
+        # equivariant by construction).
         ca = backbone[:, :, 0]
-        prev_ca = jnp.concatenate([ca[:, :1], ca[:, :-1]], axis=1)
-        next_ca = jnp.concatenate([ca[:, 1:], ca[:, -1:]], axis=1)
-        n_at = ca + (prev_ca - ca) * (1.46 / 3.8)
-        c_at = ca + (next_ca - ca) * (1.52 / 3.8)
+        if l > 2:
+            step = ca[:, 1:] - ca[:, :-1]                  # (b, l-1, 3)
+            prev_dir = jnp.concatenate([step[:, 1:2], step], axis=1)
+            next_dir = jnp.concatenate([step, step[:, -2:-1]], axis=1)
+        elif l == 2:
+            step = ca[:, 1:] - ca[:, :-1]
+            prev_dir = jnp.concatenate([step, step], axis=1)
+            next_dir = prev_dir
+        else:
+            prev_dir = jnp.zeros_like(ca)
+            next_dir = jnp.zeros_like(ca)
+        n_at = ca - prev_dir * (1.46 / 3.8)
+        c_at = ca + next_dir * (1.52 / 3.8)
     else:
         n_at, ca, c_at = backbone[:, :, 0], backbone[:, :, 1], backbone[:, :, 2]
 

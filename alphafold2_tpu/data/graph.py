@@ -90,3 +90,71 @@ def mat_input_to_masked(
     m = mask if mask is not None else jnp.ones((b, n), bool)
     edge_mask = m[:, :, None] & m[:, None, :] & (edges_mat > 0)
     return nodes, node_mask, edges_mat, edge_mask
+
+
+# ---------------------------------------------------------------------------
+# Static-degree covalent neighbor list (no dense (N, N) adjacency)
+# ---------------------------------------------------------------------------
+
+_INTRA_TABLES = None
+
+
+def _intra_neighbor_tables():
+    """(21, 14, 3) local neighbor-slot ids + mask from the bond table
+    (max intra-residue heavy-atom degree in the 14-slot layout is 3)."""
+    global _INTRA_TABLES
+    if _INTRA_TABLES is None:
+        import numpy as np
+        t = np.asarray(constants.BOND_ADJACENCY_TABLE)
+        k_intra = int((t > 0).sum(-1).max())
+        idx = np.zeros((*t.shape[:2], k_intra), np.int32)
+        msk = np.zeros((*t.shape[:2], k_intra), np.float32)
+        for a in range(t.shape[0]):
+            for s in range(t.shape[1]):
+                nb = np.nonzero(t[a, s])[0]
+                idx[a, s, :len(nb)] = nb
+                msk[a, s, :len(nb)] = 1.0
+        _INTRA_TABLES = (idx, msk)
+    return _INTRA_TABLES
+
+
+def covalent_neighbor_table(
+    seq: jnp.ndarray,
+    include_peptide_bonds: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(b, L) tokens -> neighbor list over the flat L*14 atom cloud:
+    (idx (b, L*14, 4), mask (b, L*14, 4)).
+
+    The O(N*K) form of `prot_covalent_bond` (same bonds: per-AA table
+    intra-residue, C(i)<->N(i+1) peptide) for consumers that only need
+    each atom's <=4 bonded partners — building the dense (N, N)
+    adjacency and top_k-ing it costs O(N^2) memory for a degree-<=4
+    graph (822 MB/batch at 1024 res; r05 review). Slots are [3 intra
+    bonds | 1 peptide bond], masked where absent."""
+    import numpy as np
+
+    b, l = seq.shape
+    k = constants.NUM_COORDS_PER_RES
+    intra_idx, intra_mask = _intra_neighbor_tables()
+    li = jnp.asarray(intra_idx)[seq]                  # (b, l, 14, 3)
+    lm = jnp.asarray(intra_mask)[seq]
+    base = (jnp.arange(l) * k)[None, :, None, None]
+    gidx = (li + base).reshape(b, l * k, -1)
+    gmask = lm.reshape(b, l * k, -1)
+
+    # peptide column is sequence-independent: N slot 0 bonds back to
+    # C (slot 2) of residue i-1; C slot 2 bonds forward to N of i+1
+    pep = np.zeros((l, k), np.int32)
+    pmask = np.zeros((l, k), np.float32)
+    if include_peptide_bonds and l > 1:
+        rows = np.arange(l)
+        pep[1:, 0] = (rows[1:] - 1) * k + 2
+        pmask[1:, 0] = 1.0
+        pep[:-1, 2] = (rows[:-1] + 1) * k
+        pmask[:-1, 2] = 1.0
+    pep_idx = jnp.broadcast_to(jnp.asarray(pep).reshape(1, l * k, 1),
+                               (b, l * k, 1))
+    pep_mask = jnp.broadcast_to(jnp.asarray(pmask).reshape(1, l * k, 1),
+                                (b, l * k, 1))
+    return (jnp.concatenate([gidx, pep_idx], axis=-1),
+            jnp.concatenate([gmask, pep_mask], axis=-1))

@@ -38,7 +38,8 @@ from alphafold2_tpu.model.attention_variants import (
 from alphafold2_tpu.model.evoformer import Evoformer, PairwiseAttentionBlock
 from alphafold2_tpu.model.mlm import MLM
 from alphafold2_tpu.model.primitives import Attention, Dense, LayerNorm
-from alphafold2_tpu.model.refiners import Refiner
+from alphafold2_tpu.model.refiners import (AtomEGNNRefiner,
+                                            Refiner)
 from alphafold2_tpu.model.structure import StructureModule
 from alphafold2_tpu.parallel.sharding import shard_msa, shard_pair
 
@@ -66,6 +67,10 @@ class ReturnValues:
     # path so the head can be trained (the reference's lddt_linear ships
     # untrained — alphafold2.py:621)
     confidence: Optional[jnp.ndarray] = None
+    # full refined atom cloud (b, n, 14, 3); populated only under
+    # structure_module_refinement='egnn-atom' (the notebook's atom-level
+    # path — coords stay the CA trace for API stability)
+    atoms: Optional[jnp.ndarray] = None
 
 
 class Alphafold2(nn.Module):
@@ -101,6 +106,13 @@ class Alphafold2(nn.Module):
     # the produced coordinates (on top of any module type).
     structure_module_type: str = "ipa"
     structure_module_refinement_iters: int = 0
+    # what refinement_iters refines: 'residue' = dense EGNN on the CA
+    # trace (the README-era refinement loop); 'egnn-atom' = sparse EGNN
+    # over the 14-slot covalent-bond atom graph, the reference notebook's
+    # atom-level experiment (egnn_esm_end2end.ipynb cells 25-33,
+    # utils.py:497-650) — coords stay (b, n, 3) CA; the full refined
+    # atom cloud is returned on ReturnValues.atoms
+    structure_module_refinement: str = "residue"
     # reversible main trunk (README.md:40-era flag): O(1) activation memory
     reversible: bool = False
     # scan+remat over trunk depth (Evoformer.use_scan); False unrolls the
@@ -548,11 +560,34 @@ class Alphafold2(nn.Module):
             )(single_repr, init_coords, edges=pairwise_repr, mask=mask)
 
         if self.structure_module_refinement_iters > 0:
-            single_out, coords = Refiner(
-                dim=self.dim, kind="egnn",
-                iters=self.structure_module_refinement_iters,
-                edge_dim=self.dim, name="coords_refiner",
-            )(single_out, coords, edges=pairwise_repr, mask=mask)
+            if self.structure_module_refinement == "egnn-atom":
+                # notebook atom-level path: CA trace -> 14-atom scaffold
+                # -> sparse EGNN over the covalent graph; coords contract
+                # stays the refined CA slot
+                _, atoms = AtomEGNNRefiner(
+                    dim=self.dim,
+                    iters=self.structure_module_refinement_iters,
+                    name="atom_refiner",
+                )(single_out, coords, seq, mask=mask)
+                ret_kwargs["atoms"] = atoms
+                # CA slot — except for residues with no atom cloud at all
+                # (unknown/'_' tokens: scn cloud mask all-zero), whose
+                # refined slot is zeroed; they keep the structure-module
+                # coords instead of collapsing to the origin (r05 review)
+                from alphafold2_tpu.data.scn import scn_cloud_mask
+                has_ca = scn_cloud_mask(seq)[:, :, 1:2] > 0
+                coords = jnp.where(has_ca, atoms[:, :, 1], coords)
+            elif self.structure_module_refinement == "residue":
+                single_out, coords = Refiner(
+                    dim=self.dim, kind="egnn",
+                    iters=self.structure_module_refinement_iters,
+                    edge_dim=self.dim, name="coords_refiner",
+                )(single_out, coords, edges=pairwise_repr, mask=mask)
+            else:
+                raise ValueError(
+                    "structure_module_refinement must be 'residue' or "
+                    f"'egnn-atom', got "
+                    f"{self.structure_module_refinement!r}")
 
         # confidence head always built (cheap Dense(1)) so one params tree
         # serves every return configuration

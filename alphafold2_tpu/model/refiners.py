@@ -182,3 +182,140 @@ class Refiner(nn.Module):
         for _ in range(self.iters):
             h, x = layer(h, x, edges=edges, mask=mask)
         return h, x
+
+
+# ---------------------------------------------------------------------------
+# Atom-level refinement over the covalent-bond graph (round-4 VERDICT #8)
+# ---------------------------------------------------------------------------
+
+
+class SparseEGNNLayer(nn.Module):
+    """EGNN over a fixed-degree neighbor list instead of all pairs.
+
+    The reference notebook refines at the ATOM level with a *sparse* EGNN
+    over the 14-slot covalent graph (egnn_esm_end2end.ipynb cells 25-33,
+    utils.py:497-650). The atom cloud is L*14 nodes; all-pairs messages
+    would be O((L*14)^2) — 12.8M pairs at 256 res — for a graph whose true
+    degree is <= 4. The TPU-native sparse form is a static-shape GATHER:
+    each node sees exactly `max_degree` neighbor slots (take_along_axis
+    over precomputed indices), so messages are O(N * max_degree), no
+    dynamic shapes, no scatter.
+    """
+
+    dim: int
+    max_degree: int = 4
+    hidden: Optional[int] = None
+    coor_clamp: float = 3.0
+
+    @nn.compact
+    def __call__(self, h, x, neigh_idx, neigh_mask, mask=None):
+        """h: (b, N, d); x: (b, N, 3); neigh_idx/(b, N, K) int indices;
+        neigh_mask: (b, N, K) 1.0 where the slot holds a real bond;
+        mask: (b, N) node validity."""
+        hidden = self.hidden or self.dim * 2
+        b, n_nodes, d = h.shape
+        k = neigh_idx.shape[-1]
+
+        def gather(t, idx):
+            # t (b, N, c), idx (b, N, K) -> (b, N, K, c)
+            c = t.shape[-1]
+            flat = jnp.broadcast_to(idx.reshape(b, n_nodes * k, 1),
+                                    (b, n_nodes * k, c))
+            return jnp.take_along_axis(t, flat, axis=1).reshape(
+                b, n_nodes, k, c)
+
+        h_j = gather(h, neigh_idx)                       # (b, N, K, d)
+        x_j = gather(x, neigh_idx)                       # (b, N, K, 3)
+        rel = x[:, :, None, :] - x_j                     # (b, N, K, 3)
+        dist2 = _safe_norm2(rel)                         # (b, N, K, 1)
+
+        live = neigh_mask[..., None]
+        if mask is not None:
+            live = live * mask[:, :, None, None]
+        msg_in = jnp.concatenate(
+            [jnp.broadcast_to(h[:, :, None, :], (b, n_nodes, k, d)),
+             h_j, dist2], axis=-1)
+        msg = jax.nn.silu(Dense(hidden, param_dtype=jnp.float32,
+                                name="edge_mlp_in")(msg_in))
+        msg = jax.nn.silu(Dense(hidden, param_dtype=jnp.float32,
+                                name="edge_mlp_out")(msg))
+        msg = msg * live
+
+        coor_w = Dense(1, param_dtype=jnp.float32, use_bias=False,
+                       kernel_init=zeros_init(), name="coor_mlp")(msg)
+        coor_w = jnp.tanh(coor_w) * self.coor_clamp * live
+        denom = jnp.maximum(live.sum(axis=2), 1.0)       # (b, N, 1)
+        x = x + (rel / jnp.sqrt(dist2) * coor_w).sum(axis=2) / denom
+
+        agg = msg.sum(axis=2) / denom
+        dh = jax.nn.silu(Dense(hidden, param_dtype=jnp.float32,
+                               name="node_mlp_in")(
+            jnp.concatenate([h, agg], axis=-1)))
+        dh = Dense(self.dim, param_dtype=jnp.float32,
+                   name="node_mlp_out")(dh)
+        if mask is not None:
+            dh = dh * mask[:, :, None]
+        return h + dh, x
+
+
+class AtomEGNNRefiner(nn.Module):
+    """Atom-level coordinate refinement: residue repr + CA trace ->
+    14-atom scaffold (core/nerf.sidechain_container) -> sparse EGNN over
+    the covalent-bond adjacency (data/graph.prot_covalent_bond) ->
+    refined atom cloud.
+
+    The `structure_module_refinement='egnn-atom'` mode (reference
+    notebook cells 25-33; utils.py:497-650 `mat_input_to_masked` +
+    `prot_covalent_bond`). Returns (h_atoms, atoms) with atoms
+    (b, L, 14, 3); the CA slot [:, :, 1] is the model's coords contract.
+    """
+
+    dim: int
+    iters: int = 2
+    max_degree: int = 4
+
+    @nn.compact
+    def __call__(self, h_res, ca_coords, seq, mask=None):
+        """h_res: (b, L, d) single repr; ca_coords: (b, L, 3);
+        seq: (b, L) tokens; mask: (b, L) residue validity."""
+        from alphafold2_tpu import constants
+        from alphafold2_tpu.core.nerf import sidechain_container
+        from alphafold2_tpu.data.graph import covalent_neighbor_table
+        from alphafold2_tpu.data.scn import scn_atom_embedd, scn_cloud_mask
+
+        b, l, d = h_res.shape
+        kk = constants.NUM_COORDS_PER_RES
+        n_atoms = l * kk
+
+        atoms = sidechain_container(
+            ca_coords.astype(jnp.float32)[:, :, None, :], seq)
+        cloud = scn_cloud_mask(seq)                     # (b, L, 14)
+        if mask is not None:
+            cloud = cloud * mask[..., None].astype(cloud.dtype)
+
+        atom_tok = scn_atom_embedd(seq)                 # (b, L, 14)
+        h_atom = Dense(self.dim, param_dtype=jnp.float32,
+                       name="res_to_atom")(h_res)[:, :, None, :] + \
+            nn.Embed(constants.NUM_ATOM_TOKENS, self.dim,
+                     param_dtype=jnp.float32,
+                     name="atom_id_embed")(atom_tok)
+
+        # static-degree neighbor list straight from the bond tables —
+        # O(N*K); never materializes the (N, N) adjacency
+        neigh_idx, neigh_mask = covalent_neighbor_table(seq)
+
+        h = h_atom.reshape(b, n_atoms, self.dim)
+        x = atoms.reshape(b, n_atoms, 3)
+        node_mask = cloud.reshape(b, n_atoms)
+        # a bond to a masked atom slot is not a message path
+        neigh_mask = neigh_mask * jnp.take_along_axis(
+            node_mask, neigh_idx.reshape(b, -1), axis=1).reshape(
+            neigh_idx.shape)
+
+        layer = SparseEGNNLayer(dim=self.dim, max_degree=self.max_degree,
+                                name="layer")
+        for _ in range(self.iters):
+            h, x = layer(h, x, neigh_idx, neigh_mask, mask=node_mask)
+
+        atoms = x.reshape(b, l, kk, 3) * cloud[..., None]
+        return h.reshape(b, l, kk, self.dim), atoms

@@ -184,6 +184,26 @@ class TestGraph:
         # peptide bond: C (slot 2) of res 0 to N (slot 14) of res 1
         assert adj[0, 2, 14] == 1 and adj[0, 14, 2] == 1
 
+    def test_neighbor_table_matches_dense_adjacency(self):
+        """covalent_neighbor_table is the O(N*K) form of
+        prot_covalent_bond: same edge set on random sequences."""
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        seq = jnp.asarray(rng.integers(0, 21, size=(2, 7)))
+        adj = np.asarray(graph.prot_covalent_bond(seq))
+        idx, msk = graph.covalent_neighbor_table(seq)
+        idx, msk = np.asarray(idx), np.asarray(msk)
+        n = adj.shape[1]
+        for b in range(adj.shape[0]):
+            dense_edges = {(i, j) for i in range(n) for j in range(n)
+                           if adj[b, i, j] > 0}
+            table_edges = {(i, int(idx[b, i, s]))
+                           for i in range(n)
+                           for s in range(idx.shape[-1])
+                           if msk[b, i, s] > 0}
+            assert table_edges == dense_edges
+
     def test_nth_degree(self):
         seq = jnp.asarray([[featurize.AA_INDEX["A"]]])
         adj = graph.prot_covalent_bond(seq, include_peptide_bonds=False)

@@ -116,3 +116,107 @@ def test_seq_and_msa_embed_projection():
         seq_embed=jnp.ones((1, 8, 48)),
         msa_embed=jnp.ones((1, 3, 8, 48)))
     assert ret.distance.shape == (1, 8, 8, 37)
+
+
+# ---------------------------------------------------------------------------
+# Atom-level EGNN refinement (round-4 VERDICT #8; notebook cells 25-33)
+# ---------------------------------------------------------------------------
+
+
+class TestAtomEGNNRefiner:
+    def _inputs(self, key, b=1, l=6, d=16):
+        ks = jax.random.split(key, 3)
+        h = jax.random.normal(ks[0], (b, l, d))
+        ca = jnp.cumsum(
+            jax.random.normal(ks[1], (b, l, 3)) * 0.5 +
+            jnp.asarray([3.8, 0.0, 0.0]), axis=1)
+        seq = jax.random.randint(ks[2], (b, l), 0, 20)
+        mask = jnp.ones((b, l), bool)
+        return h, ca, seq, mask
+
+    def test_shapes_and_finite(self):
+        from alphafold2_tpu.model.refiners import AtomEGNNRefiner
+
+        h, ca, seq, mask = self._inputs(jax.random.PRNGKey(0))
+        ref = AtomEGNNRefiner(dim=16, iters=2)
+        params = ref.init(jax.random.PRNGKey(1), h, ca, seq, mask=mask)
+        h_at, atoms = ref.apply(params, h, ca, seq, mask=mask)
+        assert atoms.shape == (1, 6, 14, 3)
+        assert h_at.shape == (1, 6, 14, 16)
+        assert np.isfinite(np.asarray(atoms)).all()
+        # masked atom slots (per-AA cloud mask) stay zeroed
+        from alphafold2_tpu.data.scn import scn_cloud_mask
+        cloud = np.asarray(scn_cloud_mask(seq))
+        assert np.abs(np.asarray(atoms)[cloud == 0]).max() == 0.0
+
+    def test_equivariance(self):
+        """Rotate+translate the CA trace -> the refined atom cloud
+        rotates/translates identically (E(3) equivariance through the
+        scaffold build-out AND the sparse message passing)."""
+        from alphafold2_tpu.model.refiners import AtomEGNNRefiner
+        from alphafold2_tpu.data.scn import scn_cloud_mask
+
+        h, ca, seq, mask = self._inputs(jax.random.PRNGKey(2))
+        R = rotation(jax.random.PRNGKey(3))
+        t = jnp.asarray([1.5, -2.0, 0.5])
+
+        ref = AtomEGNNRefiner(dim=16, iters=2)
+        params = ref.init(jax.random.PRNGKey(4), h, ca, seq, mask=mask)
+        _, atoms = ref.apply(params, h, ca, seq, mask=mask)
+        _, atoms_rt = ref.apply(params, h, ca @ R.T + t, seq, mask=mask)
+        cloud = np.asarray(scn_cloud_mask(seq))[..., None]
+        expect = (np.asarray(atoms) @ np.asarray(R).T +
+                  np.asarray(t)) * cloud
+        np.testing.assert_allclose(np.asarray(atoms_rt), expect,
+                                   rtol=1e-4, atol=2e-4)
+
+    def test_covalent_graph_is_the_message_path(self):
+        """Zeroed bond mask (max_degree slots of a disconnected graph)
+        must leave coordinates at the scaffold: messages ride ONLY the
+        covalent adjacency."""
+        from alphafold2_tpu.core.nerf import sidechain_container
+        from alphafold2_tpu.model.refiners import SparseEGNNLayer
+
+        b, n, d, k = 1, 8, 8, 4
+        key = jax.random.PRNGKey(5)
+        h = jax.random.normal(key, (b, n, d))
+        x = jax.random.normal(key, (b, n, 3))
+        idx = jnp.zeros((b, n, k), jnp.int32)
+        dead = jnp.zeros((b, n, k))
+        layer = SparseEGNNLayer(dim=d, max_degree=k)
+        params = layer.init(jax.random.PRNGKey(6), h, x, idx, dead)
+        _, x_out = layer.apply(params, h, x, idx, dead)
+        np.testing.assert_allclose(np.asarray(x_out), np.asarray(x),
+                                   atol=1e-6)
+
+    def test_model_decode_path(self):
+        """Full model decode with structure_module_refinement='egnn-atom':
+        coords stay (b, n, 3) CA, ReturnValues.atoms carries the 14-slot
+        cloud, gradients flow."""
+        model = Alphafold2(dim=32, depth=1, heads=2, dim_head=8,
+                           predict_coords=True, structure_module_depth=1,
+                           structure_module_refinement_iters=2,
+                           structure_module_refinement="egnn-atom")
+        seq = jax.random.randint(jax.random.PRNGKey(7), (1, 8), 0, 20)
+        msa = seq[:, None]
+        mask = jnp.ones((1, 8), bool)
+        params = model.init(jax.random.PRNGKey(8), seq, msa=msa,
+                            mask=mask, msa_mask=mask[:, None])
+        coords, ret = model.apply(params, seq, msa=msa, mask=mask,
+                                  msa_mask=mask[:, None],
+                                  return_aux_logits=True)
+        assert coords.shape == (1, 8, 3)
+        assert ret.atoms.shape == (1, 8, 14, 3)
+        np.testing.assert_allclose(np.asarray(coords),
+                                   np.asarray(ret.atoms[:, :, 1]))
+
+        def loss(p):
+            c, _ = model.apply(p, seq, msa=msa, mask=mask,
+                               msa_mask=mask[:, None],
+                               return_aux_logits=True)
+            return jnp.sum(c * c)
+
+        g = jax.grad(loss)(params)
+        leaves = jax.tree.leaves(g)
+        assert all(np.isfinite(np.asarray(x)).all() for x in leaves)
+        assert any(float(jnp.abs(x).max()) > 0 for x in leaves)
