@@ -4,6 +4,7 @@ from alphafold2_tpu.train.loop import (  # noqa: F401
     compute_loss,
     fit,
     make_eval_step,
+    make_recycled_train_step,
     make_train_step,
     shard_batch,
 )

@@ -26,8 +26,12 @@ from alphafold2_tpu.train import losses
 from alphafold2_tpu.train.state import TrainState
 
 
-def compute_loss(model, params, batch, rng, train: bool = True):
-    """Forward + composite loss. Returns (loss, metrics)."""
+def compute_loss(model, params, batch, rng, train: bool = True,
+                 recyclables=None):
+    """Forward + composite loss. Returns (loss, metrics).
+
+    `recyclables` feeds the recycling embedder (prior-iteration state from
+    a no-grad prologue pass; see make_recycled_train_step)."""
     metrics = {}
     wants_coords = model.predict_coords and "coords" in batch
 
@@ -40,6 +44,7 @@ def compute_loss(model, params, batch, rng, train: bool = True):
         mask=mask,
         msa_mask=batch.get("msa_mask"),
         train=train,
+        recyclables=recyclables,
     )
     # 'performer' redraws FAVOR+ random features every step (the per-step
     # form of performer-pytorch's feature_redraw_interval; unbiased). Eval
@@ -106,6 +111,75 @@ def make_train_step(model):
             return compute_loss(model, params, batch, rng, train=True)
 
         grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+        new_state = state.apply_gradients(grads=grads).replace(rng=new_rng)
+        return new_state, metrics
+
+    return train_step
+
+
+def make_recycled_train_step(model, max_recycles: int = 3):
+    """Train step with SAMPLED recycling (the AF2 training protocol the
+    reference only gestures at — its tests run the recycle loop by hand
+    at inference, test_attention.py:344-385, but nothing trains the
+    recycling embedder).
+
+    Each step draws r ~ Uniform{0..max_recycles}, runs r no-grad passes
+    threading `Recyclables` (the model already stop-gradients them), and
+    takes the gradient only through the final pass — so the same weights
+    serve every inference recycle count (predict.fold). One compiled
+    program: the prologue is a fori_loop with a traced bound, the
+    r==0 / r>0 split is a lax.cond."""
+    assert model.predict_coords, "recycled training needs predict_coords"
+    assert max_recycles >= 1
+
+    def train_step(state: TrainState, batch):
+        rng, new_rng = jax.random.split(state.rng)
+        r = jax.random.randint(jax.random.fold_in(rng, 77), (), 0,
+                               max_recycles + 1)
+
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones(batch["seq"].shape, dtype=bool)
+        fwd_kwargs = dict(msa=batch.get("msa"), mask=mask,
+                          msa_mask=batch.get("msa_mask"), train=False,
+                          return_aux_logits=True, return_recyclables=True,
+                          rngs={"performer": jax.random.PRNGKey(0)})
+
+        def one_pass(rec):
+            _, ret = model.apply(state.params, batch["seq"],
+                                 recyclables=rec, **fwd_kwargs)
+            return ret.recyclables
+
+        # prologue: pass 1 from scratch, then r-1 recycled passes — all
+        # outside the grad trace (recycling trains with stopped gradients,
+        # matching the model's own stop_gradient on Recyclables). The
+        # whole prologue sits under the r>0 cond so r==0 steps (1 in
+        # max_recycles+1) skip it entirely; the false branch's zero
+        # Recyclables are never consumed (the loss cond discards them).
+        rec_shapes = jax.eval_shape(lambda: one_pass(None))
+
+        def prologue(_):
+            return jax.lax.fori_loop(
+                0, jnp.maximum(r - 1, 0), lambda _, c: one_pass(c),
+                one_pass(None))
+
+        rec = jax.lax.cond(
+            r > 0, prologue,
+            lambda _: jax.tree.map(
+                lambda sd: jnp.zeros(sd.shape, sd.dtype), rec_shapes),
+            None)
+
+        def loss_fn(params):
+            return jax.lax.cond(
+                r > 0,
+                lambda _: compute_loss(model, params, batch, rng,
+                                       train=True, recyclables=rec),
+                lambda _: compute_loss(model, params, batch, rng,
+                                       train=True),
+                None)
+
+        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+        metrics["recycles"] = r.astype(jnp.float32)
         new_state = state.apply_gradients(grads=grads).replace(rng=new_rng)
         return new_state, metrics
 

@@ -182,8 +182,18 @@ def _child_main() -> int:
     # rounding converts, so bf16 is pure overhead off-TPU: 18.0 vs 13.6
     # s/iter at the mid config — see the _CPU_XLA_FLAGS comment).
     dtype = jnp.dtype(os.environ.get("BENCH_DTYPE", "bfloat16"))
+    # Shallow trunks unroll without scan+remat: the remat recompute (~1
+    # extra trunk forward in the backward) costs more than the activation
+    # memory it saves on a 16 GB chip — measured on the v5e at the full
+    # config: 92.4 ms (scan+remat) -> 75.9 ms unrolled (MFU 0.158 ->
+    # 0.193). Deep trunks (the depth-48 flagship) need scan+remat to fit.
+    # BENCH_SCAN=1/0 overrides.
+    if os.environ.get("BENCH_SCAN") in ("0", "1"):
+        use_scan = os.environ.get("BENCH_SCAN") == "1"
+    else:
+        use_scan = cfg["depth"] > 4
     model = Alphafold2(dim=cfg["dim"], depth=cfg["depth"], heads=8,
-                       dim_head=64, dtype=dtype)
+                       dim_head=64, dtype=dtype, use_scan=use_scan)
     batch = synthetic_batch(jax.random.PRNGKey(0), batch=B,
                             seq_len=cfg["seq_len"], msa_depth=MSA,
                             with_coords=True)
@@ -242,6 +252,7 @@ def _child_main() -> int:
         "matmul": matmul,
         "platform": platform,
         "dtype": dtype.name,
+        "use_scan": use_scan,
         "warmup": cfg["warmup"],
         "iters": cfg["iters"],
         "tflops": tflops,
