@@ -37,6 +37,11 @@ def main(argv=None):
     ap.add_argument("--structure-module", default=None,
                     choices=["ipa", "egnn", "en", "se3"])
     ap.add_argument("--refinement-iters", type=int, default=None)
+    ap.add_argument("--refinement", default=None,
+                    choices=["residue", "egnn-atom"],
+                    help="what --refinement-iters refines: the CA trace "
+                         "(residue) or the 14-atom covalent graph "
+                         "(egnn-atom, the notebook's atom-level mode)")
     ap.add_argument("--reversible", action="store_const", const=True,
                     default=None)
     ap.add_argument("--log", default=None)
@@ -55,6 +60,8 @@ def main(argv=None):
         exp.model.structure_module_type = args.structure_module
     if args.refinement_iters is not None:
         exp.model.structure_module_refinement_iters = args.refinement_iters
+    if args.refinement is not None:
+        exp.model.structure_module_refinement = args.refinement
     if args.reversible is not None:
         exp.model.reversible = args.reversible
     if args.steps is not None:
