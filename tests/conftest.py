@@ -37,10 +37,16 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "float32")
 
 # persistent compilation cache: the suite is compile-dominated (many tiny
-# model configs); caching across runs cuts wall-clock dramatically
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_af2tpu")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# model configs); caching across runs cuts wall-clock dramatically.
+# Configured via __graft_entry__._enable_compile_cache so the dir is
+# NAMESPACED per platform/flags — a flat dir shared with the tunnel TPU
+# clients produced entries whose deserialization segfaulted the CPU
+# client mid-suite (r05). jax_platforms is already forced to "cpu" above,
+# so the namespace key is correct here.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import __graft_entry__  # noqa: E402
+
+__graft_entry__._enable_compile_cache()
 
 
 def perturb_params(params, key, scale=0.05):
