@@ -24,6 +24,7 @@ sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
 os.environ.pop("PYTHONPATH", None)
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 # Under a bare `python -m pytest tests` the axon sitecustomize hook has
 # ALREADY imported jax at interpreter start (PYTHONPATH=/root/.axon_site),
@@ -64,3 +65,14 @@ def pytest_configure(config):
         "markers",
         "quick: fast smoke tier (one representative test per subsystem, "
         "~4-5 min on 1 CPU core): python -m pytest -m quick")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop in-process compiled executables after each test module: a
+    monolithic 285-test process accumulated compiler state that
+    segfaulted XLA:CPU compiling the pp train step ~57% in (r05, twice:
+    once in cache deserialization, once in backend_compile_and_load).
+    The persistent disk cache keeps recompiles cheap."""
+    yield
+    jax.clear_caches()
