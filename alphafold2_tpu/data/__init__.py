@@ -30,4 +30,8 @@ from alphafold2_tpu.data.sidechainnet import (  # noqa: F401
     corpus_from_pdb,
     load_scn_pickle,
 )
-from alphafold2_tpu.data.synthetic import pad_to, synthetic_batch  # noqa: F401
+from alphafold2_tpu.data.synthetic import (  # noqa: F401
+    pad_to,
+    synthetic_batch,
+    synthetic_requests,
+)

@@ -54,6 +54,40 @@ def synthetic_batch(
     return out
 
 
+def synthetic_requests(
+    rng: jax.Array,
+    num: int = 32,
+    lengths=(24, 48, 96),
+    msa_depth: int = 3,
+    deadline_s=None,
+    priority_levels: int = 1,
+):
+    """Random mixed-length `serve.FoldRequest`s for load tests.
+
+    Lengths cycle through `lengths` (deterministic coverage of every
+    bucket regardless of `num`); tokens are random as in
+    synthetic_batch. msa_depth=0 emits MSA-free requests.
+    """
+    import numpy as np
+
+    from alphafold2_tpu.serve.request import FoldRequest  # lazy: no cycle
+
+    requests = []
+    for i in range(num):
+        k_seq, k_msa, rng = jax.random.split(rng, 3)
+        n = int(lengths[i % len(lengths)])
+        seq = np.asarray(jax.random.randint(
+            k_seq, (n,), 0, constants.NUM_AMINO_ACIDS))
+        msa = None
+        if msa_depth > 0:
+            msa = np.asarray(jax.random.randint(
+                k_msa, (msa_depth, n), 0, constants.NUM_AMINO_ACIDS))
+        requests.append(FoldRequest(
+            seq=seq, msa=msa, deadline_s=deadline_s,
+            priority=i % max(priority_levels, 1)))
+    return requests
+
+
 def pad_to(x: jnp.ndarray, target_len: int, axis: int = 1,
            value: float = 0) -> jnp.ndarray:
     """Pad one axis to a fixed crop size (static-shape discipline)."""

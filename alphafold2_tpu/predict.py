@@ -80,15 +80,34 @@ def fold(
     return FoldResult(coords, conf, distance, recyclables)
 
 
-def fold_and_write(model, params, seq, out_path: str, **kwargs) -> str:
+def fold_and_write(model, params, seq, out_path: str, **kwargs) -> list:
     """fold() + PDB output of the CA trace (data/pdb_io.coords2pdb).
-    Single-structure only; fold batches yourself and write per element."""
+
+    Folds the whole (b, n) batch in ONE forward pass and writes one PDB
+    per batch element: `out_path` for a batch of 1, `<stem>_k<ext>` for
+    element k otherwise. Returns the list of written paths (length b).
+    Pass `mask` to trim per-element padding from the written trace.
+    """
+    import os
+
     import numpy as np
 
     from alphafold2_tpu.data.pdb_io import coords2pdb
 
-    assert seq.shape[0] == 1, \
-        "fold_and_write writes one structure; pass a batch of 1"
     result = fold(model, params, seq, **kwargs)
-    return coords2pdb(np.asarray(seq[0]), np.asarray(result.coords[0]),
-                      name=out_path)
+    seq_np = np.asarray(seq)
+    coords_np = np.asarray(result.coords)
+    mask = kwargs.get("mask")
+    mask_np = None if mask is None else np.asarray(mask)
+
+    b = seq_np.shape[0]
+    stem, ext = os.path.splitext(out_path)
+    ext = ext or ".pdb"
+    paths = []
+    for k in range(b):
+        path = out_path if b == 1 else f"{stem}_{k}{ext}"
+        idx = (slice(None) if mask_np is None
+               else np.flatnonzero(mask_np[k]))
+        paths.append(coords2pdb(seq_np[k][idx], coords_np[k][idx],
+                                name=path))
+    return paths

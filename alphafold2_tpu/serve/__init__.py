@@ -1,0 +1,29 @@
+"""alphafold2_tpu.serve — length-bucketed batching inference server.
+
+The serving stack, bottom-up:
+
+- request:   FoldRequest/FoldResponse/FoldTicket — ragged in, exact out
+- bucketing: BucketPolicy — ragged lengths onto a closed shape set
+- executor:  FoldExecutor — LRU cache of compiled fold executables
+- scheduler: Scheduler — dynamic batching, deadlines, backpressure
+- metrics:   ServeMetrics — counters, padding waste, latency tails, JSONL
+
+Minimal use (see README "Serving"):
+
+    from alphafold2_tpu import serve
+    executor = serve.FoldExecutor(model, params)
+    sched = serve.Scheduler(executor, serve.BucketPolicy((64, 128, 256)),
+                            serve.SchedulerConfig(msa_depth=5))
+    with sched:
+        sched.warmup()
+        ticket = sched.submit(serve.FoldRequest(seq_tokens, msa=msa_tokens))
+        response = ticket.result(timeout=120)
+"""
+
+from alphafold2_tpu.serve.bucketing import BucketPolicy, default_policy  # noqa: F401
+from alphafold2_tpu.serve.executor import FoldExecutor  # noqa: F401
+from alphafold2_tpu.serve.metrics import ServeMetrics  # noqa: F401
+from alphafold2_tpu.serve.request import (FoldRequest, FoldResponse,  # noqa: F401
+                                          FoldTicket)
+from alphafold2_tpu.serve.scheduler import (QueueFullError, Scheduler,  # noqa: F401
+                                            SchedulerConfig)

@@ -1,0 +1,143 @@
+"""Length-bucket policy: map ragged requests onto a closed set of shapes.
+
+On TPU every distinct input shape is a distinct XLA compile (and a
+distinct executable resident in HBM), so the server quantizes sequence
+length to a small set of bucket edges — powers of two by default, or
+config-driven for a known length distribution (FastFold's insight:
+matching work shape to the accelerator is where serving throughput
+lives). The trade is padding waste vs compile count: finer edges waste
+fewer pad tokens per fold but compile (and cache) more executables.
+
+`assemble()` turns a list of same-bucket requests into one fixed-shape
+batch — the vectorized host-side form of `data.pad_to` + masks (one
+zero-filled buffer and one device transfer per tensor; this runs on the
+scheduler worker between every batch) — padding the batch axis too so
+that a bucket always presents exactly one (batch, len) signature. Pass
+`msa_depth` to pin the MSA axis as well: without it the batch's depth
+is max over its members, and ragged-depth traffic would mint a fresh
+compiled shape per observed depth.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from alphafold2_tpu.serve.request import FoldRequest
+
+
+class BucketPolicy:
+    """Sorted ascending bucket edges; a request of length n maps to the
+    smallest edge >= n."""
+
+    def __init__(self, edges: Sequence[int]):
+        edges = sorted(set(int(e) for e in edges))
+        if not edges or edges[0] <= 0:
+            raise ValueError(f"bucket edges must be positive, got {edges}")
+        self.edges: Tuple[int, ...] = tuple(edges)
+
+    @classmethod
+    def powers_of_two(cls, min_len: int = 32,
+                      max_len: int = 512) -> "BucketPolicy":
+        edges = []
+        e = 1
+        while e < max_len:
+            e *= 2
+            if e >= min_len:
+                edges.append(min(e, max_len))
+        if max_len not in edges:
+            edges.append(max_len)
+        return cls(edges)
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.edges)
+
+    @property
+    def max_len(self) -> int:
+        return self.edges[-1]
+
+    def bucket_for(self, length: int) -> int:
+        """Deterministic: same length always lands on the same edge."""
+        if length <= 0:
+            raise ValueError(f"length must be positive, got {length}")
+        for e in self.edges:
+            if length <= e:
+                return e
+        raise ValueError(
+            f"length {length} exceeds max bucket {self.edges[-1]}; "
+            "add a larger edge or reject upstream")
+
+    def assemble(
+        self,
+        requests: List[FoldRequest],
+        bucket_len: int,
+        batch_size: int,
+        msa_depth: Optional[int] = None,
+    ) -> Tuple[dict, float]:
+        """Pad `requests` (all <= bucket_len) into one fixed-shape batch.
+
+        Returns (batch, padding_waste) where batch has seq (B, L),
+        mask (B, L), and msa/msa_mask (B, M, L); padding_waste is the
+        fraction of the (B, L) token grid that is padding (batch-fill
+        rows count as waste — they are real FLOPs spent on nothing).
+
+        msa_depth=None infers M as the max depth over the requests (no
+        MSA tensor when none carry one) — fine for uniform-depth
+        traffic, but every distinct observed depth is a distinct
+        compiled shape. Pinning msa_depth keeps the shape set closed:
+        shallower MSAs are zero-padded+masked, deeper ones keep their
+        FIRST msa_depth rows (the query-first convention
+        `featurize.subsample_msa` maintains); msa_depth=0 forces the
+        MSA-free signature.
+        """
+        if not requests:
+            raise ValueError("assemble() needs at least one request")
+        if len(requests) > batch_size:
+            raise ValueError(
+                f"{len(requests)} requests > batch_size {batch_size}")
+        lengths = [r.length for r in requests]
+        if max(lengths) > bucket_len:
+            raise ValueError(
+                f"request length {max(lengths)} > bucket_len {bucket_len}")
+
+        seq = np.zeros((batch_size, bucket_len), np.int32)
+        mask = np.zeros((batch_size, bucket_len), bool)
+        for i, r in enumerate(requests):
+            seq[i, : r.length] = r.seq
+            mask[i, : r.length] = True
+        batch = {"seq": jnp.asarray(seq), "mask": jnp.asarray(mask),
+                 "msa": None, "msa_mask": None}
+
+        depth = msa_depth
+        if depth is None:
+            depths = [r.msa.shape[0] for r in requests
+                      if r.msa is not None]
+            depth = max(depths) if depths else 0
+        if depth > 0:
+            msa = np.zeros((batch_size, depth, bucket_len), np.int32)
+            msa_mask = np.zeros((batch_size, depth, bucket_len), bool)
+            for i, r in enumerate(requests):
+                if r.msa is not None:
+                    m = min(r.msa.shape[0], depth)
+                    n = r.msa.shape[1]
+                    msa[i, :m, :n] = r.msa[:m]
+                    msa_mask[i, :m, :n] = True
+            batch["msa"] = jnp.asarray(msa)
+            batch["msa_mask"] = jnp.asarray(msa_mask)
+
+        real = sum(lengths)
+        waste = 1.0 - real / float(batch_size * bucket_len)
+        return batch, waste
+
+
+def msa_depth_of(batch: dict) -> int:
+    """Shape-key helper: 0 when the batch carries no MSA."""
+    return 0 if batch.get("msa") is None else int(batch["msa"].shape[1])
+
+
+def default_policy(max_len: Optional[int] = None) -> BucketPolicy:
+    """The serving default: powers of two from 32 up to max_len (512)."""
+    return BucketPolicy.powers_of_two(32, max_len or 512)

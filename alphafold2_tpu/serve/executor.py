@@ -1,0 +1,115 @@
+"""Compiled-executable cache around `predict.fold`.
+
+One `jax.jit` *instance* per (bucket_len, batch_size, msa_depth,
+num_recycles) key: because the scheduler feeds each key exactly one
+shape signature, each instance holds exactly one compiled executable,
+so LRU-evicting a key actually frees its executable (a single shared
+jit fn would pin every shape it ever saw in its internal cache — no
+eviction handle). On TPU the executables for big buckets are HBM-heavy;
+`max_entries` bounds the resident set and `warmup()` pre-pays compiles
+before traffic arrives instead of on the first unlucky request.
+
+`stats()` exposes hits/misses/evictions; misses == distinct XLA
+compilations triggered through this executor, the number the e2e test
+pins to the bucket count.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu.predict import FoldResult, fold
+from alphafold2_tpu.serve.bucketing import msa_depth_of
+
+# (bucket_len, batch_size, msa_depth, num_recycles)
+ExecKey = Tuple[int, int, int, int]
+
+
+class FoldExecutor:
+    """LRU cache of jitted fold executables, keyed by shape signature."""
+
+    def __init__(self, model, params, max_entries: int = 8):
+        assert model.predict_coords, "serving needs predict_coords=True"
+        self.model = model
+        self.params = params
+        self.max_entries = max(1, int(max_entries))
+        self._cache: "OrderedDict[ExecKey, callable]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def _build(self, num_recycles: int):
+        def run(params, seq, mask, msa, msa_mask) -> FoldResult:
+            return fold(self.model, params, seq, msa=msa, mask=mask,
+                        msa_mask=msa_mask, num_recycles=num_recycles)
+
+        return jax.jit(run)
+
+    def _get(self, key: ExecKey):
+        with self._lock:
+            fn = self._cache.get(key)
+            if fn is not None:
+                self.hits += 1
+                self._cache.move_to_end(key)
+                return fn
+            self.misses += 1
+            fn = self._build(key[3])
+            self._cache[key] = fn
+            while len(self._cache) > self.max_entries:
+                self._cache.popitem(last=False)
+                self.evictions += 1
+            return fn
+
+    def key_for(self, batch: dict, num_recycles: int) -> ExecKey:
+        b, n = batch["seq"].shape
+        return (int(n), int(b), msa_depth_of(batch), int(num_recycles))
+
+    def run(self, batch: dict, num_recycles: int) -> FoldResult:
+        """Fold one assembled batch; blocks until device results land so
+        the caller's latency measurement is honest."""
+        fn = self._get(self.key_for(batch, num_recycles))
+        result = fn(self.params, batch["seq"], batch["mask"], batch["msa"],
+                    batch["msa_mask"])
+        return jax.block_until_ready(result)
+
+    def warmup(self, keys: Iterable[ExecKey],
+               timer=None) -> int:
+        """Compile (and discard) each (len, batch, msa_depth, recycles)
+        signature with a zero batch. Returns the number of fresh
+        compiles. Optional `timer` is a profiling.StepTimer measuring
+        each warmup (== compile+first-run) wall time."""
+        fresh = 0
+        for key in keys:
+            bucket_len, batch_size, msa_depth, num_recycles = key
+            before = self.misses
+            batch = {
+                "seq": jnp.zeros((batch_size, bucket_len), jnp.int32),
+                "mask": jnp.zeros((batch_size, bucket_len), bool),
+                "msa": None, "msa_mask": None,
+            }
+            if msa_depth:
+                batch["msa"] = jnp.zeros(
+                    (batch_size, msa_depth, bucket_len), jnp.int32)
+                batch["msa_mask"] = jnp.zeros(
+                    (batch_size, msa_depth, bucket_len), bool)
+            if timer is not None:
+                with timer.measure():
+                    self.run(batch, num_recycles)
+            else:
+                self.run(batch, num_recycles)
+            fresh += self.misses - before
+        return fresh
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions,
+                    "resident": len(self._cache),
+                    "max_entries": self.max_entries,
+                    "keys": list(self._cache.keys())}

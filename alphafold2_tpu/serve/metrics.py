@@ -1,0 +1,135 @@
+"""Serving metrics: counters, queue depth, padding waste, latency tails.
+
+Reuses `utils.logging.MetricsLogger` for the JSONL sink (one record per
+executed batch — queue depth, padding waste, and the current per-bucket
+p50/p90/p99 latency) and `utils.profiling.percentile` for the tail
+stats, so bench and serving report through one stats path. `snapshot()`
+is the health-check view: O(1)-ish, lock-consistent, JSON-serializable.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from alphafold2_tpu.utils.logging import MetricsLogger
+from alphafold2_tpu.utils.profiling import percentile
+
+
+class ServeMetrics:
+    """Thread-safe serving counters + JSONL emission."""
+
+    def __init__(self, jsonl_path: Optional[str] = None,
+                 stdout: bool = False, max_latencies_per_bucket: int = 4096):
+        self._logger = MetricsLogger(jsonl_path, stdout=stdout) \
+            if (jsonl_path or stdout) else None
+        self._lock = threading.Lock()
+        self._max_lat = max_latencies_per_bucket
+        self.enqueued = 0
+        self.served = 0
+        self.shed = 0
+        self.errors = 0
+        self.cancelled = 0
+        self.rejected = 0           # backpressure: submit refused
+        self.batches = 0
+        self.queue_depth = 0
+        self._real_tokens = 0
+        self._padded_tokens = 0
+        # per-bucket latency reservoirs (seconds, request-level)
+        self._latencies: Dict[int, List[float]] = defaultdict(list)
+
+    # -- recording -------------------------------------------------------
+
+    def record_enqueued(self, queue_depth: int):
+        with self._lock:
+            self.enqueued += 1
+            self.queue_depth = queue_depth
+
+    def record_rejected(self):
+        with self._lock:
+            self.rejected += 1
+
+    def record_shed(self, n: int = 1):
+        with self._lock:
+            self.shed += n
+
+    def record_error(self, n: int = 1):
+        with self._lock:
+            self.errors += n
+
+    def record_cancelled(self, n: int = 1):
+        with self._lock:
+            self.cancelled += n
+
+    def record_served(self, bucket_len: int, latency_s: float):
+        with self._lock:
+            self.served += 1
+            lats = self._latencies[bucket_len]
+            lats.append(latency_s)
+            if len(lats) > self._max_lat:
+                del lats[: len(lats) - self._max_lat]
+
+    def record_batch(self, bucket_len: int, batch_size: int, n_real: int,
+                     real_tokens: int, padding_waste: float,
+                     batch_latency_s: float, queue_depth: int):
+        """One executed batch; emits the JSONL record."""
+        with self._lock:
+            self.batches += 1
+            self.queue_depth = queue_depth
+            self._real_tokens += real_tokens
+            self._padded_tokens += batch_size * bucket_len
+            lats = self._latencies[bucket_len]
+            record = dict(
+                bucket_len=bucket_len,
+                batch_size=batch_size,
+                n_real=n_real,
+                queue_depth=queue_depth,
+                padding_waste=padding_waste,
+                batch_latency_s=batch_latency_s,
+                p50_latency_s=percentile(lats, 50),
+                p90_latency_s=percentile(lats, 90),
+                p99_latency_s=percentile(lats, 99),
+            )
+            step = self.batches
+            logger = self._logger
+        if logger is not None:
+            logger.log(step=step, **record)
+
+    # -- views -----------------------------------------------------------
+
+    def padding_waste_fraction(self) -> float:
+        with self._lock:
+            if self._padded_tokens == 0:
+                return 0.0
+            return 1.0 - self._real_tokens / float(self._padded_tokens)
+
+    def snapshot(self) -> dict:
+        """Health-check view: counters + per-bucket latency tails."""
+        with self._lock:
+            per_bucket = {
+                str(b): {"count": len(lats),
+                         "p50_s": percentile(lats, 50),
+                         "p90_s": percentile(lats, 90),
+                         "p99_s": percentile(lats, 99)}
+                for b, lats in sorted(self._latencies.items())
+            }
+            padded = self._padded_tokens
+            waste = (1.0 - self._real_tokens / float(padded)) if padded \
+                else 0.0
+            return {
+                "enqueued": self.enqueued,
+                "served": self.served,
+                "shed": self.shed,
+                "errors": self.errors,
+                "cancelled": self.cancelled,
+                "rejected": self.rejected,
+                "batches": self.batches,
+                "queue_depth": self.queue_depth,
+                "padding_waste": waste,
+                "latency_by_bucket": per_bucket,
+            }
+
+    def close(self):
+        if self._logger is not None:
+            self._logger.close()

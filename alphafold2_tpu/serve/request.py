@@ -1,0 +1,105 @@
+"""Serving request/response types.
+
+The unit of work for `serve.Scheduler` is one sequence (plus optional
+MSA), not a padded batch: batching, padding, and shape selection are the
+server's job (bucketing.py / scheduler.py), so callers submit ragged
+requests and get back exact-length results. Deadlines are wall-relative
+at submit time and enforced by the scheduler (expired requests are shed,
+not folded — ParaFold-style load shedding beats folding dead work).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+_req_counter = itertools.count()
+
+
+def _next_request_id() -> str:
+    return f"req-{next(_req_counter)}"
+
+
+@dataclass
+class FoldRequest:
+    """One fold job: a token sequence, optional MSA, QoS knobs.
+
+    seq: (n,) int tokens (featurize.tokenize output).
+    msa: optional (m, n) int tokens; rows beyond the scheduler's view are
+        padded/masked per batch, never truncated.
+    priority: higher folds first when a batch is formed from a backlog.
+    deadline_s: wall-clock budget from submit; past it the request is
+        shed with status "shed" instead of occupying accelerator time.
+    """
+
+    seq: np.ndarray
+    msa: Optional[np.ndarray] = None
+    request_id: str = field(default_factory=_next_request_id)
+    priority: int = 0
+    deadline_s: Optional[float] = None
+
+    def __post_init__(self):
+        self.seq = np.asarray(self.seq, dtype=np.int32)
+        if self.seq.ndim != 1:
+            raise ValueError(
+                f"FoldRequest.seq must be 1-D (n,), got {self.seq.shape}; "
+                "the scheduler owns batching")
+        if self.msa is not None:
+            self.msa = np.asarray(self.msa, dtype=np.int32)
+            if self.msa.ndim != 2 or self.msa.shape[1] != self.seq.shape[0]:
+                raise ValueError(
+                    f"FoldRequest.msa must be (m, {self.seq.shape[0]}), "
+                    f"got {self.msa.shape}")
+
+    @property
+    def length(self) -> int:
+        return int(self.seq.shape[0])
+
+
+@dataclass
+class FoldResponse:
+    """Result of one FoldRequest, unpadded back to the request length.
+
+    status: "ok" | "shed" (deadline expired before folding) |
+            "error" (executor raised; see .error) |
+            "cancelled" (scheduler stopped without draining).
+    """
+
+    request_id: str
+    status: str
+    coords: Optional[np.ndarray] = None       # (n, 3) CA trace
+    confidence: Optional[np.ndarray] = None   # (n,) in [0, 1]
+    bucket_len: Optional[int] = None
+    latency_s: Optional[float] = None
+    error: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok"
+
+
+class FoldTicket:
+    """Future handed back by Scheduler.submit(); resolves to FoldResponse."""
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        self._event = threading.Event()
+        self._response: Optional[FoldResponse] = None
+
+    def _resolve(self, response: FoldResponse):
+        self._response = response
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> FoldResponse:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"FoldTicket.result timed out for {self.request_id}")
+        assert self._response is not None
+        return self._response

@@ -1,0 +1,346 @@
+"""Thread-based dynamic batcher: the serving front door.
+
+Requests enqueue from any thread; one worker drains them into per-bucket
+batches under a `max_batch_size` / `max_wait_ms` policy (ParaFold-style:
+throughput comes from scheduling, not the model). Three QoS behaviors:
+
+- deadline shedding: a request whose deadline expires while queued is
+  resolved `status="shed"` without touching the accelerator — folding
+  dead work is the most expensive way to miss a deadline;
+- bounded-queue backpressure: `queue_limit` caps in-flight requests;
+  `full_policy="reject"` raises QueueFullError at submit (shed at the
+  door), `"block"` makes submit wait for capacity;
+- priority: when a backlog exceeds one batch, higher-priority requests
+  fold first (FIFO within a priority level).
+
+Batches are always padded to `max_batch_size` (bucketing.assemble), so
+the compiled-shape set is closed: one executable per (bucket,
+num_recycles), never one per observed batch size. The scheduler/executor
+seam is deliberate — a later multi-chip server replaces FoldExecutor
+with a `parallel.mesh`-sharded one and this file does not change.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from alphafold2_tpu.serve.bucketing import BucketPolicy
+from alphafold2_tpu.serve.executor import FoldExecutor
+from alphafold2_tpu.serve.metrics import ServeMetrics
+from alphafold2_tpu.serve.request import (FoldRequest, FoldResponse,
+                                          FoldTicket)
+
+
+class QueueFullError(RuntimeError):
+    """submit() refused: queue at queue_limit and full_policy='reject'."""
+
+
+@dataclass
+class SchedulerConfig:
+    max_batch_size: int = 4
+    max_wait_ms: float = 50.0      # oldest request age that forces a batch
+    queue_limit: int = 256         # in-flight cap (queued, not yet folded)
+    num_recycles: int = 1
+    full_policy: str = "reject"    # "reject" | "block"
+    poll_ms: float = 5.0           # worker wakeup granularity
+    # Serving MSA depth. None = per-batch max over members — ONLY safe
+    # when every request carries the same depth; ragged-depth traffic
+    # then mints one compiled shape per observed depth and defeats the
+    # closed-shape guarantee. Pin it (bucketing.assemble semantics:
+    # pad shallow, keep the first msa_depth rows of deeper MSAs) for
+    # production traffic; 0 serves MSA-free.
+    msa_depth: Optional[int] = None
+
+    def __post_init__(self):
+        if self.full_policy not in ("reject", "block"):
+            raise ValueError(f"full_policy must be 'reject' or 'block', "
+                             f"got {self.full_policy!r}")
+        if self.max_batch_size < 1 or self.queue_limit < 1:
+            raise ValueError("max_batch_size and queue_limit must be >= 1")
+
+
+class _Entry:
+    __slots__ = ("request", "ticket", "bucket_len", "enqueued_at",
+                 "deadline")
+
+    def __init__(self, request: FoldRequest, bucket_len: int):
+        self.request = request
+        self.ticket = FoldTicket(request.request_id)
+        self.bucket_len = bucket_len
+        self.enqueued_at = time.monotonic()
+        self.deadline = (None if request.deadline_s is None
+                         else self.enqueued_at + request.deadline_s)
+
+
+class Scheduler:
+    """Dynamic batching fold server over one FoldExecutor."""
+
+    def __init__(self, executor: FoldExecutor, buckets: BucketPolicy,
+                 config: Optional[SchedulerConfig] = None,
+                 metrics: Optional[ServeMetrics] = None):
+        self.executor = executor
+        self.buckets = buckets
+        self.config = config or SchedulerConfig()
+        self.metrics = metrics or ServeMetrics()
+        self._cond = threading.Condition()
+        self._incoming: deque = deque()
+        self._pending: Dict[int, List[_Entry]] = {}
+        self._depth = 0            # incoming + pending, guarded by _cond
+        self._running = False
+        self._drain = True
+        self._worker: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "Scheduler":
+        with self._cond:
+            if self._running:
+                return self
+            self._running = True
+            self._drain = True
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-scheduler")
+        self._worker.start()
+        return self
+
+    def stop(self, drain: bool = True):
+        """Stop the worker. drain=True folds everything already queued
+        (expired deadlines still shed); drain=False resolves queued
+        requests as status='cancelled'."""
+        with self._cond:
+            self._running = False
+            self._drain = drain
+            self._cond.notify_all()
+        if self._worker is not None:
+            self._worker.join()
+            self._worker = None
+
+    def __enter__(self) -> "Scheduler":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def warmup(self, msa_depth: Optional[int] = None) -> int:
+        """Precompile every bucket at the serving signature so the first
+        real request pays queueing, not XLA. Returns fresh compiles.
+        Defaults to the config's pinned msa_depth; the guarantee only
+        holds when serving shapes are pinned to match (config.msa_depth,
+        or uniform-depth traffic equal to this depth)."""
+        if msa_depth is None:
+            msa_depth = self.config.msa_depth or 0
+        keys = [(edge, self.config.max_batch_size, msa_depth,
+                 self.config.num_recycles) for edge in self.buckets.edges]
+        return self.executor.warmup(keys)
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, request: FoldRequest) -> FoldTicket:
+        bucket_len = self.buckets.bucket_for(request.length)  # fail fast
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("Scheduler.submit() before start()")
+            while self._depth >= self.config.queue_limit:
+                if self.config.full_policy == "reject":
+                    self.metrics.record_rejected()
+                    raise QueueFullError(
+                        f"queue at limit {self.config.queue_limit}")
+                self._cond.wait()
+                if not self._running:
+                    raise RuntimeError("Scheduler stopped while blocked "
+                                       "on a full queue")
+            entry = _Entry(request, bucket_len)
+            self._incoming.append(entry)
+            self._depth += 1
+            depth = self._depth
+            self._cond.notify_all()
+        self.metrics.record_enqueued(depth)
+        return entry.ticket
+
+    def serve_stats(self) -> dict:
+        """Health-check snapshot: serving counters + executor cache."""
+        stats = self.metrics.snapshot()
+        stats["executor"] = self.executor.stats()
+        stats["bucket_edges"] = list(self.buckets.edges)
+        with self._cond:
+            stats["running"] = self._running
+        return stats
+
+    # -- worker ----------------------------------------------------------
+
+    def _run(self):
+        try:
+            self._run_inner()
+        except Exception as exc:   # worker must never die silently:
+            self._fail_outstanding(repr(exc))
+            return
+        if not self._drain:
+            self._cancel_remaining()
+
+    def _run_inner(self):
+        poll_s = self.config.poll_ms / 1000.0
+        just_executed = False   # a ready batch may already be waiting
+        while True:
+            with self._cond:
+                if not just_executed and not self._incoming \
+                        and self._running:
+                    # timed wait only while entries pend (max_wait_ms /
+                    # deadline bookkeeping needs the clock); a fully
+                    # idle scheduler parks until submit()/stop() notify
+                    if any(self._pending.values()):
+                        self._cond.wait(timeout=poll_s)
+                    else:
+                        self._cond.wait()
+                while self._incoming:
+                    entry = self._incoming.popleft()
+                    self._pending.setdefault(entry.bucket_len,
+                                             []).append(entry)
+                stopping = not self._running
+                drain = self._drain
+            if stopping and not drain:
+                break
+            self._shed_expired()
+            batch = self._form_batch(stopping)
+            just_executed = batch is not None
+            if batch is not None:
+                self._execute(*batch)
+                continue
+            if stopping:
+                with self._cond:
+                    if self._incoming or any(self._pending.values()):
+                        continue
+                break
+
+    def _resolve_removed(self, entries: List[_Entry]):
+        """Entries left the queue: update depth, wake blocked submitters."""
+        if not entries:
+            return
+        with self._cond:
+            self._depth -= len(entries)
+            self._cond.notify_all()
+
+    def _shed_expired(self):
+        now = time.monotonic()
+        shed: List[_Entry] = []
+        for bucket_len, entries in self._pending.items():
+            keep = []
+            for e in entries:
+                if e.deadline is not None and now > e.deadline:
+                    shed.append(e)
+                else:
+                    keep.append(e)
+            self._pending[bucket_len] = keep
+        self._resolve_removed(shed)
+        for e in shed:
+            self.metrics.record_shed()
+            e.ticket._resolve(FoldResponse(
+                request_id=e.request.request_id, status="shed",
+                bucket_len=e.bucket_len,
+                latency_s=now - e.enqueued_at,
+                error="deadline expired before folding"))
+
+    def _form_batch(self, stopping: bool):
+        """Pick the bucket whose oldest entry has waited longest, if any
+        bucket is ready (full batch, max_wait exceeded, or draining)."""
+        cfg = self.config
+        now = time.monotonic()
+        best = None
+        for bucket_len, entries in self._pending.items():
+            if not entries:
+                continue
+            oldest = min(e.enqueued_at for e in entries)
+            ready = (len(entries) >= cfg.max_batch_size
+                     or (now - oldest) * 1000.0 >= cfg.max_wait_ms
+                     or stopping)
+            if ready and (best is None or oldest < best[1]):
+                best = (bucket_len, oldest)
+        if best is None:
+            return None
+        bucket_len = best[0]
+        entries = self._pending[bucket_len]
+        # higher priority folds first; FIFO within a priority level
+        entries.sort(key=lambda e: (-e.request.priority, e.enqueued_at))
+        take = entries[:cfg.max_batch_size]
+        self._pending[bucket_len] = entries[cfg.max_batch_size:]
+        self._resolve_removed(take)
+        return bucket_len, take
+
+    def _execute(self, bucket_len: int, entries: List[_Entry]):
+        cfg = self.config
+        t0 = time.monotonic()
+        # the whole assemble -> run -> device-fetch window is guarded:
+        # entries already left the queue, so an unresolved exception here
+        # would orphan their tickets forever (resolve as error instead)
+        try:
+            batch, waste = self.buckets.assemble(
+                [e.request for e in entries], bucket_len,
+                cfg.max_batch_size, msa_depth=cfg.msa_depth)
+            result = self.executor.run(batch, cfg.num_recycles)
+            coords = np.asarray(result.coords)
+            confidence = np.asarray(result.confidence)
+        except Exception as exc:  # resolve, never kill the worker
+            self.metrics.record_error(len(entries))
+            for e in entries:
+                e.ticket._resolve(FoldResponse(
+                    request_id=e.request.request_id, status="error",
+                    bucket_len=bucket_len, error=repr(exc)))
+            return
+        now = time.monotonic()
+        real_tokens = 0
+        for i, e in enumerate(entries):
+            n = e.request.length
+            real_tokens += n
+            latency = now - e.enqueued_at
+            self.metrics.record_served(bucket_len, latency)
+            e.ticket._resolve(FoldResponse(
+                request_id=e.request.request_id, status="ok",
+                # copy: a view would pin the whole padded batch in the
+                # caller's hands for the lifetime of the response
+                coords=coords[i, :n].copy(),
+                confidence=confidence[i, :n].copy(),
+                bucket_len=bucket_len, latency_s=latency))
+        with self._cond:
+            depth = self._depth
+        self.metrics.record_batch(
+            bucket_len, cfg.max_batch_size, len(entries), real_tokens,
+            waste, now - t0, depth)
+
+    def _drain_all_entries(self) -> List[_Entry]:
+        with self._cond:
+            leftovers = list(self._incoming)
+            self._incoming.clear()
+            for entries in self._pending.values():
+                leftovers.extend(entries)
+            self._pending.clear()
+            self._depth -= len(leftovers)
+            self._cond.notify_all()
+        return leftovers
+
+    def _cancel_remaining(self):
+        leftovers = self._drain_all_entries()
+        self.metrics.record_cancelled(len(leftovers))
+        for e in leftovers:
+            e.ticket._resolve(FoldResponse(
+                request_id=e.request.request_id, status="cancelled",
+                bucket_len=e.bucket_len))
+
+    def _fail_outstanding(self, error: str):
+        """Worker crashed outside executor.run (e.g. the metrics sink):
+        stop accepting work and resolve every outstanding ticket as an
+        error instead of leaving callers blocked forever."""
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        leftovers = self._drain_all_entries()
+        self.metrics.record_error(len(leftovers))
+        for e in leftovers:
+            e.ticket._resolve(FoldResponse(
+                request_id=e.request.request_id, status="error",
+                bucket_len=e.bucket_len,
+                error=f"scheduler worker crashed: {error}"))

@@ -1,2 +1,7 @@
 from alphafold2_tpu.utils.logging import MetricsLogger  # noqa: F401
-from alphafold2_tpu.utils.profiling import StepTimer, annotate, trace  # noqa: F401
+from alphafold2_tpu.utils.profiling import (  # noqa: F401
+    StepTimer,
+    annotate,
+    percentile,
+    trace,
+)

@@ -40,8 +40,13 @@ class MetricsLogger:
                   flush=True)
         return record
 
+    def flush(self):
+        if self._fh is not None:
+            self._fh.flush()
+
     def close(self):
         if self._fh is not None:
+            self._fh.flush()
             self._fh.close()
             self._fh = None
 

@@ -4,8 +4,11 @@ Net-new vs the reference, which has no profiler hooks at all (SURVEY.md
 §5.1 — ad-hoc time.time() in a notebook is all it offers). Step time IS
 the benchmark metric (BASELINE.json), so the timer is first-class:
 
-- `StepTimer`: wall-clock accumulator with mean/p50/min stats, used by
-  `train.fit(step_timer=...)` and bench.py;
+- `percentile`: the one interpolating percentile everything reports
+  through (StepTimer, serve.ServeMetrics, bench) — one stats path, no
+  two subtly-different p99 definitions;
+- `StepTimer`: wall-clock accumulator with mean/p50/p90/p99/min stats,
+  used by `train.fit(step_timer=...)`, bench.py, and serve warmup;
 - `trace`: context manager around `jax.profiler` emitting a TensorBoard-
   loadable trace directory;
 - `annotate`: named-scope annotation that shows up in profiler timelines.
@@ -15,9 +18,17 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 import jax
+
+
+def percentile(values: Sequence[float], q: float) -> float:
+    """Linear-interpolation percentile (numpy's default method) on a
+    possibly-unsorted sequence or array; 0.0 for empty input."""
+    import numpy as np
+
+    return float(np.percentile(values, q)) if len(values) else 0.0
 
 
 class StepTimer:
@@ -54,10 +65,15 @@ class StepTimer:
 
     @property
     def p50(self) -> float:
-        if not self.durations:
-            return 0.0
-        s = sorted(self.durations)
-        return s[len(s) // 2]
+        return percentile(self.durations, 50)
+
+    @property
+    def p90(self) -> float:
+        return percentile(self.durations, 90)
+
+    @property
+    def p99(self) -> float:
+        return percentile(self.durations, 99)
 
     @property
     def best(self) -> float:
@@ -65,7 +81,8 @@ class StepTimer:
 
     def summary(self) -> dict:
         return {"count": self.count, "mean_s": self.mean,
-                "p50_s": self.p50, "best_s": self.best}
+                "p50_s": self.p50, "p90_s": self.p90, "p99_s": self.p99,
+                "best_s": self.best}
 
 
 @contextlib.contextmanager

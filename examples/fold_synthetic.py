@@ -30,7 +30,7 @@ batch = synthetic_batch(jax.random.PRNGKey(0), batch=1, seq_len=48,
 params = model.init(jax.random.PRNGKey(1), batch["seq"], msa=batch["msa"],
                     mask=batch["mask"], msa_mask=batch["msa_mask"])
 
-path = fold_and_write(model, params, batch["seq"], out_path,
-                      msa=batch["msa"], mask=batch["mask"],
-                      msa_mask=batch["msa_mask"], num_recycles=3)
-print(f"wrote {path}")
+paths = fold_and_write(model, params, batch["seq"], out_path,
+                       msa=batch["msa"], mask=batch["mask"],
+                       msa_mask=batch["msa_mask"], num_recycles=3)
+print(f"wrote {paths[0]}")

@@ -340,12 +340,28 @@ class TestPredict:
         model = small_model(predict_coords=True, structure_module_depth=1)
         inp = make_inputs(b=1, n=8, m=3)
         params = model.init(jax.random.PRNGKey(1), **inp)
-        path = fold_and_write(model, params, inp["seq"],
-                              out_path=str(tmp_path / "pred.pdb"),
-                              msa=inp["msa"], mask=inp["mask"],
-                              msa_mask=inp["msa_mask"], num_recycles=1)
-        text = open(path).read()
+        paths = fold_and_write(model, params, inp["seq"],
+                               out_path=str(tmp_path / "pred.pdb"),
+                               msa=inp["msa"], mask=inp["mask"],
+                               msa_mask=inp["msa_mask"], num_recycles=1)
+        assert paths == [str(tmp_path / "pred.pdb")]
+        text = open(paths[0]).read()
         assert text.startswith("ATOM")
+
+    def test_fold_and_write_batched(self, tmp_path):
+        from alphafold2_tpu.predict import fold_and_write
+
+        model = small_model(predict_coords=True, structure_module_depth=1)
+        inp = make_inputs(b=2, n=8, m=3)
+        params = model.init(jax.random.PRNGKey(1), **inp)
+        paths = fold_and_write(model, params, inp["seq"],
+                               out_path=str(tmp_path / "pred.pdb"),
+                               msa=inp["msa"], mask=inp["mask"],
+                               msa_mask=inp["msa_mask"], num_recycles=0)
+        assert paths == [str(tmp_path / "pred_0.pdb"),
+                         str(tmp_path / "pred_1.pdb")]
+        for path in paths:
+            assert open(path).read().startswith("ATOM")
 
 
 class TestEvaluateScript:

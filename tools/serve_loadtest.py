@@ -1,0 +1,194 @@
+"""Offline load-test driver for `alphafold2_tpu.serve`.
+
+Closed-loop harness: `--concurrency` submitter threads each submit a
+synthetic request, wait for its result, and repeat — either for a fixed
+`--requests` count or until `--duration-s` of wall clock. Warmup
+(per-bucket compiles) is timed separately and excluded from throughput,
+so the reported folds/hour is steady-state serving, comparable to
+STATUS.md's raw `predict.fold` numbers — the delta between the two is
+the scheduling + padding overhead this subsystem is supposed to keep
+small.
+
+Prints ONE JSON line:
+  {"folds_per_hour": N, "padding_waste": F, "shed": 0, ...}
+
+`--smoke` (tools/serve_smoke.sh) exits 1 on ANY shed / timeout / error /
+rejected request at trivial load — the serving regression tripwire.
+
+Runs on CPU by default (__graft_entry__.force_cpu_fallback); pass
+--platform ambient to target the real chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=64,
+                    help="total requests (ignored when --duration-s > 0)")
+    ap.add_argument("--duration-s", type=float, default=0.0,
+                    help="run this many seconds instead of a fixed count")
+    ap.add_argument("--concurrency", type=int, default=4,
+                    help="closed-loop submitter threads")
+    ap.add_argument("--lengths", default="24,48,96",
+                    help="comma-separated request lengths (cycled)")
+    ap.add_argument("--buckets", default="",
+                    help="comma-separated bucket edges; default: "
+                         "powers-of-two covering --lengths")
+    ap.add_argument("--msa-depth", type=int, default=3)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--max-wait-ms", type=float, default=25.0)
+    ap.add_argument("--num-recycles", type=int, default=0)
+    ap.add_argument("--deadline-s", type=float, default=0.0,
+                    help="per-request deadline; 0 = none")
+    ap.add_argument("--dim", type=int, default=32)
+    ap.add_argument("--depth", type=int, default=1)
+    ap.add_argument("--metrics-path", default="/tmp/serve_loadtest.jsonl")
+    ap.add_argument("--platform", default="cpu",
+                    choices=("cpu", "ambient"))
+    ap.add_argument("--smoke", action="store_true",
+                    help="exit 1 on any shed/timeout/error/rejection")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    import __graft_entry__
+    if args.platform == "cpu":
+        __graft_entry__.force_cpu_fallback()
+
+    import jax
+    import jax.numpy as jnp
+
+    from alphafold2_tpu import Alphafold2, serve
+    from alphafold2_tpu.data.synthetic import synthetic_requests
+    from alphafold2_tpu.utils.profiling import StepTimer
+
+    lengths = tuple(int(x) for x in args.lengths.split(",") if x)
+    if args.buckets:
+        policy = serve.BucketPolicy(
+            int(x) for x in args.buckets.split(",") if x)
+    else:
+        policy = serve.BucketPolicy.powers_of_two(
+            min(lengths), max(max(lengths), min(lengths)))
+
+    model = Alphafold2(dim=args.dim, depth=args.depth, heads=2,
+                       dim_head=16, predict_coords=True,
+                       structure_module_depth=1)
+    n0 = policy.edges[0]
+    seq = jnp.zeros((1, n0), jnp.int32)
+    init_kwargs = dict(mask=jnp.ones((1, n0), bool))
+    if args.msa_depth > 0:
+        init_kwargs["msa"] = jnp.zeros((1, args.msa_depth, n0), jnp.int32)
+        init_kwargs["msa_mask"] = jnp.ones((1, args.msa_depth, n0), bool)
+    params = model.init(jax.random.PRNGKey(0), seq, **init_kwargs)
+
+    executor = serve.FoldExecutor(model, params,
+                                  max_entries=policy.num_buckets)
+    metrics = serve.ServeMetrics(args.metrics_path)
+    config = serve.SchedulerConfig(
+        max_batch_size=args.max_batch, max_wait_ms=args.max_wait_ms,
+        num_recycles=args.num_recycles, msa_depth=args.msa_depth)
+    scheduler = serve.Scheduler(executor, policy, config, metrics)
+
+    warmup_timer = StepTimer()
+    with warmup_timer.measure():
+        compiles = scheduler.warmup()
+    scheduler.start()
+
+    deadline_s = args.deadline_s or None
+    pool = synthetic_requests(
+        jax.random.PRNGKey(1), num=max(args.requests, 64),
+        lengths=lengths, msa_depth=args.msa_depth, deadline_s=deadline_s)
+    failures = []
+    lock = threading.Lock()
+    counter = [0]
+
+    def run_submitter(stop_at, budget):
+        import numpy as np
+        while True:
+            with lock:
+                i = counter[0]
+                if (stop_at and time.monotonic() >= stop_at) or \
+                        (budget and i >= budget):
+                    return
+                counter[0] = i + 1
+            req_proto = pool[i % len(pool)]
+            req = serve.FoldRequest(seq=req_proto.seq, msa=req_proto.msa,
+                                    deadline_s=deadline_s)
+            try:
+                resp = scheduler.submit(req).result(timeout=600)
+            except Exception as exc:
+                with lock:
+                    failures.append(repr(exc))
+                return  # a broken loop would spin; one strike ends it
+            if not resp.ok:
+                with lock:
+                    failures.append(f"{resp.status}: {resp.error}")
+            elif resp.coords.shape != (req.length, 3) or \
+                    not np.isfinite(resp.coords).all():
+                with lock:
+                    failures.append(
+                        f"bad coords {resp.coords.shape} for n={req.length}")
+
+    t0 = time.monotonic()
+    stop_at = t0 + args.duration_s if args.duration_s > 0 else 0.0
+    budget = 0 if args.duration_s > 0 else args.requests
+    threads = [threading.Thread(target=run_submitter,
+                                args=(stop_at, budget), daemon=True)
+               for _ in range(max(args.concurrency, 1))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    serving_wall = time.monotonic() - t0
+    scheduler.stop()
+
+    snap = scheduler.serve_stats()
+    report = {
+        "metric": "serve_loadtest",
+        "platform": args.platform,
+        "folds_per_hour": round(snap["served"] / serving_wall * 3600.0, 1),
+        "serving_wall_s": round(serving_wall, 3),
+        "warmup_s": round(warmup_timer.mean * warmup_timer.count, 3),
+        "compiles": compiles,
+        "bucket_edges": snap["bucket_edges"],
+        "padding_waste": round(snap["padding_waste"], 4),
+        "served": snap["served"],
+        "shed": snap["shed"],
+        "errors": snap["errors"],
+        "rejected": snap["rejected"],
+        "batches": snap["batches"],
+        "latency_by_bucket": snap["latency_by_bucket"],
+        "executor": {k: snap["executor"][k]
+                     for k in ("hits", "misses", "evictions")},
+        "metrics_path": args.metrics_path,
+        "failures": failures[:8],
+    }
+    metrics.close()
+    print(json.dumps(report))
+
+    if args.smoke:
+        bad = snap["shed"] + snap["errors"] + snap["rejected"] \
+            + len(failures)
+        if bad or snap["served"] == 0:
+            print(f"SMOKE FAIL: {bad} bad outcomes, "
+                  f"{snap['served']} served", file=sys.stderr)
+            return 1
+        print(f"SMOKE OK: {snap['served']} folds, 0 shed/errors",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
