@@ -1,0 +1,26 @@
+"""alphafold2_tpu.cache — content-addressed fold results + coalescing.
+
+At serving scale the request stream is massively redundant (ParaFold's
+workload analysis), so the cheapest fold is the one never run. Three
+pieces, each usable alone:
+
+- keys:     fold_key — canonical digest of (seq, effective MSA, fold
+            config, model tag) via utils.hashing.stable_digest
+- store:    FoldCache — byte-budgeted memory LRU over an optional
+            atomic-write on-disk .npz tier; corruption == miss
+- coalesce: InflightRegistry — duplicate submissions attach to the
+            in-flight leader instead of folding twice
+
+`serve.Scheduler(..., cache=FoldCache(...))` wires all three into the
+serving path (submit: cache -> coalesce -> enqueue; completion
+populates the store and fans out to followers). `predict.fold_and_write`
+takes the same cache for offline batch memoization. Caching is OFF by
+default everywhere — results are only reusable when the model+params
+are fixed and identified by `model_tag` (README "Result cache &
+deduplication").
+"""
+
+from alphafold2_tpu.cache.coalesce import InflightRegistry  # noqa: F401
+from alphafold2_tpu.cache.keys import KEY_SCHEMA, fold_key  # noqa: F401
+from alphafold2_tpu.cache.store import (CachedFold, CacheStats,  # noqa: F401
+                                        FoldCache)

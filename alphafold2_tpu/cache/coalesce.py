@@ -1,0 +1,73 @@
+"""In-flight request coalescing: one computation, N waiters.
+
+A cache only deduplicates work that has *finished*; under a batch
+window the duplicates arrive while the first copy is still queued or on
+the accelerator, and a plain cache would fold all of them. The registry
+closes that gap: the first submission of a key becomes the LEADER (it
+enqueues and folds normally), every later submission of the same key
+while the leader is outstanding becomes a FOLLOWER — recorded here,
+never enqueued, resolved when the leader settles.
+
+Settlement is unconditional: whatever happens to the leader (result,
+executor error, deadline shed, cancellation, worker crash) the owner
+MUST call `settle(key)` exactly once and resolve every returned
+follower — including failure propagation, because a follower that
+attached to a leader that then errored must see that error, not hang.
+The registry stores opaque follower objects and never touches them;
+policy (what response a follower gets) stays with the owner.
+
+Thread-safe; attach/settle are O(1) dict ops under one lock, safe on
+the submit hot path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List
+
+
+class InflightRegistry:
+    """Tracks keys with work in flight and the followers awaiting them."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._followers: Dict[str, List[Any]] = {}
+        self.leaders = 0               # lifetime counters, lock-guarded
+        self.coalesced = 0
+
+    def attach(self, key: str, follower: Any) -> bool:
+        """Returns True if the caller is the leader for `key` (it must do
+        the work and later settle); False if `follower` was recorded
+        behind an existing leader."""
+        with self._lock:
+            waiting = self._followers.get(key)
+            if waiting is None:
+                self._followers[key] = []
+                self.leaders += 1
+                return True
+            waiting.append(follower)
+            self.coalesced += 1
+            return False
+
+    def settle(self, key: str) -> List[Any]:
+        """Close out `key`: the leader's work reached a terminal state
+        (success OR failure). Returns the followers to resolve; after
+        this, the next attach of `key` starts a fresh leader."""
+        with self._lock:
+            return self._followers.pop(key, [])
+
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._followers)
+
+    def waiting(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._followers.values())
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"inflight_keys": len(self._followers),
+                    "waiting_followers":
+                        sum(len(v) for v in self._followers.values()),
+                    "leaders": self.leaders,
+                    "coalesced": self.coalesced}

@@ -1,0 +1,79 @@
+"""Content-addressed keys for fold results.
+
+A fold is a pure function of (sequence tokens, the MSA the server will
+actually feed the model, fold configuration, model identity), so the
+cache key is a stable digest over exactly those — not the request id,
+not arrival time, not the bucket (padding is masked out; two lengths
+sharing a bucket must NOT share a key, and the same sequence folded
+through different bucket layouts SHOULD).
+
+The MSA contributes its *effective* content: the serving scheduler pins
+`msa_depth` and keeps only the first `msa_depth` rows of deeper MSAs
+(bucketing.assemble's query-first convention), so two requests whose
+MSAs agree on those rows are the same work and hash the same. The
+pinned depth itself is part of the key — a depth-3 and depth-8 serving
+config pad/mask differently and trace different programs.
+
+`model_tag` folds model identity in. Callers own its meaning: a params
+checksum, a release string ("af2_tpu_v3@step120k"), anything that
+changes when the weights or architecture do. The empty default is fine
+for a single-model process but unsafe for a shared on-disk store —
+README "Result cache & deduplication" spells this out.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from alphafold2_tpu.utils.hashing import stable_digest
+
+# bump when the semantics of the cached value change (e.g. stored
+# fields, confidence definition): old disk entries silently miss
+# instead of deserializing into the wrong meaning
+KEY_SCHEMA = "fold-v1"
+
+
+def fold_key(
+    seq,
+    msa=None,
+    *,
+    msa_depth: Optional[int] = None,
+    num_recycles: int = 0,
+    model_tag: str = "",
+    extras=None,
+) -> str:
+    """Digest identifying one fold's result.
+
+    seq: (n,) int tokens. msa: optional (m, n) int tokens. msa_depth
+    mirrors SchedulerConfig.msa_depth: None = serve the MSA as-is,
+    0 = MSA-free signature (the MSA is ignored entirely, so it does
+    not contribute), k = first k rows contribute (deeper rows are
+    truncated by the server and must not split the key).
+
+    extras: any additional result-determining inputs (stable_digest
+    types: arrays/scalars/strings/nested tuples). None — the serving
+    scheduler's case — keys identically to omitting it, so offline
+    callers that pass no extras share entries with the server when the
+    rest of the config matches. Raises TypeError on un-hashable
+    content; callers should then skip caching, never guess.
+    """
+    # canonical token dtype: FoldRequest coerces to int32 before the
+    # scheduler keys, so offline callers passing default-int (int64)
+    # tokens must land on the SAME key — dtype is part of the digest
+    seq = np.asarray(seq, dtype=np.int32)
+    if seq.ndim != 1:
+        raise ValueError(f"fold_key seq must be 1-D, got {seq.shape}")
+    if msa is not None and msa_depth == 0:
+        msa = None                     # served MSA-free: content irrelevant
+    if msa is not None:
+        msa = np.asarray(msa, dtype=np.int32)
+        if msa.ndim != 2 or msa.shape[1] != seq.shape[0]:
+            raise ValueError(
+                f"fold_key msa must be (m, {seq.shape[0]}), got "
+                f"{None if msa is None else msa.shape}")
+        if msa_depth is not None:
+            msa = msa[:msa_depth]
+    return stable_digest(KEY_SCHEMA, model_tag, seq, msa,
+                         msa_depth, int(num_recycles), extras)

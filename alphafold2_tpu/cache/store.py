@@ -1,0 +1,260 @@
+"""Two-tier fold result store: in-memory LRU over an optional disk tier.
+
+The memory tier is a byte-budgeted LRU (coords for a 512-residue fold
+are ~6 KB; a default 256 MB budget holds tens of thousands of results —
+but budgets are enforced, not assumed). The disk tier is one `.npz`
+per key under a 2-hex-char fan-out, written atomically (tmp file +
+`os.replace`) so a crashed writer can never leave a half-entry a later
+reader trusts. Anything wrong with a disk entry — unreadable npz,
+missing fields, key mismatch, shape nonsense — is treated as a MISS and
+the file is quarantined (renamed `*.quarantined`), never re-read and
+never raised to the serving path: a corrupt cache must cost a
+recompute, not an outage.
+
+Expiry is TTL-based (wall clock at put time, both tiers) plus
+max-entries / max-bytes LRU eviction in memory. `CacheStats` counts
+every outcome; `snapshot()` is the JSON-ready health view the serve
+stats embed.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+_QUARANTINE_SUFFIX = ".quarantined"
+
+
+@dataclass
+class CachedFold:
+    """One stored result: exact-length (unpadded) arrays, always copies."""
+
+    coords: np.ndarray       # (n, 3) float32
+    confidence: np.ndarray   # (n,) float32
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.coords.nbytes + self.confidence.nbytes)
+
+
+class CacheStats:
+    """Thread-safe counters for every cache outcome."""
+
+    FIELDS = ("hits", "misses", "puts", "evictions", "expirations",
+              "disk_hits", "disk_errors")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        for f in self.FIELDS:
+            setattr(self, f, 0)
+
+    def bump(self, field: str, n: int = 1):
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    @property
+    def hit_ratio(self) -> float:
+        with self._lock:
+            total = self.hits + self.misses
+            return self.hits / total if total else 0.0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {f: getattr(self, f) for f in self.FIELDS}
+        total = out["hits"] + out["misses"]
+        out["hit_ratio"] = out["hits"] / total if total else 0.0
+        return out
+
+
+class _Entry:
+    __slots__ = ("value", "expires_at")
+
+    def __init__(self, value: CachedFold, expires_at: Optional[float]):
+        self.value = value
+        self.expires_at = expires_at
+
+
+class FoldCache:
+    """Content-addressed fold result cache (memory LRU + optional disk).
+
+    max_bytes / max_entries bound the memory tier only; the disk tier
+    is bounded by TTL (and by whoever owns the directory). ttl_s=None
+    disables expiry. `clock` is injectable for tests.
+    """
+
+    def __init__(self, max_bytes: int = 256 << 20, max_entries: int = 4096,
+                 ttl_s: Optional[float] = None,
+                 disk_dir: Optional[str] = None,
+                 clock: Callable[[], float] = time.time):
+        if max_bytes < 0 or max_entries < 0:
+            raise ValueError("max_bytes and max_entries must be >= 0")
+        self.max_bytes = int(max_bytes)
+        self.max_entries = int(max_entries)
+        self.ttl_s = ttl_s
+        self.disk_dir = disk_dir
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._mem: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._bytes = 0
+        self.stats = CacheStats()
+        if disk_dir:
+            os.makedirs(disk_dir, exist_ok=True)
+
+    # -- memory tier -----------------------------------------------------
+
+    def _mem_get(self, key: str) -> Optional[CachedFold]:
+        now = self._clock()
+        with self._lock:
+            entry = self._mem.get(key)
+            if entry is None:
+                return None
+            if entry.expires_at is not None and now >= entry.expires_at:
+                del self._mem[key]
+                self._bytes -= entry.value.nbytes
+                self.stats.bump("expirations")
+                return None
+            self._mem.move_to_end(key)
+            return entry.value
+
+    def _mem_put(self, key: str, value: CachedFold,
+                 expires_at: Optional[float] = None):
+        """expires_at overrides the fresh-write TTL — disk promotions
+        pass the ORIGINAL write time's expiry so a value can never live
+        past write_time + ttl_s by bouncing between tiers."""
+        if self.max_entries == 0 or self.max_bytes == 0:
+            return
+        if expires_at is not None:
+            expires = expires_at
+        else:
+            expires = (None if self.ttl_s is None
+                       else self._clock() + self.ttl_s)
+        with self._lock:
+            old = self._mem.pop(key, None)
+            if old is not None:
+                self._bytes -= old.value.nbytes
+            self._mem[key] = _Entry(value, expires)
+            self._bytes += value.nbytes
+            while self._mem and (len(self._mem) > self.max_entries
+                                 or self._bytes > self.max_bytes):
+                _, evicted = self._mem.popitem(last=False)
+                self._bytes -= evicted.value.nbytes
+                self.stats.bump("evictions")
+
+    # -- disk tier -------------------------------------------------------
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.disk_dir, key[:2], f"{key}.npz")
+
+    def _quarantine(self, path: str):
+        self.stats.bump("disk_errors")
+        try:
+            os.replace(path, path + _QUARANTINE_SUFFIX)
+        except OSError:
+            pass                       # racing quarantiners: either wins
+
+    def _disk_get(self, key: str):
+        """Returns (value, expires_at) or None."""
+        path = self._path(key)
+        try:
+            if not os.path.exists(path):
+                return None
+            expires_at = None
+            if self.ttl_s is not None:
+                expires_at = os.path.getmtime(path) + self.ttl_s
+                if self._clock() >= expires_at:
+                    self.stats.bump("expirations")
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+                    return None
+        except OSError:
+            return None
+        try:
+            with np.load(path) as z:
+                stored_key = bytes(z["key"]).decode("utf-8")
+                value = CachedFold(
+                    coords=np.asarray(z["coords"], np.float32),
+                    confidence=np.asarray(z["confidence"], np.float32))
+            if (stored_key != key or value.coords.ndim != 2
+                    or value.coords.shape[1] != 3
+                    or value.confidence.shape
+                    != (value.coords.shape[0],)):
+                raise ValueError(f"cache entry {key} fails validation")
+        except Exception:              # unreadable/garbage/wrong entry
+            self._quarantine(path)
+            return None
+        return value, expires_at
+
+    def _disk_put(self, key: str, value: CachedFold):
+        path = self._path(key)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(tmp, "wb") as fh:
+                np.savez(fh, coords=value.coords,
+                         confidence=value.confidence,
+                         key=np.frombuffer(key.encode("utf-8"), np.uint8))
+            os.replace(tmp, path)      # atomic: readers see old or new
+        except Exception:
+            self.stats.bump("disk_errors")
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    # -- public API ------------------------------------------------------
+
+    def get(self, key: str) -> Optional[CachedFold]:
+        """Lookup; never raises. Disk hits are promoted into memory."""
+        value = self._mem_get(key)
+        if value is None and self.disk_dir:
+            hit = self._disk_get(key)
+            if hit is not None:
+                value, expires_at = hit
+                self.stats.bump("disk_hits")
+                self._mem_put(key, value, expires_at=expires_at)
+        if value is None:
+            self.stats.bump("misses")
+            return None
+        self.stats.bump("hits")
+        return value
+
+    def put(self, key: str, coords, confidence) -> CachedFold:
+        """Store one result (copies taken; never raises past stats)."""
+        value = CachedFold(
+            coords=np.array(coords, np.float32, copy=True),
+            confidence=np.array(confidence, np.float32, copy=True))
+        self.stats.bump("puts")
+        self._mem_put(key, value)
+        if self.disk_dir:
+            self._disk_put(key, value)
+        return value
+
+    # -- views -----------------------------------------------------------
+
+    @property
+    def bytes_resident(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._mem)
+
+    def snapshot(self) -> dict:
+        out = self.stats.snapshot()
+        with self._lock:
+            out["entries_resident"] = len(self._mem)
+            out["bytes_resident"] = self._bytes
+        out["max_bytes"] = self.max_bytes
+        out["max_entries"] = self.max_entries
+        out["ttl_s"] = self.ttl_s
+        out["disk_dir"] = self.disk_dir
+        return out

@@ -11,7 +11,10 @@ Differences by design:
   directory of `<id>.a3m` + `<id>.pdb` (and/or `<id>.npz`) files;
 - parsing runs through the native C++ loader (data/native.py) when built;
 - featurized samples cache as .npz next to the data (the reference uses
-  per-item pickle, trrosetta.py:178-200);
+  per-item pickle, trrosetta.py:178-200), named by a stable digest of
+  the featurize config (utils.hashing.stable_digest) so a config change
+  — e.g. max_msa_rows — misses cleanly instead of serving stale
+  features;
 - batches come out fixed-shape (static XLA shapes), not ragged-padded.
 """
 
@@ -24,6 +27,10 @@ import numpy as np
 
 from alphafold2_tpu import constants
 from alphafold2_tpu.data import featurize, native
+from alphafold2_tpu.utils.hashing import stable_digest
+
+# bump when the cached sample layout changes (keys, dtypes, semantics)
+_FEAT_SCHEMA = "trrosetta-feat-v1"
 
 
 class TrRosettaDataset:
@@ -34,6 +41,11 @@ class TrRosettaDataset:
         self.root = root
         self.cache = cache
         self.max_msa_rows = max_msa_rows
+        # everything that changes the featurized content is in the name:
+        # a different config misses and refeaturizes instead of loading
+        # a stale cache written under other settings
+        self._cache_tag = stable_digest(
+            _FEAT_SCHEMA, max_msa_rows, digest_size=4)
         self.ids = sorted(
             os.path.splitext(f)[0] for f in os.listdir(root)
             if f.endswith(".a3m"))
@@ -44,7 +56,8 @@ class TrRosettaDataset:
         return len(self.ids)
 
     def _cache_path(self, sample_id: str) -> str:
-        return os.path.join(self.root, f"{sample_id}.feat.npz")
+        return os.path.join(
+            self.root, f"{sample_id}.feat-{self._cache_tag}.npz")
 
     def load(self, sample_id: str) -> Dict[str, np.ndarray]:
         cpath = self._cache_path(sample_id)

@@ -24,6 +24,12 @@ class FoldResult(NamedTuple):
     recyclables: Recyclables
 
 
+# single source of truth for the recycling default: fold_and_write's
+# cache keys hash the effective value, so a drifting duplicate literal
+# would silently serve results computed under one default as another
+DEFAULT_NUM_RECYCLES = 3
+
+
 def fold(
     model,
     params,
@@ -31,7 +37,7 @@ def fold(
     msa: Optional[jnp.ndarray] = None,
     mask: Optional[jnp.ndarray] = None,
     msa_mask: Optional[jnp.ndarray] = None,
-    num_recycles: int = 3,
+    num_recycles: int = DEFAULT_NUM_RECYCLES,
     **extra,
 ) -> FoldResult:
     """Run the model with `num_recycles` recycling iterations.
@@ -80,13 +86,31 @@ def fold(
     return FoldResult(coords, conf, distance, recyclables)
 
 
-def fold_and_write(model, params, seq, out_path: str, **kwargs) -> list:
+def fold_and_write(model, params, seq, out_path: str, cache=None,
+                   model_tag: str = "", **kwargs) -> list:
     """fold() + PDB output of the CA trace (data/pdb_io.coords2pdb).
 
     Folds the whole (b, n) batch in ONE forward pass and writes one PDB
     per batch element: `out_path` for a batch of 1, `<stem>_k<ext>` for
     element k otherwise. Returns the list of written paths (length b).
     Pass `mask` to trim per-element padding from the written trace.
+
+    cache: optional `alphafold2_tpu.cache.FoldCache` — the same
+    content-addressed memoization the serving scheduler uses, so
+    offline batch scripts re-running overlapping inputs skip the fold.
+    Keys cover each element's unpadded (seq, msa, msa_mask,
+    num_recycles) plus `model_tag` (identify your weights whenever the
+    cache outlives this process) and any scalar extra model kwargs; a
+    call with array-valued or un-hashable extras (e.g. batched
+    per-element conditioning, which can't be attributed to one
+    element's key) folds uncached rather than risk serving another
+    call's result. With no extras and a
+    trivial msa_mask the key matches the serving scheduler's
+    (msa_depth=None config), so one shared FoldCache deduplicates
+    across offline and served folds of the same content. The
+    forward pass is skipped only when EVERY element hits (partial
+    batches would mint a new compiled shape); partial hits still fold
+    once but refresh the store. Off by default.
     """
     import os
 
@@ -94,20 +118,82 @@ def fold_and_write(model, params, seq, out_path: str, **kwargs) -> list:
 
     from alphafold2_tpu.data.pdb_io import coords2pdb
 
-    result = fold(model, params, seq, **kwargs)
     seq_np = np.asarray(seq)
-    coords_np = np.asarray(result.coords)
     mask = kwargs.get("mask")
     mask_np = None if mask is None else np.asarray(mask)
-
+    msa = kwargs.get("msa")
+    msa_np = None if msa is None else np.asarray(msa)
+    msa_mask = kwargs.get("msa_mask")
+    msa_mask_np = None if msa_mask is None else np.asarray(msa_mask)
     b = seq_np.shape[0]
+
+    def trim(k):
+        return (slice(None) if mask_np is None
+                else np.flatnonzero(mask_np[k]))
+
+    keys = cached = None
+    if cache is not None:
+        from alphafold2_tpu.cache import fold_key
+        num_recycles = kwargs.get("num_recycles", DEFAULT_NUM_RECYCLES)
+        # everything fold() forwards beyond the keyed inputs must reach
+        # the key too — two calls differing only in an extra conditioning
+        # kwarg are different computations. Only SCALAR extras are
+        # keyable: an array-valued extra (e.g. batched per-element
+        # conditioning like embedds) can't be attributed to one element
+        # of the per-element key, so it disables caching for the call
+        # rather than risk serving another element's/call's result.
+        # The no-extras case keys exactly like the serving scheduler
+        # (extras=None), so offline and served folds of the same content
+        # share entries when msa_depth semantics match (scheduler
+        # msa_depth=None). An all-True msa_mask is content-equivalent
+        # to no mask (the scheduler's own construction) and doesn't
+        # split the key.
+        extra = tuple(sorted(
+            (k, v) for k, v in kwargs.items()
+            if k not in ("msa", "mask", "msa_mask", "num_recycles")))
+        scalar_ok = all(
+            v is None or isinstance(v, (str, bytes, bool, int, float,
+                                        np.integer, np.floating))
+            for _, v in extra)
+        if scalar_ok:
+            try:
+                keys, cached = [], []
+                for k in range(b):
+                    idx = trim(k)
+                    mm = (None if msa_mask_np is None
+                          else msa_mask_np[k][:, idx])
+                    if mm is not None and mm.all():
+                        mm = None
+                    extras = None if not extra and mm is None \
+                        else (extra, mm)
+                    keys.append(fold_key(
+                        seq_np[k][idx],
+                        None if msa_np is None else msa_np[k][:, idx],
+                        num_recycles=num_recycles, model_tag=model_tag,
+                        extras=extras))
+                    cached.append(cache.get(keys[k]))
+            except TypeError:
+                # un-content-hashable extra kwarg: fold uncached rather
+                # than risk serving another call's result
+                keys = cached = None
+
+    coords_np = confidence_np = None
+    if cached is None or not all(c is not None for c in cached):
+        result = fold(model, params, seq, **kwargs)
+        coords_np = np.asarray(result.coords)
+        confidence_np = np.asarray(result.confidence)
+
     stem, ext = os.path.splitext(out_path)
     ext = ext or ".pdb"
     paths = []
     for k in range(b):
         path = out_path if b == 1 else f"{stem}_{k}{ext}"
-        idx = (slice(None) if mask_np is None
-               else np.flatnonzero(mask_np[k]))
-        paths.append(coords2pdb(seq_np[k][idx], coords_np[k][idx],
-                                name=path))
+        idx = trim(k)
+        if cached is not None and cached[k] is not None:
+            coords_k = cached[k].coords
+        else:
+            coords_k = coords_np[k][idx]
+            if keys is not None:
+                cache.put(keys[k], coords_k, confidence_np[k][idx])
+        paths.append(coords2pdb(seq_np[k][idx], coords_k, name=path))
     return paths

@@ -5,21 +5,31 @@ The serving stack, bottom-up:
 - request:   FoldRequest/FoldResponse/FoldTicket — ragged in, exact out
 - bucketing: BucketPolicy — ragged lengths onto a closed shape set
 - executor:  FoldExecutor — LRU cache of compiled fold executables
-- scheduler: Scheduler — dynamic batching, deadlines, backpressure
+- scheduler: Scheduler — dynamic batching, deadlines, backpressure,
+             optional result cache + in-flight coalescing
 - metrics:   ServeMetrics — counters, padding waste, latency tails, JSONL
+
+`FoldCache` (re-exported from alphafold2_tpu.cache) makes the server
+content-addressed: pass `Scheduler(..., cache=FoldCache(...),
+model_tag=...)` and duplicate requests are served from the store or
+coalesced onto the in-flight fold instead of re-folding (README
+"Result cache & deduplication"). Off by default.
 
 Minimal use (see README "Serving"):
 
     from alphafold2_tpu import serve
     executor = serve.FoldExecutor(model, params)
     sched = serve.Scheduler(executor, serve.BucketPolicy((64, 128, 256)),
-                            serve.SchedulerConfig(msa_depth=5))
+                            serve.SchedulerConfig(msa_depth=5),
+                            cache=serve.FoldCache(),
+                            model_tag="demo@params-v1")
     with sched:
         sched.warmup()
         ticket = sched.submit(serve.FoldRequest(seq_tokens, msa=msa_tokens))
         response = ticket.result(timeout=120)
 """
 
+from alphafold2_tpu.cache import FoldCache, fold_key  # noqa: F401
 from alphafold2_tpu.serve.bucketing import BucketPolicy, default_policy  # noqa: F401
 from alphafold2_tpu.serve.executor import FoldExecutor  # noqa: F401
 from alphafold2_tpu.serve.metrics import ServeMetrics  # noqa: F401

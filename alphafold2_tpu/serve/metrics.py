@@ -34,6 +34,10 @@ class ServeMetrics:
         self.rejected = 0           # backpressure: submit refused
         self.batches = 0
         self.queue_depth = 0
+        # result-cache outcomes at submit (all zero when caching is off)
+        self.cache_hits = 0         # served straight from the store
+        self.cache_misses = 0       # key looked up, not found
+        self.coalesced = 0          # parked behind an in-flight leader
         self._real_tokens = 0
         self._padded_tokens = 0
         # per-bucket latency reservoirs (seconds, request-level)
@@ -62,6 +66,25 @@ class ServeMetrics:
         with self._lock:
             self.cancelled += n
 
+    def record_cache_hit(self):
+        with self._lock:
+            self.cache_hits += 1
+
+    def record_cache_miss(self):
+        with self._lock:
+            self.cache_misses += 1
+
+    def record_coalesced(self):
+        with self._lock:
+            self.coalesced += 1
+
+    def _cache_view(self) -> dict:
+        """Caller holds self._lock."""
+        total = self.cache_hits + self.cache_misses
+        return {"hits": self.cache_hits, "misses": self.cache_misses,
+                "coalesced": self.coalesced,
+                "hit_ratio": self.cache_hits / total if total else 0.0}
+
     def record_served(self, bucket_len: int, latency_s: float):
         with self._lock:
             self.served += 1
@@ -72,8 +95,13 @@ class ServeMetrics:
 
     def record_batch(self, bucket_len: int, batch_size: int, n_real: int,
                      real_tokens: int, padding_waste: float,
-                     batch_latency_s: float, queue_depth: int):
-        """One executed batch; emits the JSONL record."""
+                     batch_latency_s: float, queue_depth: int,
+                     cache_store: Optional[dict] = None):
+        """One executed batch; emits the JSONL record. `cache_store` is
+        the FoldCache.snapshot() of the scheduler's result store (None
+        when caching is off): the JSONL cache section combines the
+        submit-side counters here with the store's resident bytes and
+        evictions so one record answers "is the cache working"."""
         with self._lock:
             self.batches += 1
             self.queue_depth = queue_depth
@@ -91,10 +119,22 @@ class ServeMetrics:
                 p90_latency_s=percentile(lats, 90),
                 p99_latency_s=percentile(lats, 99),
             )
+            if cache_store is not None:
+                cache = self._cache_view()
+                cache["bytes_resident"] = cache_store.get(
+                    "bytes_resident", 0)
+                cache["evictions"] = cache_store.get("evictions", 0)
+                record["cache"] = cache
             step = self.batches
             logger = self._logger
         if logger is not None:
-            logger.log(step=step, **record)
+            try:
+                logger.log(step=step, **record)
+            except Exception:
+                # the JSONL sink is observability, not serving: a full
+                # disk under the metrics file must not lose the counter
+                # updates above or propagate into the serving worker
+                pass
 
     # -- views -----------------------------------------------------------
 
@@ -128,6 +168,7 @@ class ServeMetrics:
                 "queue_depth": self.queue_depth,
                 "padding_waste": waste,
                 "latency_by_bucket": per_bucket,
+                "cache": self._cache_view(),
             }
 
     def close(self):

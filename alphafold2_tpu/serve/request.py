@@ -67,6 +67,10 @@ class FoldResponse:
     status: "ok" | "shed" (deadline expired before folding) |
             "error" (executor raised; see .error) |
             "cancelled" (scheduler stopped without draining).
+    source: how the result was obtained — "fold" (ran on the
+            accelerator), "cache" (content-addressed result store hit),
+            "coalesced" (attached to an identical in-flight fold; for
+            non-ok statuses this marks leader-state propagation).
     """
 
     request_id: str
@@ -76,6 +80,7 @@ class FoldResponse:
     bucket_len: Optional[int] = None
     latency_s: Optional[float] = None
     error: Optional[str] = None
+    source: str = "fold"
 
     @property
     def ok(self) -> bool:

@@ -13,6 +13,24 @@ throughput comes from scheduling, not the model). Three QoS behaviors:
 - priority: when a backlog exceeds one batch, higher-priority requests
   fold first (FIFO within a priority level).
 
+With a `cache` (alphafold2_tpu.cache.FoldCache — OFF by default),
+submit() never enqueues redundant work: a content-addressed key over
+(seq, effective MSA, fold config, model_tag) is checked against the
+result store (hit → the ticket resolves immediately, source="cache"),
+then against the in-flight registry (duplicate of a queued/running
+fold → the ticket parks as a FOLLOWER of that leader, source=
+"coalesced"). Only a genuinely novel fold enqueues. Every terminal
+leader state — ok, executor error, deadline shed, cancellation, worker
+crash — fans out to its followers, so coalesced tickets can never
+deadlock; on success the store is populated before followers settle,
+closing the attach/settle race. Parked followers count against
+`queue_limit` at attach time, so a duplicate storm is bounded like
+unique traffic (worst-case transient residency is < 2x queue_limit:
+a leader gates its own enqueue on queue depth alone — counting its own
+parked followers there would be a circular wait). Followers inherit
+the leader's timing: their own deadline is not separately enforced
+while parked (cache-aware admission control is a ROADMAP follow-on).
+
 Batches are always padded to `max_batch_size` (bucketing.assemble), so
 the compiled-shape set is closed: one executable per (bucket,
 num_recycles), never one per observed batch size. The scheduler/executor
@@ -30,6 +48,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from alphafold2_tpu.cache import FoldCache, InflightRegistry, fold_key
 from alphafold2_tpu.serve.bucketing import BucketPolicy
 from alphafold2_tpu.serve.executor import FoldExecutor
 from alphafold2_tpu.serve.metrics import ServeMetrics
@@ -67,27 +86,50 @@ class SchedulerConfig:
 
 class _Entry:
     __slots__ = ("request", "ticket", "bucket_len", "enqueued_at",
-                 "deadline")
+                 "deadline", "cache_key", "store_key")
 
     def __init__(self, request: FoldRequest, bucket_len: int):
         self.request = request
         self.ticket = FoldTicket(request.request_id)
         self.bucket_len = bucket_len
+        self.cache_key: Optional[str] = None   # set only on cache leaders
+        # set when the key is known but the entry is NOT a leader (the
+        # saturated block-mode fall-through): its successful fold still
+        # populates the store, it just has no followers to settle
+        self.store_key: Optional[str] = None
+        self.mark_enqueued()
+
+    def mark_enqueued(self):
+        """(Re)start the latency/deadline clock — called again right
+        before the entry actually enters the queue so time blocked on a
+        full queue (full_policy='block') doesn't eat the deadline."""
         self.enqueued_at = time.monotonic()
-        self.deadline = (None if request.deadline_s is None
-                         else self.enqueued_at + request.deadline_s)
+        self.deadline = (None if self.request.deadline_s is None
+                         else self.enqueued_at + self.request.deadline_s)
 
 
 class Scheduler:
-    """Dynamic batching fold server over one FoldExecutor."""
+    """Dynamic batching fold server over one FoldExecutor.
+
+    cache: optional FoldCache enabling result caching AND in-flight
+        coalescing (both off when None — the default). model_tag
+        namespaces cache keys by model identity; REQUIRED to be
+        meaningful whenever the cache outlives one (model, params),
+        e.g. any disk-backed store shared across restarts.
+    """
 
     def __init__(self, executor: FoldExecutor, buckets: BucketPolicy,
                  config: Optional[SchedulerConfig] = None,
-                 metrics: Optional[ServeMetrics] = None):
+                 metrics: Optional[ServeMetrics] = None,
+                 cache: Optional[FoldCache] = None,
+                 model_tag: str = ""):
         self.executor = executor
         self.buckets = buckets
         self.config = config or SchedulerConfig()
         self.metrics = metrics or ServeMetrics()
+        self.cache = cache
+        self.model_tag = model_tag
+        self._inflight = InflightRegistry()
         self._cond = threading.Condition()
         self._incoming: deque = deque()
         self._pending: Dict[int, List[_Entry]] = {}
@@ -143,31 +185,177 @@ class Scheduler:
 
     def submit(self, request: FoldRequest) -> FoldTicket:
         bucket_len = self.buckets.bucket_for(request.length)  # fail fast
-        with self._cond:
-            if not self._running:
-                raise RuntimeError("Scheduler.submit() before start()")
-            while self._depth >= self.config.queue_limit:
-                if self.config.full_policy == "reject":
-                    self.metrics.record_rejected()
-                    raise QueueFullError(
-                        f"queue at limit {self.config.queue_limit}")
-                self._cond.wait()
+        entry = _Entry(request, bucket_len)
+        if self.cache is not None:
+            with self._cond:
                 if not self._running:
-                    raise RuntimeError("Scheduler stopped while blocked "
-                                       "on a full queue")
-            entry = _Entry(request, bucket_len)
-            self._incoming.append(entry)
-            self._depth += 1
-            depth = self._depth
-            self._cond.notify_all()
+                    raise RuntimeError("Scheduler.submit() before start()")
+            if self._serve_from_cache_or_coalesce(entry):
+                return entry.ticket
+        try:
+            with self._cond:
+                if not self._running:
+                    raise RuntimeError("Scheduler.submit() before start()")
+                # queued entries AND parked followers occupy the bound
+                # (waiting() is 0 with no cache); follower settlement
+                # notifies _cond so block-mode waiters see the shrink.
+                # A LEADER gates on depth alone: its own parked
+                # followers can only settle after it enqueues and
+                # folds, so counting them here would be a circular
+                # wait — leader parked forever on capacity that only
+                # its own settlement frees. Follower growth is bounded
+                # at attach time instead.
+                while self._depth + (
+                        self._inflight.waiting()
+                        if entry.cache_key is None else 0) \
+                        >= self.config.queue_limit:
+                    if self.config.full_policy == "reject":
+                        self.metrics.record_rejected()
+                        raise QueueFullError(
+                            f"queue at limit {self.config.queue_limit}")
+                    self._cond.wait()
+                    if not self._running:
+                        raise RuntimeError("Scheduler stopped while "
+                                           "blocked on a full queue")
+                entry.mark_enqueued()
+                self._incoming.append(entry)
+                self._depth += 1
+                depth = self._depth
+                self._cond.notify_all()
+        except BaseException:
+            # a leader that never made it into the queue still owes its
+            # followers a settlement — error out anyone who attached in
+            # the window between precheck and the raise
+            self._settle_followers(entry, FoldResponse(
+                request_id=request.request_id, status="error",
+                bucket_len=bucket_len,
+                error="coalescing leader rejected at submit "
+                      "(queue full or scheduler stopped)"))
+            raise
         self.metrics.record_enqueued(depth)
         return entry.ticket
 
+    # -- cache / coalescing ----------------------------------------------
+
+    def _cache_key_for(self, request: FoldRequest) -> str:
+        return fold_key(request.seq, request.msa,
+                        msa_depth=self.config.msa_depth,
+                        num_recycles=self.config.num_recycles,
+                        model_tag=self.model_tag)
+
+    def _serve_from_cache_or_coalesce(self, entry: _Entry) -> bool:
+        """submit() fast path: True when the entry was fully handled
+        (resolved from the store, or parked behind the in-flight
+        leader). Cache trouble of any kind degrades to a miss — a
+        broken cache must cost a recompute, never fail a submit."""
+        try:
+            key = self._cache_key_for(entry.request)
+            cached = self.cache.get(key)      # never raises (store.py)
+        except Exception:
+            self.metrics.record_cache_miss()
+            return False
+        if cached is not None:
+            self.metrics.record_cache_hit()
+            entry.ticket._resolve(FoldResponse(
+                request_id=entry.request.request_id, status="ok",
+                coords=cached.coords.copy(),
+                confidence=cached.confidence.copy(),
+                bucket_len=entry.bucket_len,
+                latency_s=time.monotonic() - entry.enqueued_at,
+                source="cache"))
+            return True
+        self.metrics.record_cache_miss()
+        # parked followers hold real memory (their request arrays), so
+        # the bounded-queue invariant must cover them too: a duplicate
+        # storm on one hot key must not grow the registry unboundedly
+        # where pre-cache behavior would have hit queue_limit. Check
+        # and attach under ONE lock — a window between them would let
+        # concurrent duplicates all pass the check and overshoot the
+        # limit. (Lock order _cond -> registry lock; no path takes them
+        # in the other order.)
+        with self._cond:
+            if (self._depth + self._inflight.waiting()
+                    >= self.config.queue_limit):
+                if self.config.full_policy == "reject":
+                    self.metrics.record_rejected()
+                    raise QueueFullError(
+                        f"queue + coalesced followers at limit "
+                        f"{self.config.queue_limit}")
+                # "block": fall through to the normal enqueue path,
+                # which waits for capacity and folds this duplicate —
+                # bounded beats deduped when the queue is saturated
+                # (the fold still populates the store via store_key)
+                entry.store_key = key
+                return False
+            leader = self._inflight.attach(key, entry)
+        if not leader:
+            self.metrics.record_coalesced()
+            return True                       # follower: leader settles us
+        entry.cache_key = key                 # leader: enqueue + settle
+        return False
+
+    def _settle_followers(self, entry: _Entry, response: FoldResponse):
+        """Fan the leader's terminal response out to its followers.
+        Called from EVERY path that resolves a leader ticket, success or
+        failure, so a coalesced ticket can never be left hanging."""
+        if entry.cache_key is None:
+            return
+        followers: List[_Entry] = self._inflight.settle(entry.cache_key)
+        if followers:
+            # parked followers counted against queue_limit: their
+            # release frees capacity block-mode submitters wait on
+            with self._cond:
+                self._cond.notify_all()
+        now = time.monotonic()
+        for f in followers:
+            if response.status == "ok":
+                try:
+                    resp = FoldResponse(
+                        request_id=f.request.request_id, status="ok",
+                        coords=response.coords.copy(),
+                        confidence=response.confidence.copy(),
+                        bucket_len=response.bucket_len,
+                        latency_s=now - f.enqueued_at, source="coalesced")
+                except Exception as exc:  # e.g. MemoryError on the copy:
+                    resp = FoldResponse(  # never orphan the remaining fan-out
+                        request_id=f.request.request_id, status="error",
+                        bucket_len=f.bucket_len, source="coalesced",
+                        error=f"coalesced fan-out failed: {exc!r}")
+                f.ticket._resolve(resp)
+            else:
+                f.ticket._resolve(FoldResponse(
+                    request_id=f.request.request_id,
+                    status=response.status, bucket_len=f.bucket_len,
+                    latency_s=now - f.enqueued_at, source="coalesced",
+                    error=f"coalesced onto leader "
+                          f"{response.request_id}: "
+                          f"{response.error or response.status}"))
+
+    def _resolve_entry(self, entry: _Entry, response: FoldResponse):
+        """Terminal state for one queued entry: populate the store (ok
+        only, BEFORE followers settle so late duplicates hit the cache),
+        resolve the leader ticket, fan out to followers."""
+        put_key = entry.cache_key or entry.store_key
+        if response.status == "ok" and self.cache is not None \
+                and put_key is not None:
+            try:
+                self.cache.put(put_key, response.coords,
+                               response.confidence)
+            except Exception:
+                pass                  # a full/broken store never blocks
+        entry.ticket._resolve(response)
+        self._settle_followers(entry, response)
+
     def serve_stats(self) -> dict:
-        """Health-check snapshot: serving counters + executor cache."""
+        """Health-check snapshot: serving counters + executor cache +
+        result-cache section ("cache": submit-side counters always;
+        "store"/"inflight" sub-views only when a cache is attached)."""
         stats = self.metrics.snapshot()
         stats["executor"] = self.executor.stats()
         stats["bucket_edges"] = list(self.buckets.edges)
+        if self.cache is not None:
+            stats["cache"]["store"] = self.cache.snapshot()
+            stats["cache"]["inflight"] = self._inflight.snapshot()
         with self._cond:
             stats["running"] = self._running
         return stats
@@ -239,7 +427,7 @@ class Scheduler:
         self._resolve_removed(shed)
         for e in shed:
             self.metrics.record_shed()
-            e.ticket._resolve(FoldResponse(
+            self._resolve_entry(e, FoldResponse(
                 request_id=e.request.request_id, status="shed",
                 bucket_len=e.bucket_len,
                 latency_s=now - e.enqueued_at,
@@ -287,29 +475,60 @@ class Scheduler:
         except Exception as exc:  # resolve, never kill the worker
             self.metrics.record_error(len(entries))
             for e in entries:
-                e.ticket._resolve(FoldResponse(
+                self._resolve_entry(e, FoldResponse(
                     request_id=e.request.request_id, status="error",
                     bucket_len=bucket_len, error=repr(exc)))
             return
         now = time.monotonic()
         real_tokens = 0
-        for i, e in enumerate(entries):
-            n = e.request.length
-            real_tokens += n
-            latency = now - e.enqueued_at
-            self.metrics.record_served(bucket_len, latency)
-            e.ticket._resolve(FoldResponse(
-                request_id=e.request.request_id, status="ok",
-                # copy: a view would pin the whole padded batch in the
-                # caller's hands for the lifetime of the response
-                coords=coords[i, :n].copy(),
-                confidence=confidence[i, :n].copy(),
-                bucket_len=bucket_len, latency_s=latency))
+        try:
+            for i, e in enumerate(entries):
+                n = e.request.length
+                real_tokens += n
+                latency = now - e.enqueued_at
+                self.metrics.record_served(bucket_len, latency)
+                self._resolve_entry(e, FoldResponse(
+                    request_id=e.request.request_id, status="ok",
+                    # copy: a view would pin the whole padded batch in
+                    # the caller's hands for the lifetime of the response
+                    coords=coords[i, :n].copy(),
+                    confidence=confidence[i, :n].copy(),
+                    bucket_len=bucket_len, latency_s=latency))
+        except Exception as exc:
+            # resolution machinery failed mid-batch (e.g. MemoryError on
+            # a response copy): entries already left the queue, so
+            # anything still unresolved must be error-resolved HERE or
+            # its caller blocks forever — then keep serving
+            for e in entries:
+                if not e.ticket.done():
+                    self.metrics.record_error()
+                    try:
+                        self._resolve_entry(e, FoldResponse(
+                            request_id=e.request.request_id,
+                            status="error", bucket_len=bucket_len,
+                            error=f"post-fold resolution failed: "
+                                  f"{exc!r}"))
+                    except Exception:
+                        e.ticket._resolve(FoldResponse(
+                            request_id=e.request.request_id,
+                            status="error", bucket_len=bucket_len,
+                            error=f"post-fold resolution failed: "
+                                  f"{exc!r}"))
+            return
         with self._cond:
             depth = self._depth
-        self.metrics.record_batch(
-            bucket_len, cfg.max_batch_size, len(entries), real_tokens,
-            waste, now - t0, depth)
+        try:
+            self.metrics.record_batch(
+                bucket_len, cfg.max_batch_size, len(entries), real_tokens,
+                waste, now - t0, depth,
+                cache_store=(None if self.cache is None
+                             else self.cache.snapshot()))
+        except Exception:
+            # last-resort worker protection (sink I/O failures are
+            # already absorbed inside ServeMetrics.record_batch; this
+            # additionally survives a misbehaving metrics subclass —
+            # observability must never take down serving)
+            pass
 
     def _drain_all_entries(self) -> List[_Entry]:
         with self._cond:
@@ -326,9 +545,10 @@ class Scheduler:
         leftovers = self._drain_all_entries()
         self.metrics.record_cancelled(len(leftovers))
         for e in leftovers:
-            e.ticket._resolve(FoldResponse(
+            self._resolve_entry(e, FoldResponse(
                 request_id=e.request.request_id, status="cancelled",
-                bucket_len=e.bucket_len))
+                bucket_len=e.bucket_len,
+                error="scheduler stopped without draining"))
 
     def _fail_outstanding(self, error: str):
         """Worker crashed outside executor.run (e.g. the metrics sink):
@@ -340,7 +560,7 @@ class Scheduler:
         leftovers = self._drain_all_entries()
         self.metrics.record_error(len(leftovers))
         for e in leftovers:
-            e.ticket._resolve(FoldResponse(
+            self._resolve_entry(e, FoldResponse(
                 request_id=e.request.request_id, status="error",
                 bucket_len=e.bucket_len,
                 error=f"scheduler worker crashed: {error}"))

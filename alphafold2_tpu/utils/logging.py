@@ -15,7 +15,12 @@ from typing import IO, Optional
 
 
 class MetricsLogger:
-    """log(step=..., **scalars) -> one JSONL record (+ pretty stdout)."""
+    """log(step=..., **scalars) -> one JSONL record (+ pretty stdout).
+
+    Values are scalars, or ONE level of dict-of-scalars for grouped
+    sections (e.g. the serving cache section: `cache={"hits": 3, ...}`
+    emits a nested object and pretty-prints as `cache.hits=3`).
+    """
 
     def __init__(self, path: Optional[str] = None, stdout: bool = True):
         self.stdout = stdout
@@ -26,16 +31,31 @@ class MetricsLogger:
             self._fh = open(path, "a")
         self._t0 = time.time()
 
+    @staticmethod
+    def _scalar(v):
+        return v if isinstance(v, (str, type(None))) else float(v)
+
     def log(self, step: int, **scalars):
         record = {"step": int(step),
                   "wall_s": round(time.time() - self._t0, 3)}
-        record.update({k: float(v) for k, v in scalars.items()})
+        for k, v in scalars.items():
+            record[k] = ({k2: self._scalar(v2) for k2, v2 in v.items()}
+                         if isinstance(v, dict) else self._scalar(v))
         if self._fh is not None:
             self._fh.write(json.dumps(record) + "\n")
             self._fh.flush()
         if self.stdout:
-            parts = " ".join(f"{k}={v:.4g}" for k, v in record.items()
-                             if k not in ("step", "wall_s"))
+            flat = {}
+            for k, v in record.items():
+                if k in ("step", "wall_s"):
+                    continue
+                if isinstance(v, dict):
+                    flat.update({f"{k}.{k2}": v2 for k2, v2 in v.items()})
+                else:
+                    flat[k] = v
+            parts = " ".join(
+                f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in flat.items())
             print(f"[step {record['step']:>6}] {parts}", file=sys.stdout,
                   flush=True)
         return record

@@ -1,0 +1,636 @@
+"""Cache subsystem tests (ISSUE 2): stable digests, content-addressed
+keys, the two-tier FoldCache (LRU + TTL + disk roundtrip + atomic
+write / corruption quarantine), in-flight coalescing fan-out (including
+leader failure propagation), the cached `fold_and_write` path, and the
+end-to-end scheduler run where a 50%-duplicate workload executes only
+unique work.
+"""
+
+import json
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu import Alphafold2, predict, serve
+from alphafold2_tpu.cache import (CachedFold, FoldCache, InflightRegistry,
+                                  fold_key)
+from alphafold2_tpu.data.synthetic import synthetic_requests
+from alphafold2_tpu.serve import (BucketPolicy, FoldExecutor, FoldRequest,
+                                  QueueFullError, Scheduler,
+                                  SchedulerConfig, ServeMetrics)
+from alphafold2_tpu.utils.hashing import stable_digest
+
+MSA_DEPTH = 3
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = Alphafold2(dim=32, depth=1, heads=2, dim_head=16,
+                       predict_coords=True, structure_module_depth=1)
+    n = 16
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, n), jnp.int32),
+        msa=jnp.zeros((1, MSA_DEPTH, n), jnp.int32),
+        mask=jnp.ones((1, n), bool),
+        msa_mask=jnp.ones((1, MSA_DEPTH, n), bool))
+    return model, params
+
+
+def fold_result(n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.normal(size=(n, 3)).astype(np.float32),
+            rng.uniform(size=(n,)).astype(np.float32))
+
+
+@pytest.mark.quick
+class TestStableDigest:
+    def test_deterministic_and_type_discriminating(self):
+        a = np.arange(6, dtype=np.int32)
+        assert stable_digest(a) == stable_digest(a.copy())
+        # dtype, shape, and scalar/str/None forms all key differently
+        assert stable_digest(a) != stable_digest(a.astype(np.int64))
+        assert stable_digest(a) != stable_digest(a.reshape(2, 3))
+        assert stable_digest(1) != stable_digest(1.0)
+        assert stable_digest(1) != stable_digest("1")
+        assert stable_digest(None) != stable_digest(0)
+        assert stable_digest(None) != stable_digest("")
+        assert stable_digest(True) != stable_digest(1)
+
+    def test_framing_prevents_concat_collisions(self):
+        assert stable_digest("ab") != stable_digest("a", "b")
+        assert stable_digest(("ab",)) != stable_digest(("a", "b"))
+        assert stable_digest([1, [2, 3]]) != stable_digest([1, 2, 3])
+
+    def test_digest_size(self):
+        assert len(stable_digest("x", digest_size=4)) == 8
+        assert len(stable_digest("x")) == 32
+
+    def test_object_dtype_refused_not_pointer_hashed(self):
+        """np.asarray(dict).tobytes() would hash MEMORY ADDRESSES —
+        nondeterministic keys and address-reuse collisions. Must raise
+        so callers fall back to not caching."""
+        with pytest.raises(TypeError, match="object dtype"):
+            stable_digest({"temperature": 0.1})
+        with pytest.raises(TypeError):
+            stable_digest({1, 2})
+        with pytest.raises(TypeError):
+            stable_digest(np.array([object()], dtype=object))
+        with pytest.raises(TypeError):
+            stable_digest((1, {"nested": True}))
+
+
+@pytest.mark.quick
+class TestFoldKey:
+    def test_stability_and_separation(self):
+        seq = np.arange(10, dtype=np.int32)
+        msa = np.tile(seq, (4, 1))
+        k = fold_key(seq, msa, msa_depth=2, num_recycles=1, model_tag="t")
+        assert k == fold_key(seq.copy(), msa.copy(), msa_depth=2,
+                             num_recycles=1, model_tag="t")
+        # every config axis separates keys
+        assert k != fold_key(seq, msa, msa_depth=3, num_recycles=1,
+                             model_tag="t")
+        assert k != fold_key(seq, msa, msa_depth=2, num_recycles=2,
+                             model_tag="t")
+        assert k != fold_key(seq, msa, msa_depth=2, num_recycles=1,
+                             model_tag="other")
+        assert k != fold_key(seq + 1, msa, msa_depth=2, num_recycles=1,
+                             model_tag="t")
+        assert k != fold_key(seq, msa + 1, msa_depth=2, num_recycles=1,
+                             model_tag="t")
+
+    def test_effective_msa_semantics(self):
+        """Rows the server truncates away must not split the key; a
+        pinned depth of 0 ignores the MSA entirely."""
+        seq = np.arange(10, dtype=np.int32)
+        msa = np.tile(seq, (4, 1))
+        k = fold_key(seq, msa, msa_depth=2, num_recycles=0)
+        assert k == fold_key(seq, msa[:2], msa_depth=2, num_recycles=0)
+        assert fold_key(seq, msa, msa_depth=0, num_recycles=0) == \
+            fold_key(seq, None, msa_depth=0, num_recycles=0)
+        # unpinned: the full MSA contributes
+        assert fold_key(seq, msa, num_recycles=0) != \
+            fold_key(seq, msa[:2], num_recycles=0)
+
+    def test_token_dtype_canonicalized(self):
+        """Default-int (int64) tokens must key identically to the int32
+        the server coerces to — else offline/server sharing never hits."""
+        seq64 = np.arange(10)              # platform default int
+        msa64 = np.tile(seq64, (2, 1))
+        assert fold_key(seq64, msa64, num_recycles=0) == \
+            fold_key(seq64.astype(np.int32), msa64.astype(np.int32),
+                     num_recycles=0)
+
+    def test_extras_separate_and_default_to_server_form(self):
+        seq = np.arange(10, dtype=np.int32)
+        base = fold_key(seq, num_recycles=0, model_tag="t")
+        assert base == fold_key(seq, num_recycles=0, model_tag="t",
+                                extras=None)
+        assert base != fold_key(seq, num_recycles=0, model_tag="t",
+                                extras=(("temperature", 0.1),))
+        with pytest.raises(TypeError):
+            fold_key(seq, num_recycles=0,
+                     extras={"unhashable": object()})
+
+    def test_shape_validation(self):
+        with pytest.raises(ValueError, match="1-D"):
+            fold_key(np.zeros((2, 3), np.int32))
+        with pytest.raises(ValueError, match="msa"):
+            fold_key(np.zeros(4, np.int32), np.zeros((2, 5), np.int32))
+
+
+@pytest.mark.quick
+class TestFoldCacheMemory:
+    def test_roundtrip_and_put_copies(self):
+        cache = FoldCache()
+        coords, conf = fold_result()
+        cache.put("k", coords, conf)
+        coords[:] = -1                         # caller mutates its array...
+        got = cache.get("k")
+        assert isinstance(got, CachedFold)
+        assert not np.array_equal(got.coords, coords)  # ...store unaffected
+        assert np.array_equal(got.confidence, conf)
+        assert cache.get("missing") is None
+        snap = cache.snapshot()
+        assert snap["hits"] == 1 and snap["misses"] == 1
+        assert snap["puts"] == 1 and snap["bytes_resident"] > 0
+        assert snap["hit_ratio"] == pytest.approx(1 / 2)
+
+    def test_lru_eviction_by_entries_and_bytes(self):
+        cache = FoldCache(max_entries=2)
+        for i in range(3):
+            cache.put(f"k{i}", *fold_result(seed=i))
+        assert cache.get("k0") is None         # oldest evicted
+        assert cache.get("k1") is not None and cache.get("k2") is not None
+        assert cache.stats.evictions == 1 and len(cache) == 2
+
+        one_entry = cache.get("k1").nbytes
+        tight = FoldCache(max_bytes=one_entry)  # budget fits exactly one
+        tight.put("a", *fold_result(seed=0))
+        tight.put("b", *fold_result(seed=1))
+        assert tight.get("a") is None and tight.get("b") is not None
+        assert tight.bytes_resident <= one_entry
+
+    def test_lru_order_refreshed_by_get(self):
+        cache = FoldCache(max_entries=2)
+        cache.put("a", *fold_result(seed=0))
+        cache.put("b", *fold_result(seed=1))
+        cache.get("a")                         # a becomes most-recent
+        cache.put("c", *fold_result(seed=2))   # evicts b, not a
+        assert cache.get("a") is not None and cache.get("b") is None
+
+    def test_ttl_expiry_with_injected_clock(self):
+        now = [100.0]
+        cache = FoldCache(ttl_s=10.0, clock=lambda: now[0])
+        cache.put("k", *fold_result())
+        now[0] = 109.9
+        assert cache.get("k") is not None
+        now[0] = 110.0
+        assert cache.get("k") is None          # expired == miss
+        assert cache.stats.expirations == 1 and len(cache) == 0
+
+
+@pytest.mark.quick
+class TestFoldCacheDisk:
+    def test_disk_roundtrip_across_instances(self, tmp_path):
+        d = str(tmp_path / "store")
+        coords, conf = fold_result()
+        FoldCache(disk_dir=d).put("deadbeef01", coords, conf)
+        fresh = FoldCache(disk_dir=d)          # new process, cold memory
+        got = fresh.get("deadbeef01")
+        assert got is not None and np.array_equal(got.coords, coords)
+        assert fresh.stats.disk_hits == 1
+        # promoted into memory: second get never touches disk
+        fresh.get("deadbeef01")
+        assert fresh.stats.disk_hits == 1 and fresh.stats.hits == 2
+        # no stray tmp files from the atomic write protocol
+        leftovers = [f for root, _, fs in os.walk(d) for f in fs
+                     if ".tmp." in f]
+        assert leftovers == []
+
+    def test_corruption_quarantined_as_miss(self, tmp_path):
+        d = str(tmp_path / "store")
+        cache = FoldCache(disk_dir=d)
+        cache.put("cafe0123", *fold_result())
+        path = cache._path("cafe0123")
+        with open(path, "wb") as fh:
+            fh.write(b"this is not an npz file")
+
+        fresh = FoldCache(disk_dir=d)
+        assert fresh.get("cafe0123") is None   # miss, not an exception
+        assert fresh.stats.disk_errors == 1
+        assert not os.path.exists(path)
+        assert os.path.exists(path + ".quarantined")
+        # quarantined entries are never re-read; a recompute repopulates
+        fresh.put("cafe0123", *fold_result(seed=1))
+        assert FoldCache(disk_dir=d).get("cafe0123") is not None
+
+    def test_wrong_key_content_quarantined(self, tmp_path):
+        """An entry whose stored key disagrees with its filename (e.g. a
+        mis-copied store) fails validation and quarantines."""
+        d = str(tmp_path / "store")
+        cache = FoldCache(disk_dir=d)
+        cache.put("aaaa1111", *fold_result())
+        os.makedirs(os.path.dirname(cache._path("bbbb2222")), exist_ok=True)
+        os.rename(cache._path("aaaa1111"), cache._path("bbbb2222"))
+        fresh = FoldCache(disk_dir=d)
+        assert fresh.get("bbbb2222") is None
+        assert fresh.stats.disk_errors == 1
+
+    def test_disk_promotion_preserves_original_ttl(self, tmp_path):
+        """Promoting a disk hit into memory must keep the ORIGINAL
+        expiry (file write time + ttl), not grant a fresh lease — else
+        an entry could live ~2x ttl_s by bouncing between tiers."""
+        d = str(tmp_path / "store")
+        now = [1000.0]
+        writer = FoldCache(ttl_s=60.0, disk_dir=d, clock=lambda: now[0])
+        writer.put("aa11", *fold_result())
+        path = writer._path("aa11")
+        os.utime(path, (950.0, 950.0))     # written at t=950
+        reader = FoldCache(ttl_s=60.0, disk_dir=d, clock=lambda: now[0])
+        assert reader.get("aa11") is not None   # promoted at t=1000
+        now[0] = 1011.0                    # past 950 + 60
+        assert reader.get("aa11") is None  # memory copy expired with it
+
+    def test_disk_ttl_expiry(self, tmp_path):
+        d = str(tmp_path / "store")
+        cache = FoldCache(ttl_s=60.0, disk_dir=d)
+        cache.put("feed5678", *fold_result())
+        path = cache._path("feed5678")
+        old = os.path.getmtime(path) - 120.0
+        os.utime(path, (old, old))
+        fresh = FoldCache(ttl_s=60.0, disk_dir=d)
+        assert fresh.get("feed5678") is None
+        assert fresh.stats.expirations == 1
+        assert not os.path.exists(path)
+
+
+@pytest.mark.quick
+class TestInflightRegistry:
+    def test_leader_then_followers_then_settle(self):
+        reg = InflightRegistry()
+        assert reg.attach("k", "lead-obj") is True
+        assert reg.attach("k", "f1") is False
+        assert reg.attach("k", "f2") is False
+        assert reg.inflight() == 1 and reg.waiting() == 2
+        assert reg.settle("k") == ["f1", "f2"]
+        assert reg.settle("k") == []           # settle is terminal
+        assert reg.attach("k", "x") is True    # fresh leader afterwards
+        snap = reg.snapshot()
+        assert snap["leaders"] == 2 and snap["coalesced"] == 2
+
+
+class _BoomExecutor:
+    """Minimal executor stand-in that always fails."""
+
+    def run(self, batch, num_recycles):
+        raise RuntimeError("boom")
+
+    def stats(self):
+        return {"hits": 0, "misses": 0, "evictions": 0}
+
+
+class TestSchedulerCoalescing:
+    def _dup_requests(self, n, length=8):
+        proto = synthetic_requests(jax.random.PRNGKey(3), num=1,
+                                   lengths=(length,),
+                                   msa_depth=MSA_DEPTH)[0]
+        return [FoldRequest(seq=proto.seq, msa=proto.msa)
+                for _ in range(n)]
+
+    def test_leader_failure_propagates_to_followers(self):
+        cfg = SchedulerConfig(max_batch_size=4, max_wait_ms=100.0,
+                              num_recycles=0, msa_depth=MSA_DEPTH)
+        with Scheduler(_BoomExecutor(), BucketPolicy((16,)), cfg,
+                       cache=FoldCache(), model_tag="m") as sched:
+            tickets = [sched.submit(r) for r in self._dup_requests(3)]
+            resps = [t.result(timeout=60) for t in tickets]
+        assert all(r.status == "error" for r in resps)
+        leader, followers = resps[0], resps[1:]
+        assert "boom" in leader.error
+        for f in followers:
+            assert f.source == "coalesced"
+            assert "coalesced onto leader" in f.error
+            assert "boom" in f.error
+
+    def test_cancel_propagates_to_followers(self, model_and_params):
+        ex = FoldExecutor(*model_and_params)
+        cfg = SchedulerConfig(max_batch_size=8, max_wait_ms=60_000.0,
+                              num_recycles=0, msa_depth=MSA_DEPTH)
+        sched = Scheduler(ex, BucketPolicy((16,)), cfg,
+                          cache=FoldCache(), model_tag="m")
+        sched.start()
+        tickets = [sched.submit(r) for r in self._dup_requests(3)]
+        sched.stop(drain=False)
+        resps = [t.result(timeout=60) for t in tickets]
+        assert [r.status for r in resps] == ["cancelled"] * 3
+        assert resps[1].source == "coalesced"
+        assert ex.stats()["misses"] == 0       # nothing ever folded
+
+    def test_followers_count_against_queue_limit(self, model_and_params):
+        """A duplicate storm on one hot key must hit queue_limit
+        backpressure, not grow the in-flight registry unboundedly."""
+        ex = FoldExecutor(*model_and_params)
+        cfg = SchedulerConfig(max_batch_size=8, max_wait_ms=60_000.0,
+                              queue_limit=2, full_policy="reject",
+                              num_recycles=0, msa_depth=MSA_DEPTH)
+        sched = Scheduler(ex, BucketPolicy((16,)), cfg,
+                          cache=FoldCache(), model_tag="m")
+        sched.start()
+        reqs = self._dup_requests(3)
+        t_leader = sched.submit(reqs[0])       # queued (depth 1)
+        t_follow = sched.submit(reqs[1])       # parked (waiting 1)
+        with pytest.raises(QueueFullError, match="coalesced followers"):
+            sched.submit(reqs[2])              # depth+waiting at limit
+        sched.stop(drain=False)
+        assert t_leader.result(timeout=60).status == "cancelled"
+        assert t_follow.result(timeout=60).status == "cancelled"
+        assert ex.stats()["misses"] == 0
+
+    def test_block_mode_duplicate_storm_no_deadlock(self,
+                                                    model_and_params):
+        """full_policy='block' + hot-key duplicates at queue_limit=1:
+        the leader must never wait on capacity occupied by its OWN
+        parked followers (circular wait). All tickets resolve."""
+        ex = FoldExecutor(*model_and_params)
+        cfg = SchedulerConfig(max_batch_size=2, max_wait_ms=10.0,
+                              queue_limit=1, full_policy="block",
+                              num_recycles=0, msa_depth=MSA_DEPTH)
+        reqs = self._dup_requests(4)
+        results = []
+        lock = threading.Lock()
+        with Scheduler(ex, BucketPolicy((16,)), cfg, cache=FoldCache(),
+                       model_tag="m") as sched:
+            def go(r):
+                resp = sched.submit(r).result(timeout=120)
+                with lock:
+                    results.append(resp)
+
+            threads = [threading.Thread(target=go, args=(r,))
+                       for r in reqs]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert len(results) == 4
+        assert all(r.ok for r in results), [r.error for r in results]
+
+    def test_corrupt_disk_entry_recomputes_via_scheduler(
+            self, model_and_params, tmp_path):
+        """Acceptance: a corrupted on-disk entry is a miss — recompute
+        succeeds, the entry is quarantined, and no exception escapes
+        submit()/result()."""
+        d = str(tmp_path / "store")
+        cache = FoldCache(disk_dir=d)
+        cfg = SchedulerConfig(max_batch_size=2, max_wait_ms=10.0,
+                              num_recycles=0, msa_depth=MSA_DEPTH)
+        req = self._dup_requests(1)[0]
+        key = fold_key(req.seq, req.msa, msa_depth=MSA_DEPTH,
+                       num_recycles=0, model_tag="m")
+        path = cache._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as fh:
+            fh.write(b"\x00garbage\xff" * 64)
+
+        ex = FoldExecutor(*model_and_params)
+        with Scheduler(ex, BucketPolicy((16,)), cfg, cache=cache,
+                       model_tag="m") as sched:
+            resp = sched.submit(req).result(timeout=600)
+        assert resp.ok and resp.source == "fold"
+        assert np.isfinite(resp.coords).all()
+        assert cache.stats.disk_errors == 1
+        assert os.path.exists(path + ".quarantined")
+        assert cache.get(key) is not None      # repopulated by completion
+
+
+class TestSchedulerEndToEnd:
+    def test_half_duplicate_workload_folds_unique_only(
+            self, model_and_params, tmp_path):
+        """Acceptance demo: 32 requests, 50% duplicates — only the 16
+        unique folds reach the executor; every duplicate resolves from
+        the store or by coalescing; serve_stats()/JSONL expose the
+        cache section."""
+        jsonl = str(tmp_path / "serve.jsonl")
+        ex = FoldExecutor(*model_and_params, max_entries=4)
+        metrics = ServeMetrics(jsonl)
+        cache = FoldCache(disk_dir=str(tmp_path / "store"))
+        cfg = SchedulerConfig(max_batch_size=4, max_wait_ms=20.0,
+                              num_recycles=0, msa_depth=MSA_DEPTH)
+        policy = BucketPolicy((16, 32))
+        uniq = synthetic_requests(jax.random.PRNGKey(7), num=16,
+                                  lengths=(12, 24), msa_depth=MSA_DEPTH)
+        reqs = [FoldRequest(seq=u.seq, msa=u.msa)
+                for u in uniq for _ in (0, 1)]      # every request twice
+        assert len(reqs) == 32
+
+        tickets = []
+        tickets_lock = threading.Lock()
+        with Scheduler(ex, policy, cfg, metrics, cache=cache,
+                       model_tag="e2e") as sched:
+            def submit_slice(i):
+                for r in reqs[i::4]:
+                    t = sched.submit(r)
+                    with tickets_lock:
+                        tickets.append(t)
+
+            threads = [threading.Thread(target=submit_slice, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            responses = [t.result(timeout=600) for t in tickets]
+            snap = sched.serve_stats()
+
+        assert len(responses) == 32
+        for resp in responses:
+            assert resp.ok, resp.error
+            assert resp.coords is not None and resp.confidence is not None
+            assert np.isfinite(resp.coords).all()
+
+        # only unique work hit the accelerator path
+        assert snap["served"] <= 16
+        by_source = {s: sum(r.source == s for r in responses)
+                     for s in ("fold", "cache", "coalesced")}
+        assert by_source["fold"] == snap["served"]
+        assert by_source["cache"] + by_source["coalesced"] >= 16
+        assert ex.stats()["misses"] <= policy.num_buckets   # compile bound
+
+        # serve_stats cache section: counters + store + inflight
+        c = snap["cache"]
+        assert c["hits"] == by_source["cache"]
+        assert c["coalesced"] == by_source["coalesced"]
+        assert c["hits"] + c["misses"] == 32
+        assert 0.0 <= c["hit_ratio"] <= 1.0
+        assert c["store"]["bytes_resident"] > 0
+        assert c["store"]["puts"] == snap["served"]
+        assert c["inflight"]["inflight_keys"] == 0          # all settled
+        metrics.close()
+
+        # JSONL cache section rides along with every batch record
+        records = [json.loads(line) for line in open(jsonl)]
+        assert records
+        for rec in records:
+            assert "cache" in rec
+            for field in ("hits", "misses", "coalesced", "hit_ratio",
+                          "bytes_resident", "evictions"):
+                assert field in rec["cache"]
+
+        # identical traffic against the same disk store, fresh process:
+        # pure cache hits, executor never touched
+        ex2 = FoldExecutor(*model_and_params)
+        cache2 = FoldCache(disk_dir=str(tmp_path / "store"))
+        with Scheduler(ex2, policy, cfg, cache=cache2,
+                       model_tag="e2e") as sched2:
+            replay = [sched2.submit(FoldRequest(seq=u.seq, msa=u.msa))
+                      .result(timeout=60) for u in uniq]
+        assert all(r.ok and r.source == "cache" for r in replay)
+        assert ex2.stats()["misses"] == 0
+
+
+class TestFoldAndWriteCache:
+    def test_second_call_skips_fold(self, model_and_params, tmp_path,
+                                    monkeypatch):
+        model, params = model_and_params
+        n, msa_d = 16, MSA_DEPTH
+        rng = np.random.default_rng(5)
+        seq = jnp.asarray(rng.integers(0, 20, (2, n)), jnp.int32)
+        msa = jnp.asarray(rng.integers(0, 20, (2, msa_d, n)), jnp.int32)
+        mask = np.ones((2, n), bool)
+        mask[1, 12:] = False                   # ragged second element
+        kwargs = dict(msa=msa, mask=jnp.asarray(mask),
+                      msa_mask=jnp.ones((2, msa_d, n), bool),
+                      num_recycles=0)
+
+        calls = []
+        real_fold = predict.fold
+
+        def counting_fold(*a, **kw):
+            calls.append(1)
+            return real_fold(*a, **kw)
+
+        monkeypatch.setattr(predict, "fold", counting_fold)
+        cache = FoldCache()
+        out = str(tmp_path / "out.pdb")
+        paths = predict.fold_and_write(model, params, seq, out,
+                                       cache=cache, model_tag="w",
+                                       **kwargs)
+        assert len(paths) == 2 and all(os.path.exists(p) for p in paths)
+        assert len(calls) == 1 and cache.stats.puts == 2
+
+        paths2 = predict.fold_and_write(model, params, seq, out,
+                                        cache=cache, model_tag="w",
+                                        **kwargs)
+        assert len(calls) == 1                 # memoized: no second fold
+        assert paths2 == paths
+        assert cache.stats.hits == 2
+        # identical content on the cached rewrite
+        for p in paths:
+            assert os.path.getsize(p) > 0
+
+    def test_msa_mask_separates(self, model_and_params, tmp_path):
+        """Two calls differing only in msa_mask are different
+        computations and must not share a cache entry."""
+        model, params = model_and_params
+        n, msa_d = 16, MSA_DEPTH
+        rng = np.random.default_rng(6)
+        seq = jnp.asarray(rng.integers(0, 20, (1, n)), jnp.int32)
+        msa = jnp.asarray(rng.integers(0, 20, (1, msa_d, n)), jnp.int32)
+        mm1 = np.ones((1, msa_d, n), bool)
+        mm2 = mm1.copy()
+        mm2[0, 1:] = False                 # mask out deeper rows
+        cache = FoldCache()
+        out = str(tmp_path / "m.pdb")
+        predict.fold_and_write(model, params, seq, out, cache=cache,
+                               msa=msa, msa_mask=jnp.asarray(mm1),
+                               num_recycles=0)
+        predict.fold_and_write(model, params, seq, out, cache=cache,
+                               msa=msa, msa_mask=jnp.asarray(mm2),
+                               num_recycles=0)
+        assert cache.stats.hits == 0 and cache.stats.puts == 2
+
+    def test_offline_entries_cross_hit_the_server(self, model_and_params,
+                                                  tmp_path):
+        """One shared FoldCache: a fold_and_write run (no extras,
+        trivial msa_mask) populates entries the serving scheduler then
+        hits for the same content (msa_depth=None config)."""
+        model, params = model_and_params
+        n, msa_d = 12, MSA_DEPTH
+        rng = np.random.default_rng(8)
+        seq = rng.integers(0, 20, n).astype(np.int32)
+        msa = rng.integers(0, 20, (msa_d, n)).astype(np.int32)
+        cache = FoldCache()
+        predict.fold_and_write(
+            model, params, jnp.asarray(seq[None]),
+            str(tmp_path / "x.pdb"), cache=cache, model_tag="shared",
+            msa=jnp.asarray(msa[None]), mask=jnp.ones((1, n), bool),
+            msa_mask=jnp.ones((1, msa_d, n), bool), num_recycles=0)
+        assert cache.stats.puts == 1
+
+        ex = FoldExecutor(*model_and_params)
+        cfg = SchedulerConfig(max_batch_size=2, max_wait_ms=10.0,
+                              num_recycles=0)          # msa_depth=None
+        with Scheduler(ex, BucketPolicy((16,)), cfg, cache=cache,
+                       model_tag="shared") as sched:
+            resp = sched.submit(
+                FoldRequest(seq=seq, msa=msa)).result(timeout=60)
+        assert resp.ok and resp.source == "cache", (resp.source,
+                                                    resp.error)
+        assert ex.stats()["misses"] == 0               # never folded
+
+    def test_array_extras_disable_caching(self, model_and_params,
+                                          tmp_path, monkeypatch):
+        """An array-valued extra kwarg (batched per-element
+        conditioning) can't be attributed to one element's key — the
+        call must fold uncached, never share entries."""
+        model, params = model_and_params
+        seq = jnp.zeros((1, 8), jnp.int32)
+        calls = []
+
+        def stub_fold(model, params, seq, **kw):
+            calls.append(1)
+            b, n = np.asarray(seq).shape
+
+            class R:
+                coords = np.zeros((b, n, 3), np.float32)
+                confidence = np.zeros((b, n), np.float32)
+
+            return R()
+
+        monkeypatch.setattr(predict, "fold", stub_fold)
+        cache = FoldCache()
+        out = str(tmp_path / "e.pdb")
+        cond = np.arange(2, dtype=np.float32)
+        for _ in range(2):
+            predict.fold_and_write(model, params, seq, out, cache=cache,
+                                   cond=cond, num_recycles=0)
+        assert len(calls) == 2                 # no memoization
+        assert cache.stats.puts == 0 and cache.stats.hits == 0
+        # scalar extras stay cacheable (and separate keys)
+        predict.fold_and_write(model, params, seq, out, cache=cache,
+                               cond_scale=2.0, num_recycles=0)
+        predict.fold_and_write(model, params, seq, out, cache=cache,
+                               cond_scale=2.0, num_recycles=0)
+        assert len(calls) == 3 and cache.stats.hits == 1
+        predict.fold_and_write(model, params, seq, out, cache=cache,
+                               cond_scale=3.0, num_recycles=0)
+        assert len(calls) == 4                 # different scalar, new key
+
+    def test_model_tag_separates(self, model_and_params, tmp_path):
+        model, params = model_and_params
+        seq = jnp.zeros((1, 16), jnp.int32)
+        cache = FoldCache()
+        out = str(tmp_path / "a.pdb")
+        predict.fold_and_write(model, params, seq, out, cache=cache,
+                               model_tag="v1", num_recycles=0)
+        assert cache.stats.hits == 0
+        predict.fold_and_write(model, params, seq, out, cache=cache,
+                               model_tag="v2", num_recycles=0)
+        assert cache.stats.hits == 0           # different weights tag
+        predict.fold_and_write(model, params, seq, out, cache=cache,
+                               model_tag="v1", num_recycles=0)
+        assert cache.stats.hits == 1

@@ -1,6 +1,8 @@
 """Dataset / PDB-IO / relax tests: trrosetta-style loader over synthetic
 on-disk samples, PDB write->parse round trip, and the gradient relaxer."""
 
+import os
+
 import numpy as np
 import pytest
 import jax
@@ -51,10 +53,15 @@ class TestTrRosetta:
         assert "coords" in sample
         assert sample["coords"].shape[1:] == (14, 3)
 
-        # featurized cache written and reused
-        assert (tmp_path / "s0.feat.npz").exists()
+        # featurized cache written (config-digest naming) and reused
+        cache_path = ds._cache_path("s0")
+        assert os.path.exists(cache_path)
         again = ds[0]
         assert np.array_equal(again["seq"], sample["seq"])
+        # a different featurize config names a different cache file:
+        # stale features can never be served across configs
+        assert TrRosettaDataset(
+            str(tmp_path), max_msa_rows=7)._cache_path("s0") != cache_path
 
         dm = TrRosettaDataModule(str(tmp_path), crop_len=16, batch_size=2,
                                  max_msa_rows=3)

@@ -165,9 +165,15 @@ class TestStatsSatellites:
                        real_tokens=8, padding_waste=0.75,
                        batch_latency_s=0.5, queue_depth=1)
         m.record_shed()
+        m.record_cache_hit()
+        m.record_cache_miss()
+        m.record_coalesced()
         snap = m.snapshot()
         assert snap["enqueued"] == 1 and snap["served"] == 1
         assert snap["shed"] == 1 and snap["batches"] == 1
+        # cache section always present (zeros when caching is off)
+        assert snap["cache"] == {"hits": 1, "misses": 1, "coalesced": 1,
+                                 "hit_ratio": 0.5}
         assert snap["padding_waste"] == pytest.approx(1 - 8 / 32)
         assert snap["latency_by_bucket"]["16"]["p99_s"] == \
             pytest.approx(0.5)
@@ -264,6 +270,23 @@ class TestScheduler:
         snap = metrics.snapshot()
         assert snap["rejected"] == 1 and snap["cancelled"] == 1
         assert ex.stats()["misses"] == 0
+
+    def test_metrics_sink_failure_does_not_kill_scheduler(
+            self, model_and_params):
+        """A failing JSONL sink (disk full) is an observability problem,
+        not a serving outage: requests keep resolving ok."""
+        class BoomMetrics(ServeMetrics):
+            def record_batch(self, *a, **kw):
+                raise OSError("disk full")
+
+        ex = FoldExecutor(*model_and_params)
+        config = SchedulerConfig(max_batch_size=2, max_wait_ms=10.0,
+                                 num_recycles=0)
+        with Scheduler(ex, BucketPolicy((16,)), config,
+                       BoomMetrics()) as sched:
+            r1 = sched.submit(requests_of((8,))[0]).result(timeout=600)
+            r2 = sched.submit(requests_of((12,))[0]).result(timeout=600)
+        assert r1.ok and r2.ok
 
     def test_submit_before_start_rejected(self, model_and_params):
         sched = Scheduler(FoldExecutor(*model_and_params),

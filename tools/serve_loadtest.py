@@ -12,8 +12,19 @@ small.
 Prints ONE JSON line:
   {"folds_per_hour": N, "padding_waste": F, "shed": 0, ...}
 
+`--dup-rate F` makes fraction F of submissions repeats of earlier
+sequences with a Zipf-ish popularity skew (rank r re-requested with
+weight 1/(r+1) — the head-heavy shape of real serving traffic per
+ParaFold's workload analysis). `--cache {auto,on,off}` controls the
+content-addressed result cache + in-flight coalescing (auto = on iff
+dup-rate > 0); the report then carries the cache section (hit ratio,
+coalesced count) and `executor_calls_avoided` — requests that never
+occupied the accelerator — next to folds/hour and padding waste.
+
 `--smoke` (tools/serve_smoke.sh) exits 1 on ANY shed / timeout / error /
-rejected request at trivial load — the serving regression tripwire.
+rejected request at trivial load — the serving regression tripwire. With
+a duplicated workload (`--dup-rate` > 0, cache on) it additionally fails
+when the cache never hits or any coalesced ticket fails to resolve.
 
 Runs on CPU by default (__graft_entry__.force_cpu_fallback); pass
 --platform ambient to target the real chip.
@@ -51,6 +62,15 @@ def parse_args(argv=None):
     ap.add_argument("--num-recycles", type=int, default=0)
     ap.add_argument("--deadline-s", type=float, default=0.0,
                     help="per-request deadline; 0 = none")
+    ap.add_argument("--dup-rate", type=float, default=0.0,
+                    help="fraction of submissions repeating an earlier "
+                         "sequence (Zipf-ish popularity skew)")
+    ap.add_argument("--cache", default="auto",
+                    choices=("auto", "on", "off"),
+                    help="result cache + coalescing; auto = on iff "
+                         "--dup-rate > 0")
+    ap.add_argument("--cache-dir", default="",
+                    help="optional on-disk tier for the result cache")
     ap.add_argument("--dim", type=int, default=32)
     ap.add_argument("--depth", type=int, default=1)
     ap.add_argument("--metrics-path", default="/tmp/serve_loadtest.jsonl")
@@ -99,23 +119,72 @@ def main(argv=None) -> int:
     config = serve.SchedulerConfig(
         max_batch_size=args.max_batch, max_wait_ms=args.max_wait_ms,
         num_recycles=args.num_recycles, msa_depth=args.msa_depth)
-    scheduler = serve.Scheduler(executor, policy, config, metrics)
+    cache_on = args.cache == "on" or (args.cache == "auto"
+                                      and args.dup_rate > 0)
+    cache = None
+    if cache_on:
+        cache = serve.FoldCache(disk_dir=args.cache_dir or None)
+    scheduler = serve.Scheduler(executor, policy, config, metrics,
+                                cache=cache, model_tag="serve_loadtest")
 
     warmup_timer = StepTimer()
     with warmup_timer.measure():
         compiles = scheduler.warmup()
     scheduler.start()
 
+    import numpy as np
+
     deadline_s = args.deadline_s or None
+    # duration-mode cache runs need unique headroom: a 64-prototype pool
+    # under a 4096-entry schedule would force-duplicate almost every
+    # submission regardless of --dup-rate. The report's
+    # unique_requests/requests ratio is the effective duplicate rate.
+    pool_n = max(args.requests, 64)
+    if args.duration_s > 0 and (args.cache == "on" or args.dup_rate > 0):
+        pool_n = max(pool_n, 1024)
     pool = synthetic_requests(
-        jax.random.PRNGKey(1), num=max(args.requests, 64),
+        jax.random.PRNGKey(1), num=pool_n,
         lengths=lengths, msa_depth=args.msa_depth, deadline_s=deadline_s)
+
+    # submission schedule over prototype indices: with --dup-rate, a
+    # submission repeats an ALREADY-USED prototype with probability
+    # dup_rate, picking it Zipf-ishly (first-seen rank r with weight
+    # 1/(r+1)) — duplicates are exact (same seq AND msa), so they are
+    # cache/coalesce candidates. dup_rate=0 degenerates to the old
+    # round-robin over unique prototypes.
+    sched_rng = np.random.default_rng(2)
+    schedule_len = args.requests if args.duration_s <= 0 else 4096
+    schedule, used = [], []
+    fresh_i = 0
+
+    def zipf_pick():
+        w = 1.0 / (np.arange(len(used)) + 1.0)
+        return used[int(sched_rng.choice(len(used), p=w / w.sum()))]
+
+    for _ in range(max(schedule_len, 1)):
+        if used and sched_rng.random() < args.dup_rate:
+            j = zipf_pick()
+        elif fresh_i < len(pool):
+            j = fresh_i
+            fresh_i += 1
+            used.append(j)
+        elif args.dup_rate > 0:
+            # unique budget exhausted on a duplicate-heavy run: an
+            # explicit Zipf repeat, keeping `used` duplicate-free so the
+            # 1/(rank+1) weights stay meaningful
+            j = zipf_pick()
+        else:
+            # dup_rate=0: plain round-robin over the pool, exactly the
+            # pre-cache behavior (no popularity skew in baselines)
+            j = fresh_i % len(pool)
+            fresh_i += 1
+        schedule.append(j)
+
     failures = []
     lock = threading.Lock()
     counter = [0]
 
     def run_submitter(stop_at, budget):
-        import numpy as np
         while True:
             with lock:
                 i = counter[0]
@@ -123,7 +192,7 @@ def main(argv=None) -> int:
                         (budget and i >= budget):
                     return
                 counter[0] = i + 1
-            req_proto = pool[i % len(pool)]
+            req_proto = pool[schedule[i % len(schedule)]]
             req = serve.FoldRequest(seq=req_proto.seq, msa=req_proto.msa,
                                     deadline_s=deadline_s)
             try:
@@ -155,26 +224,43 @@ def main(argv=None) -> int:
     scheduler.stop()
 
     snap = scheduler.serve_stats()
+    total = counter[0]
+    cache_snap = snap["cache"]
+    avoided = cache_snap["hits"] + cache_snap["coalesced"]
     report = {
         "metric": "serve_loadtest",
         "platform": args.platform,
         "folds_per_hour": round(snap["served"] / serving_wall * 3600.0, 1),
+        "requests_per_hour": round(total / serving_wall * 3600.0, 1),
         "serving_wall_s": round(serving_wall, 3),
         "warmup_s": round(warmup_timer.mean * warmup_timer.count, 3),
         "compiles": compiles,
         "bucket_edges": snap["bucket_edges"],
         "padding_waste": round(snap["padding_waste"], 4),
+        "requests": total,
+        "unique_requests": len({schedule[i % len(schedule)]
+                                for i in range(total)}),
+        "dup_rate": args.dup_rate,
         "served": snap["served"],
         "shed": snap["shed"],
         "errors": snap["errors"],
         "rejected": snap["rejected"],
         "batches": snap["batches"],
+        "cache_enabled": cache_on,
+        "cache_hit_ratio": round(cache_snap["hit_ratio"], 4),
+        "coalesced": cache_snap["coalesced"],
+        "executor_calls_avoided": avoided,
         "latency_by_bucket": snap["latency_by_bucket"],
         "executor": {k: snap["executor"][k]
                      for k in ("hits", "misses", "evictions")},
         "metrics_path": args.metrics_path,
         "failures": failures[:8],
     }
+    if cache_on:
+        report["cache_store"] = {
+            k: cache_snap["store"][k]
+            for k in ("hits", "misses", "disk_hits", "disk_errors",
+                      "evictions", "bytes_resident", "entries_resident")}
     metrics.close()
     print(json.dumps(report))
 
@@ -185,7 +271,18 @@ def main(argv=None) -> int:
             print(f"SMOKE FAIL: {bad} bad outcomes, "
                   f"{snap['served']} served", file=sys.stderr)
             return 1
-        print(f"SMOKE OK: {snap['served']} folds, 0 shed/errors",
+        if cache_on and args.dup_rate > 0 and cache_snap["hits"] == 0:
+            # a duplicated workload that never hits the store means the
+            # cache subsystem is broken (every ticket still resolved:
+            # coalesced-only would show up here as hits == 0)
+            print(f"SMOKE FAIL: dup-rate {args.dup_rate} workload with "
+                  f"0 cache hits ({cache_snap['coalesced']} coalesced)",
+                  file=sys.stderr)
+            return 1
+        extra = (f", {cache_snap['hits']} cache hits, "
+                 f"{cache_snap['coalesced']} coalesced"
+                 if cache_on else "")
+        print(f"SMOKE OK: {snap['served']} folds, 0 shed/errors{extra}",
               file=sys.stderr)
     return 0
 

@@ -1,22 +1,29 @@
 #!/usr/bin/env bash
-# Serving smoke: 30-second CPU load test over the serve.Scheduler with
-# synthetic mixed-length requests. FAILS (exit 1) on any shed, timeout,
-# error, or rejected request at this trivial load — the serving
-# regression tripwire. Invoked standalone from the test-tier docs
-# (README "Tests"); tests/test_serve.py covers the same path in-process
-# under `-m 'not slow'`.
+# Serving smoke, two phases over the serve.Scheduler on CPU:
+#
+#   1. 30-second mixed-length load test. FAILS (exit 1) on any shed,
+#      timeout, error, or rejected request at this trivial load — the
+#      serving regression tripwire.
+#   2. duplicated workload (--dup-rate 0.5, result cache on). FAILS if
+#      the cache never hits, any coalesced ticket deadlocks/times out,
+#      or any request sheds/errors — the dedup-subsystem tripwire
+#      (serve_loadtest.py --smoke enforces all of it in-process).
+#
+# Invoked standalone from the test-tier docs (README "Tests");
+# tests/test_serve.py + tests/test_cache.py cover the same paths
+# in-process under `-m 'not slow'`.
 #
 #   bash tools/serve_smoke.sh            # default 30s serving window
 #   SMOKE_DURATION_S=10 bash tools/serve_smoke.sh
 #
-# The overall timeout leaves headroom for the cold per-bucket compiles
+# The overall timeouts leave headroom for the cold per-bucket compiles
 # (warmup is excluded from the serving window but not from wall clock).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 DURATION="${SMOKE_DURATION_S:-30}"
 
-exec timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     python tools/serve_loadtest.py \
     --smoke \
     --duration-s "$DURATION" \
@@ -28,3 +35,18 @@ exec timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     --deadline-s 120 \
     --num-recycles 0 \
     --metrics-path /tmp/serve_smoke.jsonl
+
+exec timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/serve_loadtest.py \
+    --smoke \
+    --requests 48 \
+    --dup-rate 0.5 \
+    --cache on \
+    --lengths 24,48 \
+    --buckets 32,64 \
+    --msa-depth 3 \
+    --max-batch 2 \
+    --concurrency 2 \
+    --deadline-s 120 \
+    --num-recycles 0 \
+    --metrics-path /tmp/serve_smoke_dup.jsonl
