@@ -14,7 +14,15 @@ MUST call `settle(key)` exactly once and resolve every returned
 follower — including failure propagation, because a follower that
 attached to a leader that then errored must see that error, not hang.
 The registry stores opaque follower objects and never touches them;
-policy (what response a follower gets) stays with the owner.
+policy (what response a follower gets) stays with the owner — including
+follower-deadline policy: `evict_followers(predicate)` lets the owner
+pull out parked followers whose own deadline expired and shed them with
+their own terminal state instead of inheriting the leader's timing.
+
+`attach` also records the leader object, so a follower's request trace
+can link to the leader's trace (`attach_with_leader`). Lifetime
+counters mirror into the process metrics registry
+(`coalesce_leaders_total` / `coalesce_followers_total`).
 
 Thread-safe; attach/settle are O(1) dict ops under one lock, safe on
 the submit hot path.
@@ -23,38 +31,90 @@ the submit hot path.
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from alphafold2_tpu.obs.registry import MetricsRegistry, get_registry
 
 
 class InflightRegistry:
     """Tracks keys with work in flight and the followers awaiting them."""
 
-    def __init__(self):
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
         self._lock = threading.Lock()
         self._followers: Dict[str, List[Any]] = {}
+        self._leader_objs: Dict[str, Any] = {}
         self.leaders = 0               # lifetime counters, lock-guarded
         self.coalesced = 0
+        reg = registry or get_registry()
+        self._m_leaders = reg.counter(
+            "coalesce_leaders_total", "keys that started an in-flight fold")
+        self._m_followers = reg.counter(
+            "coalesce_followers_total",
+            "submissions parked behind an in-flight leader")
 
     def attach(self, key: str, follower: Any) -> bool:
         """Returns True if the caller is the leader for `key` (it must do
         the work and later settle); False if `follower` was recorded
         behind an existing leader."""
+        return self.attach_with_leader(key, follower)[0]
+
+    def attach_with_leader(self, key: str, follower: Any,
+                           on_follower: Optional[
+                               Callable[[Any], None]] = None,
+                           ) -> Tuple[bool, Optional[Any]]:
+        """attach(), but also returns the current leader object (None
+        when the caller just became it). `on_follower(leader)` runs
+        UNDER the registry lock when the caller was recorded as a
+        follower — settle()/evict_followers() cannot interleave, so
+        follower bookkeeping (e.g. linking its trace to the leader's)
+        is guaranteed to land before any settlement can resolve it.
+        Keep the callback O(1); it sits on the submit hot path."""
         with self._lock:
             waiting = self._followers.get(key)
             if waiting is None:
                 self._followers[key] = []
+                self._leader_objs[key] = follower
                 self.leaders += 1
-                return True
-            waiting.append(follower)
-            self.coalesced += 1
-            return False
+                leader = None
+                is_leader = True
+            else:
+                leader = self._leader_objs.get(key)
+                if on_follower is not None:
+                    on_follower(leader)
+                waiting.append(follower)
+                self.coalesced += 1
+                is_leader = False
+        if is_leader:
+            self._m_leaders.inc()
+        else:
+            self._m_followers.inc()
+        return is_leader, leader
 
     def settle(self, key: str) -> List[Any]:
         """Close out `key`: the leader's work reached a terminal state
         (success OR failure). Returns the followers to resolve; after
         this, the next attach of `key` starts a fresh leader."""
         with self._lock:
+            self._leader_objs.pop(key, None)
             return self._followers.pop(key, [])
+
+    def evict_followers(self,
+                        predicate: Callable[[Any], bool]) -> List[Any]:
+        """Remove and return every parked follower matching `predicate`
+        (e.g. its own deadline expired while the leader is still in
+        flight). The evicted followers no longer count in `waiting()`
+        and will NOT be returned by a later settle() — the caller owns
+        resolving them."""
+        evicted: List[Any] = []
+        with self._lock:
+            for key, waiting in self._followers.items():
+                if not waiting:
+                    continue
+                keep = []
+                for f in waiting:
+                    (evicted if predicate(f) else keep).append(f)
+                self._followers[key] = keep
+        return evicted
 
     def inflight(self) -> int:
         with self._lock:

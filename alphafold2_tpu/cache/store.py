@@ -28,6 +28,9 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from alphafold2_tpu.obs.registry import MetricsRegistry, get_registry
+from alphafold2_tpu.obs.trace import NULL_TRACE
+
 _QUARANTINE_SUFFIX = ".quarantined"
 
 
@@ -44,19 +47,29 @@ class CachedFold:
 
 
 class CacheStats:
-    """Thread-safe counters for every cache outcome."""
+    """Thread-safe counters for every cache outcome.
+
+    Every bump is mirrored into the process-wide metrics registry
+    (`fold_cache_events_total{event=...}`), so all FoldCache instances
+    in a process add up under one Prometheus series while each
+    instance's `snapshot()` stays its own."""
 
     FIELDS = ("hits", "misses", "puts", "evictions", "expirations",
               "disk_hits", "disk_errors")
 
-    def __init__(self):
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
         self._lock = threading.Lock()
         for f in self.FIELDS:
             setattr(self, f, 0)
+        self._m_events = (registry or get_registry()).counter(
+            "fold_cache_events_total",
+            "result-store outcomes across all FoldCache instances",
+            ("event",))
 
     def bump(self, field: str, n: int = 1):
         with self._lock:
             setattr(self, field, getattr(self, field) + n)
+        self._m_events.inc(n, event=field)
 
     @property
     def hit_ratio(self) -> float:
@@ -91,7 +104,8 @@ class FoldCache:
     def __init__(self, max_bytes: int = 256 << 20, max_entries: int = 4096,
                  ttl_s: Optional[float] = None,
                  disk_dir: Optional[str] = None,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 registry: Optional[MetricsRegistry] = None):
         if max_bytes < 0 or max_entries < 0:
             raise ValueError("max_bytes and max_entries must be >= 0")
         self.max_bytes = int(max_bytes)
@@ -102,7 +116,14 @@ class FoldCache:
         self._lock = threading.Lock()
         self._mem: "OrderedDict[str, _Entry]" = OrderedDict()
         self._bytes = 0
-        self.stats = CacheStats()
+        reg = registry or get_registry()
+        self.stats = CacheStats(registry=reg)
+        self._m_bytes = reg.gauge(
+            "fold_cache_bytes_resident",
+            "memory-tier resident bytes (last-reporting store)")
+        self._m_entries = reg.gauge(
+            "fold_cache_entries_resident",
+            "memory-tier resident entries (last-reporting store)")
         if disk_dir:
             os.makedirs(disk_dir, exist_ok=True)
 
@@ -118,6 +139,8 @@ class FoldCache:
                 del self._mem[key]
                 self._bytes -= entry.value.nbytes
                 self.stats.bump("expirations")
+                self._m_bytes.set(self._bytes)
+                self._m_entries.set(len(self._mem))
                 return None
             self._mem.move_to_end(key)
             return entry.value
@@ -145,20 +168,23 @@ class FoldCache:
                 _, evicted = self._mem.popitem(last=False)
                 self._bytes -= evicted.value.nbytes
                 self.stats.bump("evictions")
+            self._m_bytes.set(self._bytes)
+            self._m_entries.set(len(self._mem))
 
     # -- disk tier -------------------------------------------------------
 
     def _path(self, key: str) -> str:
         return os.path.join(self.disk_dir, key[:2], f"{key}.npz")
 
-    def _quarantine(self, path: str):
+    def _quarantine(self, path: str, trace=NULL_TRACE):
         self.stats.bump("disk_errors")
+        trace.event("cache_quarantine")
         try:
             os.replace(path, path + _QUARANTINE_SUFFIX)
         except OSError:
             pass                       # racing quarantiners: either wins
 
-    def _disk_get(self, key: str):
+    def _disk_get(self, key: str, trace=NULL_TRACE):
         """Returns (value, expires_at) or None."""
         path = self._path(key)
         try:
@@ -188,7 +214,7 @@ class FoldCache:
                     != (value.coords.shape[0],)):
                 raise ValueError(f"cache entry {key} fails validation")
         except Exception:              # unreadable/garbage/wrong entry
-            self._quarantine(path)
+            self._quarantine(path, trace)
             return None
         return value, expires_at
 
@@ -211,19 +237,26 @@ class FoldCache:
 
     # -- public API ------------------------------------------------------
 
-    def get(self, key: str) -> Optional[CachedFold]:
-        """Lookup; never raises. Disk hits are promoted into memory."""
+    def get(self, key: str, trace=NULL_TRACE) -> Optional[CachedFold]:
+        """Lookup; never raises. Disk hits are promoted into memory.
+        `trace` (obs.Trace; zero-cost NULL_TRACE default) receives
+        cache_hit / cache_miss / cache_quarantine events so a request
+        trace shows where its result came from."""
         value = self._mem_get(key)
+        tier = "memory"
         if value is None and self.disk_dir:
-            hit = self._disk_get(key)
+            hit = self._disk_get(key, trace)
             if hit is not None:
                 value, expires_at = hit
+                tier = "disk"
                 self.stats.bump("disk_hits")
                 self._mem_put(key, value, expires_at=expires_at)
         if value is None:
             self.stats.bump("misses")
+            trace.event("cache_miss")
             return None
         self.stats.bump("hits")
+        trace.event("cache_hit", tier=tier)
         return value
 
     def put(self, key: str, coords, confidence) -> CachedFold:

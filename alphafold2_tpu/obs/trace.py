@@ -1,0 +1,380 @@
+"""Request-scoped tracing: follow one fold from submit to terminal.
+
+A single slow request in the serving stack crosses four components —
+`Scheduler.submit` (cache lookup, coalescing, backpressure wait), the
+pending queue, `FoldExecutor` (XLA compile vs device run), and
+`FoldCache` writeback — each previously with its own uncoordinated
+timing. A `Trace` is the per-request record that stitches them: named
+spans (intervals), point events (cache hit/miss/quarantine,
+coalescing), a link to a coalescing leader's trace, and exactly one
+terminal `finish()`.
+
+Design constraints, in priority order:
+
+- zero cost when disabled: `NULL_TRACER.start_trace()` returns the
+  `NULL_TRACE` singleton whose every method is a no-op and whose
+  `span()` is one shared reusable context manager — no allocation, no
+  string formatting, nothing on the hot path;
+- spans cross threads (submit happens on the caller's thread, queue →
+  fold → writeback on the scheduler worker), so in addition to the
+  `span()` context manager there are explicit `begin(name)`/`end(name)`
+  for stage handoffs and `add_span(name, t0, t1)` for batch-level spans
+  recorded once and fanned out to every member trace (`MultiTrace`);
+- `finish()` is idempotent and auto-closes any still-open span (marked
+  `auto_closed`) so every terminal path — ok, cache hit, coalesced,
+  shed, error, cancelled, worker crash — yields exactly one complete
+  record, never an orphan;
+- completed traces are emitted as one JSONL record each (`"schema": 1`,
+  spans with offsets relative to trace start) and the K slowest are
+  kept in a ring the scheduler exposes via `serve_stats()["traces"]`.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import json
+import os
+import threading
+import time
+from typing import IO, List, Optional
+
+# the one schema tag every observability record carries (obs/export.py)
+from alphafold2_tpu.obs.export import SCHEMA_VERSION
+
+_trace_counter = itertools.count()
+
+
+class _NullContext:
+    """Reusable no-op context manager (one instance per process)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CTX = _NullContext()
+
+
+class _NullTrace:
+    """Do-nothing stand-in so instrumented code never branches."""
+
+    __slots__ = ()
+    enabled = False
+    trace_id = ""
+
+    def begin(self, name):
+        pass
+
+    def end(self, name, **attrs):
+        pass
+
+    def span(self, name, **attrs):
+        return _NULL_CTX
+
+    def add_span(self, name, t0, t1, **attrs):
+        pass
+
+    def event(self, name, **attrs):
+        pass
+
+    def link(self, leader_trace_id):
+        pass
+
+    def finish(self, status, source="fold", error=None):
+        pass
+
+    @property
+    def finished(self):
+        return False
+
+
+NULL_TRACE = _NullTrace()
+
+
+class _SpanContext:
+    __slots__ = ("_trace", "_name", "_attrs", "_t0")
+
+    def __init__(self, trace, name, attrs):
+        self._trace = trace
+        self._name = name
+        self._attrs = attrs
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._trace.add_span(self._name, self._t0, time.monotonic(),
+                             **self._attrs)
+        return False
+
+
+class Trace:
+    """One request's span tree. Thread-safe; finish() is idempotent."""
+
+    __slots__ = ("trace_id", "request_id", "leader_trace_id", "status",
+                 "source", "error", "_tracer", "_lock", "_t0", "_t0_unix",
+                 "_end", "_spans", "_events", "_open", "_finished")
+
+    enabled = True
+
+    def __init__(self, tracer: "Tracer", request_id: str):
+        self.trace_id = f"t{next(_trace_counter)}"
+        self.request_id = request_id
+        self.leader_trace_id: Optional[str] = None
+        self.status: Optional[str] = None
+        self.source = "fold"
+        self.error: Optional[str] = None
+        self._tracer = tracer
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._t0_unix = time.time()
+        self._end: Optional[float] = None
+        self._spans: List[dict] = []
+        self._events: List[dict] = []
+        self._open: dict = {}          # name -> start (monotonic)
+        self._finished = False
+
+    # -- spans / events --------------------------------------------------
+
+    def begin(self, name: str):
+        """Open a span that a different thread may close (stage handoff)."""
+        now = time.monotonic()
+        with self._lock:
+            if not self._finished:
+                self._open[name] = now
+
+    def end(self, name: str, **attrs):
+        """Close a `begin()` span. Tolerant: unknown name is a no-op (the
+        race where a worker resolves an entry while submit's bookkeeping
+        is mid-flight must never raise into serving)."""
+        now = time.monotonic()
+        with self._lock:
+            t0 = self._open.pop(name, None)
+            if t0 is None or self._finished:
+                return
+            self._append_span(name, t0, now, attrs)
+
+    def span(self, name: str, **attrs) -> _SpanContext:
+        """Same-thread scope: `with trace.span("fold"): ...`."""
+        return _SpanContext(self, name, attrs)
+
+    def add_span(self, name: str, t0: float, t1: float, **attrs):
+        """Record a finished interval (batch-level spans measured once
+        and fanned out to every member trace)."""
+        with self._lock:
+            if not self._finished:
+                self._append_span(name, t0, t1, attrs)
+
+    def _append_span(self, name, t0, t1, attrs):
+        """Caller holds self._lock."""
+        span = {"name": name,
+                "start_s": round(t0 - self._t0, 6),
+                "dur_s": round(max(t1 - t0, 0.0), 6)}
+        if attrs:
+            span["attrs"] = attrs
+        self._spans.append(span)
+
+    def event(self, name: str, **attrs):
+        now = time.monotonic()
+        with self._lock:
+            if self._finished:
+                return
+            ev = {"name": name, "at_s": round(now - self._t0, 6)}
+            if attrs:
+                ev["attrs"] = attrs
+            self._events.append(ev)
+
+    def link(self, leader_trace_id: str):
+        """Follower -> leader edge (coalesced requests)."""
+        with self._lock:
+            self.leader_trace_id = leader_trace_id
+
+    # -- terminal --------------------------------------------------------
+
+    @property
+    def finished(self) -> bool:
+        with self._lock:
+            return self._finished
+
+    def finish(self, status: str, source: str = "fold",
+               error: Optional[str] = None):
+        """Terminal state; first call wins, later calls are no-ops.
+        Auto-closes open spans so a trace can never leak an orphan."""
+        now = time.monotonic()
+        with self._lock:
+            if self._finished:
+                return
+            self._finished = True
+            self.status = status
+            self.source = source
+            self.error = error
+            self._end = now
+            for name, t0 in sorted(self._open.items(), key=lambda kv: kv[1]):
+                self._append_span(name, t0, now, {"auto_closed": True})
+            self._open.clear()
+            record = self._record_locked()
+        self._tracer._on_finish(record)
+
+    def _record_locked(self) -> dict:
+        record = {
+            "schema": SCHEMA_VERSION,
+            "trace_id": self.trace_id,
+            "request_id": self.request_id,
+            "status": self.status,
+            "source": self.source,
+            "start_unix_s": round(self._t0_unix, 6),
+            "duration_s": round((self._end or self._t0) - self._t0, 6),
+            "spans": list(self._spans),
+            "events": list(self._events),
+        }
+        if self.leader_trace_id is not None:
+            record["leader_trace_id"] = self.leader_trace_id
+        if self.error:
+            record["error"] = str(self.error)
+        return record
+
+    def record(self) -> dict:
+        """Snapshot of the (possibly unfinished) trace."""
+        with self._lock:
+            return self._record_locked()
+
+
+class MultiTrace:
+    """Fan one measurement out to many traces (a batch's members).
+
+    The interval is measured ONCE (one clock read per edge) and appended
+    to each member, so per-request cost stays O(1) appends."""
+
+    __slots__ = ("_traces",)
+
+    enabled = True
+
+    def __init__(self, traces):
+        self._traces = [t for t in traces if t.enabled]
+
+    def span(self, name, **attrs):
+        return _MultiSpanContext(self._traces, name, attrs)
+
+    def add_span(self, name, t0, t1, **attrs):
+        for t in self._traces:
+            t.add_span(name, t0, t1, **attrs)
+
+    def event(self, name, **attrs):
+        for t in self._traces:
+            t.event(name, **attrs)
+
+
+class _MultiSpanContext:
+    __slots__ = ("_traces", "_name", "_attrs", "_t0")
+
+    def __init__(self, traces, name, attrs):
+        self._traces = traces
+        self._name = name
+        self._attrs = attrs
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.monotonic()
+        for t in self._traces:
+            t.add_span(self._name, self._t0, t1, **self._attrs)
+        return False
+
+
+class _NullTracer:
+    __slots__ = ()
+    enabled = False
+
+    def start_trace(self, request_id):
+        return NULL_TRACE
+
+    def slowest(self):
+        return []
+
+    def _on_finish(self, record):
+        pass
+
+    def close(self):
+        pass
+
+
+NULL_TRACER = _NullTracer()
+
+
+class Tracer:
+    """Trace factory + sink: JSONL emission and a slowest-K ring.
+
+    jsonl_path: append one record per completed trace (schema above);
+        None disables the file sink (the ring still works).
+    slow_k: how many slowest completed traces to retain for
+        `serve_stats()["traces"]` / `slowest()`.
+    """
+
+    enabled = True
+
+    def __init__(self, jsonl_path: Optional[str] = None, slow_k: int = 16):
+        self._lock = threading.Lock()
+        self._fh: Optional[IO] = None
+        if jsonl_path:
+            d = os.path.dirname(os.path.abspath(jsonl_path))
+            os.makedirs(d, exist_ok=True)
+            self._fh = open(jsonl_path, "a")
+        self.slow_k = max(0, int(slow_k))
+        self._seq = itertools.count()   # heap tie-break, never compares dicts
+        self._slow: list = []           # min-heap of (duration, seq, record)
+        self.completed = 0
+
+    def start_trace(self, request_id: str) -> Trace:
+        return Trace(self, request_id)
+
+    def _on_finish(self, record: dict):
+        # serialize OUTSIDE the lock: finish() runs on the serving
+        # resolve path, and every completing request contends on this
+        # one lock with serve_stats()
+        try:
+            line = json.dumps(record) if self._fh is not None else None
+        except Exception:
+            line = None     # unserializable span attr: keep the ring
+        try:
+            with self._lock:
+                self.completed += 1
+                if self.slow_k:
+                    item = (record["duration_s"], next(self._seq), record)
+                    if len(self._slow) < self.slow_k:
+                        heapq.heappush(self._slow, item)
+                    elif item[0] > self._slow[0][0]:
+                        heapq.heapreplace(self._slow, item)
+                if line is not None and self._fh is not None:
+                    self._fh.write(line + "\n")
+                    self._fh.flush()
+        except Exception:
+            pass        # the trace sink is observability, not serving
+
+    def slowest(self) -> List[dict]:
+        """Completed traces, slowest first."""
+        with self._lock:
+            return [rec for _, _, rec in
+                    sorted(self._slow, key=lambda it: -it[0])]
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.flush()
+                    self._fh.close()
+                finally:
+                    self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -87,7 +87,7 @@ def fold(
 
 
 def fold_and_write(model, params, seq, out_path: str, cache=None,
-                   model_tag: str = "", **kwargs) -> list:
+                   model_tag: str = "", tracer=None, **kwargs) -> list:
     """fold() + PDB output of the CA trace (data/pdb_io.coords2pdb).
 
     Folds the whole (b, n) batch in ONE forward pass and writes one PDB
@@ -111,7 +111,27 @@ def fold_and_write(model, params, seq, out_path: str, cache=None,
     forward pass is skipped only when EVERY element hits (partial
     batches would mint a new compiled shape); partial hits still fold
     once but refresh the store. Off by default.
+
+    tracer: optional `alphafold2_tpu.obs.Tracer` — the call gets one
+    request-scoped trace (cache_lookup / fold / write spans, cache
+    hit/miss events, source "cache" when the forward pass was skipped)
+    in the same JSONL schema the serving scheduler emits, so offline
+    batch folds land in the same `tools/obs_report.py` waterfall.
     """
+    from alphafold2_tpu.obs.trace import NULL_TRACER
+
+    trace = (tracer or NULL_TRACER).start_trace(out_path)
+    try:
+        return _fold_and_write_traced(model, params, seq, out_path, cache,
+                                      model_tag, trace, **kwargs)
+    except BaseException as exc:
+        # every trace reaches exactly one terminal state, failures too
+        trace.finish("error", error=repr(exc))
+        raise
+
+
+def _fold_and_write_traced(model, params, seq, out_path, cache,
+                           model_tag, trace, **kwargs) -> list:
     import os
 
     import numpy as np
@@ -157,43 +177,48 @@ def fold_and_write(model, params, seq, out_path: str, cache=None,
             for _, v in extra)
         if scalar_ok:
             try:
-                keys, cached = [], []
-                for k in range(b):
-                    idx = trim(k)
-                    mm = (None if msa_mask_np is None
-                          else msa_mask_np[k][:, idx])
-                    if mm is not None and mm.all():
-                        mm = None
-                    extras = None if not extra and mm is None \
-                        else (extra, mm)
-                    keys.append(fold_key(
-                        seq_np[k][idx],
-                        None if msa_np is None else msa_np[k][:, idx],
-                        num_recycles=num_recycles, model_tag=model_tag,
-                        extras=extras))
-                    cached.append(cache.get(keys[k]))
+                with trace.span("cache_lookup", batch=b):
+                    keys, cached = [], []
+                    for k in range(b):
+                        idx = trim(k)
+                        mm = (None if msa_mask_np is None
+                              else msa_mask_np[k][:, idx])
+                        if mm is not None and mm.all():
+                            mm = None
+                        extras = None if not extra and mm is None \
+                            else (extra, mm)
+                        keys.append(fold_key(
+                            seq_np[k][idx],
+                            None if msa_np is None else msa_np[k][:, idx],
+                            num_recycles=num_recycles,
+                            model_tag=model_tag, extras=extras))
+                        cached.append(cache.get(keys[k], trace=trace))
             except TypeError:
                 # un-content-hashable extra kwarg: fold uncached rather
                 # than risk serving another call's result
                 keys = cached = None
 
     coords_np = confidence_np = None
-    if cached is None or not all(c is not None for c in cached):
-        result = fold(model, params, seq, **kwargs)
-        coords_np = np.asarray(result.coords)
-        confidence_np = np.asarray(result.confidence)
+    all_hit = cached is not None and all(c is not None for c in cached)
+    if not all_hit:
+        with trace.span("fold", batch=b):
+            result = fold(model, params, seq, **kwargs)
+            coords_np = np.asarray(result.coords)
+            confidence_np = np.asarray(result.confidence)
 
     stem, ext = os.path.splitext(out_path)
     ext = ext or ".pdb"
     paths = []
-    for k in range(b):
-        path = out_path if b == 1 else f"{stem}_{k}{ext}"
-        idx = trim(k)
-        if cached is not None and cached[k] is not None:
-            coords_k = cached[k].coords
-        else:
-            coords_k = coords_np[k][idx]
-            if keys is not None:
-                cache.put(keys[k], coords_k, confidence_np[k][idx])
-        paths.append(coords2pdb(seq_np[k][idx], coords_k, name=path))
+    with trace.span("write", batch=b):
+        for k in range(b):
+            path = out_path if b == 1 else f"{stem}_{k}{ext}"
+            idx = trim(k)
+            if cached is not None and cached[k] is not None:
+                coords_k = cached[k].coords
+            else:
+                coords_k = coords_np[k][idx]
+                if keys is not None:
+                    cache.put(keys[k], coords_k, confidence_np[k][idx])
+            paths.append(coords2pdb(seq_np[k][idx], coords_k, name=path))
+    trace.finish("ok", source="cache" if all_hit else "fold")
     return paths

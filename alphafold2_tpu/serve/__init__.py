@@ -8,6 +8,9 @@ The serving stack, bottom-up:
 - scheduler: Scheduler — dynamic batching, deadlines, backpressure,
              optional result cache + in-flight coalescing
 - metrics:   ServeMetrics — counters, padding waste, latency tails, JSONL
+             (all mirrored into the process-wide obs.MetricsRegistry;
+             pass `Scheduler(..., tracer=obs.Tracer(...))` for
+             request-scoped traces — README "Observability")
 
 `FoldCache` (re-exported from alphafold2_tpu.cache) makes the server
 content-addressed: pass `Scheduler(..., cache=FoldCache(...),
@@ -30,6 +33,8 @@ Minimal use (see README "Serving"):
 """
 
 from alphafold2_tpu.cache import FoldCache, fold_key  # noqa: F401
+from alphafold2_tpu.obs import (MetricsRegistry, Tracer,  # noqa: F401
+                                get_registry, prometheus_text)
 from alphafold2_tpu.serve.bucketing import BucketPolicy, default_policy  # noqa: F401
 from alphafold2_tpu.serve.executor import FoldExecutor  # noqa: F401
 from alphafold2_tpu.serve.metrics import ServeMetrics  # noqa: F401

@@ -1,11 +1,16 @@
 """Compiled-executable cache around `predict.fold`.
 
-One `jax.jit` *instance* per (bucket_len, batch_size, msa_depth,
+One compiled executable per (bucket_len, batch_size, msa_depth,
 num_recycles) key: because the scheduler feeds each key exactly one
-shape signature, each instance holds exactly one compiled executable,
-so LRU-evicting a key actually frees its executable (a single shared
-jit fn would pin every shape it ever saw in its internal cache — no
-eviction handle). On TPU the executables for big buckets are HBM-heavy;
+shape signature, the executor compiles ahead-of-time
+(`jax.jit(...).lower(args).compile()`) and caches the resulting
+`Compiled` object — so LRU-evicting a key actually frees its executable
+(a single shared jit fn would pin every shape it ever saw in its
+internal cache — no eviction handle), and compilation is a separately
+observable phase: `run(..., trace=)` records a `compile` span only when
+a key is built fresh and a `fold` span for the device execution, which
+is how a request trace attributes XLA time vs accelerator time
+(obs/trace.py). On TPU the executables for big buckets are HBM-heavy;
 `max_entries` bounds the resident set and `warmup()` pre-pays compiles
 before traffic arrives instead of on the first unlucky request.
 
@@ -18,11 +23,12 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import Iterable, Optional, Tuple
+from typing import Iterable, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from alphafold2_tpu.obs.trace import NULL_TRACE
 from alphafold2_tpu.predict import FoldResult, fold
 from alphafold2_tpu.serve.bucketing import msa_depth_of
 
@@ -31,7 +37,7 @@ ExecKey = Tuple[int, int, int, int]
 
 
 class FoldExecutor:
-    """LRU cache of jitted fold executables, keyed by shape signature."""
+    """LRU cache of compiled fold executables, keyed by shape signature."""
 
     def __init__(self, model, params, max_entries: int = 8):
         assert model.predict_coords, "serving needs predict_coords=True"
@@ -51,32 +57,62 @@ class FoldExecutor:
 
         return jax.jit(run)
 
-    def _get(self, key: ExecKey):
+    def _compile(self, key: ExecKey, args):
+        """AOT-compile the key's executable OUTSIDE the cache lock (an
+        XLA compile can take seconds; holding the lock would stall
+        concurrent hit lookups) and insert it. Falls back to the lazily
+        compiling jitted callable on JAX versions/paths where AOT
+        lowering refuses the argument structure."""
+        jitted = self._build(key[3])
+        try:
+            fn = jitted.lower(*args).compile()
+        except Exception:
+            fn = jitted          # first call will compile lazily
+        with self._lock:
+            self.misses += 1
+            existing = self._cache.get(key)
+            if existing is not None:
+                # raced with another compiler of the same key: keep the
+                # resident one (both are valid; counters stay honest)
+                self._cache.move_to_end(key)
+                return existing
+            self._cache[key] = fn
+            while len(self._cache) > self.max_entries:
+                self._cache.popitem(last=False)
+                self.evictions += 1
+        return fn
+
+    def _lookup(self, key: ExecKey):
         with self._lock:
             fn = self._cache.get(key)
             if fn is not None:
                 self.hits += 1
                 self._cache.move_to_end(key)
-                return fn
-            self.misses += 1
-            fn = self._build(key[3])
-            self._cache[key] = fn
-            while len(self._cache) > self.max_entries:
-                self._cache.popitem(last=False)
-                self.evictions += 1
             return fn
 
     def key_for(self, batch: dict, num_recycles: int) -> ExecKey:
         b, n = batch["seq"].shape
         return (int(n), int(b), msa_depth_of(batch), int(num_recycles))
 
-    def run(self, batch: dict, num_recycles: int) -> FoldResult:
+    def run(self, batch: dict, num_recycles: int,
+            trace=NULL_TRACE) -> FoldResult:
         """Fold one assembled batch; blocks until device results land so
-        the caller's latency measurement is honest."""
-        fn = self._get(self.key_for(batch, num_recycles))
-        result = fn(self.params, batch["seq"], batch["mask"], batch["msa"],
-                    batch["msa_mask"])
-        return jax.block_until_ready(result)
+        the caller's latency measurement is honest. `trace` (a Trace /
+        MultiTrace; NULL_TRACE default is zero-cost) gets a `compile`
+        span when this signature is built fresh and a `fold` span for
+        the execution itself."""
+        key = self.key_for(batch, num_recycles)
+        args = (self.params, batch["seq"], batch["mask"], batch["msa"],
+                batch["msa_mask"])
+        fn = self._lookup(key)
+        if fn is None:
+            with trace.span("compile", bucket_len=key[0],
+                            batch_size=key[1], msa_depth=key[2],
+                            num_recycles=key[3]):
+                fn = self._compile(key, args)
+        with trace.span("fold", bucket_len=key[0]):
+            result = fn(*args)
+            return jax.block_until_ready(result)
 
     def warmup(self, keys: Iterable[ExecKey],
                timer=None) -> int:

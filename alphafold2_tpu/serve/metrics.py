@@ -1,27 +1,40 @@
 """Serving metrics: counters, queue depth, padding waste, latency tails.
 
-Reuses `utils.logging.MetricsLogger` for the JSONL sink (one record per
+Latency reservoirs are `obs.registry.Histogram` objects (per bucket),
+so p50/p90/p99 here come from the same histogram + single
+`utils.profiling.percentile` quantile path as every other stat in the
+repo — and every recording is mirrored into the process-wide
+`MetricsRegistry` (serve_* counters, gauges, and a bucket-labeled
+latency histogram) so a Prometheus scrape (obs/export.py) sees this
+server next to the cache and the train loop. Reuses
+`utils.logging.MetricsLogger` for the JSONL sink (one record per
 executed batch — queue depth, padding waste, and the current per-bucket
-p50/p90/p99 latency) and `utils.profiling.percentile` for the tail
-stats, so bench and serving report through one stats path. `snapshot()`
-is the health-check view: O(1)-ish, lock-consistent, JSON-serializable.
+p50/p90/p99 latency). `snapshot()` is the health-check view: O(1)-ish,
+lock-consistent, JSON-serializable.
 """
 
 from __future__ import annotations
 
 import threading
-from collections import defaultdict
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
+from alphafold2_tpu.obs.registry import (DEFAULT_LATENCY_BUCKETS, Histogram,
+                                         MetricsRegistry, get_registry)
 from alphafold2_tpu.utils.logging import MetricsLogger
-from alphafold2_tpu.utils.profiling import percentile
 
 
 class ServeMetrics:
-    """Thread-safe serving counters + JSONL emission."""
+    """Thread-safe serving counters + JSONL emission + registry mirror.
+
+    registry: obs.MetricsRegistry to report into (None = the process
+        default). Instance counters/latencies answer `snapshot()` for
+        THIS server; the registry carries the process-wide cumulative
+        view across all servers for exporters.
+    """
 
     def __init__(self, jsonl_path: Optional[str] = None,
-                 stdout: bool = False, max_latencies_per_bucket: int = 4096):
+                 stdout: bool = False, max_latencies_per_bucket: int = 4096,
+                 registry: Optional[MetricsRegistry] = None):
         self._logger = MetricsLogger(jsonl_path, stdout=stdout) \
             if (jsonl_path or stdout) else None
         self._lock = threading.Lock()
@@ -40,8 +53,39 @@ class ServeMetrics:
         self.coalesced = 0          # parked behind an in-flight leader
         self._real_tokens = 0
         self._padded_tokens = 0
-        # per-bucket latency reservoirs (seconds, request-level)
-        self._latencies: Dict[int, List[float]] = defaultdict(list)
+        # per-bucket latency reservoirs (seconds, request-level) —
+        # instance-scoped Histograms answering this server's snapshot()
+        self._latencies: Dict[int, Histogram] = {}
+        # process-wide mirror every recording also lands in
+        reg = registry or get_registry()
+        self._m_enqueued = reg.counter(
+            "serve_enqueued_total", "requests accepted into the queue")
+        self._m_outcomes = reg.counter(
+            "serve_requests_total",
+            "terminal request outcomes by state", ("outcome",))
+        self._m_cache = reg.counter(
+            "serve_cache_events_total",
+            "submit-side result-cache outcomes", ("event",))
+        self._m_batches = reg.counter(
+            "serve_batches_total", "executed batches")
+        self._m_tokens = reg.counter(
+            "serve_tokens_total",
+            "token grid accounting per executed batch", ("kind",))
+        self._m_queue_depth = reg.gauge(
+            "serve_queue_depth", "queued + pending requests")
+        self._m_latency = reg.histogram(
+            "serve_request_latency_seconds",
+            "submit-to-resolve latency of served requests",
+            ("bucket_len",), reservoir=max_latencies_per_bucket)
+
+    def _bucket_hist(self, bucket_len: int) -> Histogram:
+        """Caller holds self._lock."""
+        h = self._latencies.get(bucket_len)
+        if h is None:
+            h = self._latencies[bucket_len] = Histogram(
+                "serve_request_latency_seconds", "per-bucket latency",
+                buckets=DEFAULT_LATENCY_BUCKETS, reservoir=self._max_lat)
+        return h
 
     # -- recording -------------------------------------------------------
 
@@ -49,34 +93,43 @@ class ServeMetrics:
         with self._lock:
             self.enqueued += 1
             self.queue_depth = queue_depth
+        self._m_enqueued.inc()
+        self._m_queue_depth.set(queue_depth)
 
     def record_rejected(self):
         with self._lock:
             self.rejected += 1
+        self._m_outcomes.inc(outcome="rejected")
 
     def record_shed(self, n: int = 1):
         with self._lock:
             self.shed += n
+        self._m_outcomes.inc(n, outcome="shed")
 
     def record_error(self, n: int = 1):
         with self._lock:
             self.errors += n
+        self._m_outcomes.inc(n, outcome="error")
 
     def record_cancelled(self, n: int = 1):
         with self._lock:
             self.cancelled += n
+        self._m_outcomes.inc(n, outcome="cancelled")
 
     def record_cache_hit(self):
         with self._lock:
             self.cache_hits += 1
+        self._m_cache.inc(event="hit")
 
     def record_cache_miss(self):
         with self._lock:
             self.cache_misses += 1
+        self._m_cache.inc(event="miss")
 
     def record_coalesced(self):
         with self._lock:
             self.coalesced += 1
+        self._m_cache.inc(event="coalesced")
 
     def _cache_view(self) -> dict:
         """Caller holds self._lock."""
@@ -88,10 +141,9 @@ class ServeMetrics:
     def record_served(self, bucket_len: int, latency_s: float):
         with self._lock:
             self.served += 1
-            lats = self._latencies[bucket_len]
-            lats.append(latency_s)
-            if len(lats) > self._max_lat:
-                del lats[: len(lats) - self._max_lat]
+            self._bucket_hist(bucket_len).observe(latency_s)
+        self._m_outcomes.inc(outcome="served")
+        self._m_latency.observe(latency_s, bucket_len=bucket_len)
 
     def record_batch(self, bucket_len: int, batch_size: int, n_real: int,
                      real_tokens: int, padding_waste: float,
@@ -107,7 +159,7 @@ class ServeMetrics:
             self.queue_depth = queue_depth
             self._real_tokens += real_tokens
             self._padded_tokens += batch_size * bucket_len
-            lats = self._latencies[bucket_len]
+            lat = self._bucket_hist(bucket_len)
             record = dict(
                 bucket_len=bucket_len,
                 batch_size=batch_size,
@@ -115,9 +167,9 @@ class ServeMetrics:
                 queue_depth=queue_depth,
                 padding_waste=padding_waste,
                 batch_latency_s=batch_latency_s,
-                p50_latency_s=percentile(lats, 50),
-                p90_latency_s=percentile(lats, 90),
-                p99_latency_s=percentile(lats, 99),
+                p50_latency_s=lat.percentile(50),
+                p90_latency_s=lat.percentile(90),
+                p99_latency_s=lat.percentile(99),
             )
             if cache_store is not None:
                 cache = self._cache_view()
@@ -127,6 +179,11 @@ class ServeMetrics:
                 record["cache"] = cache
             step = self.batches
             logger = self._logger
+        self._m_batches.inc()
+        self._m_tokens.inc(real_tokens, kind="real")
+        self._m_tokens.inc(batch_size * bucket_len - real_tokens,
+                           kind="padding")
+        self._m_queue_depth.set(queue_depth)
         if logger is not None:
             try:
                 logger.log(step=step, **record)
@@ -148,11 +205,11 @@ class ServeMetrics:
         """Health-check view: counters + per-bucket latency tails."""
         with self._lock:
             per_bucket = {
-                str(b): {"count": len(lats),
-                         "p50_s": percentile(lats, 50),
-                         "p90_s": percentile(lats, 90),
-                         "p99_s": percentile(lats, 99)}
-                for b, lats in sorted(self._latencies.items())
+                str(b): {"count": h.count(),
+                         "p50_s": h.percentile(50),
+                         "p90_s": h.percentile(90),
+                         "p99_s": h.percentile(99)}
+                for b, h in sorted(self._latencies.items())
             }
             padded = self._padded_tokens
             waste = (1.0 - self._real_tokens / float(padded)) if padded \

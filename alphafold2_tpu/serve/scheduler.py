@@ -27,9 +27,24 @@ closing the attach/settle race. Parked followers count against
 `queue_limit` at attach time, so a duplicate storm is bounded like
 unique traffic (worst-case transient residency is < 2x queue_limit:
 a leader gates its own enqueue on queue depth alone — counting its own
-parked followers there would be a circular wait). Followers inherit
-the leader's timing: their own deadline is not separately enforced
-while parked (cache-aware admission control is a ROADMAP follow-on).
+parked followers there would be a circular wait).
+
+Unlike a leader, a parked follower DOES get its own deadline enforced:
+if it expires while waiting on the leader, the follower is shed with
+its own terminal state (`status="shed"`, reason
+`follower_deadline_exceeded`) instead of inheriting the leader's
+timing — a tight-deadline duplicate must not silently wait out a
+slow leader.
+
+With a `tracer` (alphafold2_tpu.obs.Tracer — NULL_TRACER by default,
+zero-cost no-ops), every submission carries a request-scoped trace
+from submit to its terminal state: `submit` (cache lookup, coalescing,
+backpressure wait), `queue`, `batch_form`, executor `compile`/`fold`
+(batch-level spans fanned out to each member), and `writeback` spans,
+plus cache hit/miss/quarantine and coalescing events; followers link
+to their leader's trace. Completed traces emit as JSONL and the K
+slowest are exposed via `serve_stats()["traces"]`
+(tools/obs_report.py renders the waterfall).
 
 Batches are always padded to `max_batch_size` (bucketing.assemble), so
 the compiled-shape set is closed: one executable per (bucket,
@@ -49,6 +64,9 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from alphafold2_tpu.cache import FoldCache, InflightRegistry, fold_key
+from alphafold2_tpu.obs.registry import MetricsRegistry, get_registry
+from alphafold2_tpu.obs.trace import (MultiTrace, NULL_TRACE, NULL_TRACER,
+                                      Tracer)
 from alphafold2_tpu.serve.bucketing import BucketPolicy
 from alphafold2_tpu.serve.executor import FoldExecutor
 from alphafold2_tpu.serve.metrics import ServeMetrics
@@ -86,7 +104,7 @@ class SchedulerConfig:
 
 class _Entry:
     __slots__ = ("request", "ticket", "bucket_len", "enqueued_at",
-                 "deadline", "cache_key", "store_key")
+                 "deadline", "cache_key", "store_key", "trace")
 
     def __init__(self, request: FoldRequest, bucket_len: int):
         self.request = request
@@ -97,7 +115,18 @@ class _Entry:
         # saturated block-mode fall-through): its successful fold still
         # populates the store, it just has no followers to settle
         self.store_key: Optional[str] = None
+        self.trace = NULL_TRACE                # set by submit()
         self.mark_enqueued()
+
+    def resolve(self, response: FoldResponse):
+        """THE terminal seam: resolve the caller's ticket and finish the
+        request trace in one place, so every terminal path — ok, cache
+        hit, coalesced, shed, error, cancelled, crash — yields exactly
+        one completed trace. Trace.finish is idempotent; racing
+        resolvers can't double-emit."""
+        self.ticket._resolve(response)
+        self.trace.finish(status=response.status, source=response.source,
+                          error=response.error)
 
     def mark_enqueued(self):
         """(Re)start the latency/deadline clock — called again right
@@ -116,20 +145,30 @@ class Scheduler:
         namespaces cache keys by model identity; REQUIRED to be
         meaningful whenever the cache outlives one (model, params),
         e.g. any disk-backed store shared across restarts.
+    tracer: optional obs.Tracer for request-scoped traces (None — the
+        default — is the zero-cost NULL_TRACER).
+    registry: obs.MetricsRegistry the coalescing/follower-deadline
+        counters report into (None = process default).
     """
 
     def __init__(self, executor: FoldExecutor, buckets: BucketPolicy,
                  config: Optional[SchedulerConfig] = None,
                  metrics: Optional[ServeMetrics] = None,
                  cache: Optional[FoldCache] = None,
-                 model_tag: str = ""):
+                 model_tag: str = "",
+                 tracer: Optional[Tracer] = None,
+                 registry: Optional[MetricsRegistry] = None):
         self.executor = executor
         self.buckets = buckets
         self.config = config or SchedulerConfig()
         self.metrics = metrics or ServeMetrics()
         self.cache = cache
         self.model_tag = model_tag
-        self._inflight = InflightRegistry()
+        self.tracer = tracer or NULL_TRACER
+        self._c_follower_deadline = (registry or get_registry()).counter(
+            "serve_follower_deadline_exceeded_total",
+            "parked followers shed on their own expired deadline")
+        self._inflight = InflightRegistry(registry=registry)
         self._cond = threading.Condition()
         self._incoming: deque = deque()
         self._pending: Dict[int, List[_Entry]] = {}
@@ -186,9 +225,12 @@ class Scheduler:
     def submit(self, request: FoldRequest) -> FoldTicket:
         bucket_len = self.buckets.bucket_for(request.length)  # fail fast
         entry = _Entry(request, bucket_len)
+        entry.trace = self.tracer.start_trace(request.request_id)
+        entry.trace.begin("submit")
         if self.cache is not None:
             with self._cond:
                 if not self._running:
+                    entry.trace.finish("error", error="submit before start")
                     raise RuntimeError("Scheduler.submit() before start()")
             if self._serve_from_cache_or_coalesce(entry):
                 return entry.ticket
@@ -218,14 +260,19 @@ class Scheduler:
                         raise RuntimeError("Scheduler stopped while "
                                            "blocked on a full queue")
                 entry.mark_enqueued()
+                entry.trace.end("submit")
+                entry.trace.begin("queue")
                 self._incoming.append(entry)
                 self._depth += 1
                 depth = self._depth
                 self._cond.notify_all()
-        except BaseException:
+        except BaseException as exc:
             # a leader that never made it into the queue still owes its
             # followers a settlement — error out anyone who attached in
             # the window between precheck and the raise
+            entry.trace.finish(
+                "rejected" if isinstance(exc, QueueFullError) else "error",
+                error=str(exc))
             self._settle_followers(entry, FoldResponse(
                 request_id=request.request_id, status="error",
                 bucket_len=bucket_len,
@@ -250,13 +297,13 @@ class Scheduler:
         broken cache must cost a recompute, never fail a submit."""
         try:
             key = self._cache_key_for(entry.request)
-            cached = self.cache.get(key)      # never raises (store.py)
-        except Exception:
-            self.metrics.record_cache_miss()
+            cached = self.cache.get(key, trace=entry.trace)
+        except Exception:                     # get() never raises; keying
+            self.metrics.record_cache_miss()  # trouble degrades to a miss
             return False
         if cached is not None:
             self.metrics.record_cache_hit()
-            entry.ticket._resolve(FoldResponse(
+            entry.resolve(FoldResponse(
                 request_id=entry.request.request_id, status="ok",
                 coords=cached.coords.copy(),
                 confidence=cached.confidence.copy(),
@@ -278,6 +325,8 @@ class Scheduler:
                     >= self.config.queue_limit):
                 if self.config.full_policy == "reject":
                     self.metrics.record_rejected()
+                    entry.trace.finish("rejected",
+                                       error="queue + followers at limit")
                     raise QueueFullError(
                         f"queue + coalesced followers at limit "
                         f"{self.config.queue_limit}")
@@ -287,8 +336,19 @@ class Scheduler:
                 # (the fold still populates the store via store_key)
                 entry.store_key = key
                 return False
-            leader = self._inflight.attach(key, entry)
-        if not leader:
+            def _trace_parked(leader):
+                # runs under the registry lock: settlement cannot have
+                # resolved (and emitted) this trace yet, so the leader
+                # link is guaranteed to make it into the record
+                if leader is not None:
+                    entry.trace.link(leader.trace.trace_id)
+                entry.trace.event("coalesced")
+                entry.trace.end("submit")
+                entry.trace.begin("parked")
+
+            is_leader, _ = self._inflight.attach_with_leader(
+                key, entry, on_follower=_trace_parked)
+        if not is_leader:
             self.metrics.record_coalesced()
             return True                       # follower: leader settles us
         entry.cache_key = key                 # leader: enqueue + settle
@@ -321,9 +381,9 @@ class Scheduler:
                         request_id=f.request.request_id, status="error",
                         bucket_len=f.bucket_len, source="coalesced",
                         error=f"coalesced fan-out failed: {exc!r}")
-                f.ticket._resolve(resp)
+                f.resolve(resp)
             else:
-                f.ticket._resolve(FoldResponse(
+                f.resolve(FoldResponse(
                     request_id=f.request.request_id,
                     status=response.status, bucket_len=f.bucket_len,
                     latency_s=now - f.enqueued_at, source="coalesced",
@@ -338,12 +398,13 @@ class Scheduler:
         put_key = entry.cache_key or entry.store_key
         if response.status == "ok" and self.cache is not None \
                 and put_key is not None:
-            try:
-                self.cache.put(put_key, response.coords,
-                               response.confidence)
-            except Exception:
-                pass                  # a full/broken store never blocks
-        entry.ticket._resolve(response)
+            with entry.trace.span("writeback"):
+                try:
+                    self.cache.put(put_key, response.coords,
+                                   response.confidence)
+                except Exception:
+                    pass              # a full/broken store never blocks
+        entry.resolve(response)
         self._settle_followers(entry, response)
 
     def serve_stats(self) -> dict:
@@ -353,6 +414,8 @@ class Scheduler:
         stats = self.metrics.snapshot()
         stats["executor"] = self.executor.stats()
         stats["bucket_edges"] = list(self.buckets.edges)
+        # slowest completed request traces (empty without a tracer)
+        stats["traces"] = self.tracer.slowest()
         if self.cache is not None:
             stats["cache"]["store"] = self.cache.snapshot()
             stats["cache"]["inflight"] = self._inflight.snapshot()
@@ -432,6 +495,31 @@ class Scheduler:
                 bucket_len=e.bucket_len,
                 latency_s=now - e.enqueued_at,
                 error="deadline expired before folding"))
+        self._shed_expired_followers(now)
+
+    def _shed_expired_followers(self, now: float):
+        """Enforce parked followers' OWN deadlines: a coalesced follower
+        whose deadline passes while waiting on its leader is shed with
+        its own terminal state instead of inheriting the leader's
+        timing. The leader keeps folding — only the waiter gives up."""
+        if self.cache is None:
+            return
+        expired = self._inflight.evict_followers(
+            lambda f: f.deadline is not None and now > f.deadline)
+        if not expired:
+            return
+        with self._cond:
+            self._cond.notify_all()   # waiting() shrank: wake blocked
+        for f in expired:             # submitters before resolving
+            self.metrics.record_shed()
+            self._c_follower_deadline.inc()
+            f.trace.event("follower_deadline_exceeded")
+            f.resolve(FoldResponse(
+                request_id=f.request.request_id, status="shed",
+                bucket_len=f.bucket_len,
+                latency_s=now - f.enqueued_at, source="coalesced",
+                error="follower deadline expired while parked on an "
+                      "in-flight leader (follower_deadline_exceeded)"))
 
     def _form_batch(self, stopping: bool):
         """Pick the bucket whose oldest entry has waited longest, if any
@@ -462,14 +550,29 @@ class Scheduler:
     def _execute(self, bucket_len: int, entries: List[_Entry]):
         cfg = self.config
         t0 = time.monotonic()
+        if self.tracer.enabled:
+            for e in entries:
+                e.trace.end("queue", bucket_len=bucket_len)
+            # batch-level spans (assemble / compile / fold) are measured
+            # once and fanned out to every member's trace
+            batch_trace = MultiTrace([e.trace for e in entries])
+        else:
+            batch_trace = NULL_TRACE
         # the whole assemble -> run -> device-fetch window is guarded:
         # entries already left the queue, so an unresolved exception here
         # would orphan their tickets forever (resolve as error instead)
         try:
-            batch, waste = self.buckets.assemble(
-                [e.request for e in entries], bucket_len,
-                cfg.max_batch_size, msa_depth=cfg.msa_depth)
-            result = self.executor.run(batch, cfg.num_recycles)
+            with batch_trace.span("batch_form", bucket_len=bucket_len,
+                                  n_real=len(entries)):
+                batch, waste = self.buckets.assemble(
+                    [e.request for e in entries], bucket_len,
+                    cfg.max_batch_size, msa_depth=cfg.msa_depth)
+            # trace kwarg only when tracing: alternate executors (tests,
+            # the future mesh-sharded one) needn't know about obs
+            result = (self.executor.run(batch, cfg.num_recycles)
+                      if batch_trace is NULL_TRACE else
+                      self.executor.run(batch, cfg.num_recycles,
+                                        trace=batch_trace))
             coords = np.asarray(result.coords)
             confidence = np.asarray(result.confidence)
         except Exception as exc:  # resolve, never kill the worker
@@ -509,7 +612,7 @@ class Scheduler:
                             error=f"post-fold resolution failed: "
                                   f"{exc!r}"))
                     except Exception:
-                        e.ticket._resolve(FoldResponse(
+                        e.resolve(FoldResponse(
                             request_id=e.request.request_id,
                             status="error", bucket_len=bucket_len,
                             error=f"post-fold resolution failed: "

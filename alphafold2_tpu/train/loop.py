@@ -219,12 +219,29 @@ def fit(
     logger=None,
     step_timer=None,
     prefetch: int = 2,
+    registry=None,
 ):
     """Minimal host loop (reference train_pre.py:64-96 analog): consumes an
     iterator of batches, runs the jitted step, logs scalar metrics.
     `prefetch` stages that many batches onto device from a background
     thread (train/prefetch.py) so host featurization/transfer overlaps
-    the step; 0 disables."""
+    the step; 0 disables.
+
+    Training reports into the same process-wide metrics registry the
+    serving stack uses (`registry=None` = obs.get_registry()):
+    `train_steps_total`, a `train_step_seconds` histogram (when a
+    `step_timer` measures steps), and last-logged loss terms as
+    `train_metric{name=...}` gauges — one Prometheus scrape sees train
+    and serve side by side."""
+    from alphafold2_tpu.obs.registry import get_registry
+
+    reg = registry or get_registry()
+    m_steps = reg.counter("train_steps_total", "optimizer steps run")
+    m_step_s = reg.histogram("train_step_seconds",
+                             "wall time per training step")
+    m_metric = reg.gauge("train_metric",
+                         "last logged training metric value", ("name",))
+
     pre_placed = prefetch > 0
     if pre_placed:
         from alphafold2_tpu.train.prefetch import device_prefetch
@@ -242,9 +259,17 @@ def fit(
         if step_timer is not None:
             jax.block_until_ready(metrics["loss"])
             step_timer.stop()
+            # a StepTimer already wired to a registry histogram
+            # (StepTimer(histogram=...)) records itself; observing here
+            # too would double-count every step
+            if getattr(step_timer, "histogram", None) is None:
+                m_step_s.observe(step_timer.durations[-1])
+        m_steps.inc()
         if i % log_every == 0:
             scalars = {k: float(v) for k, v in metrics.items()}
             history.append(scalars)
+            for k, v in scalars.items():
+                m_metric.set(v, name=k)
             if logger is not None:
                 logger.log(step=i, **scalars)
     return state, history

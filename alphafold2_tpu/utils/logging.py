@@ -17,9 +17,13 @@ from typing import IO, Optional
 class MetricsLogger:
     """log(step=..., **scalars) -> one JSONL record (+ pretty stdout).
 
-    Values are scalars, or ONE level of dict-of-scalars for grouped
-    sections (e.g. the serving cache section: `cache={"hits": 3, ...}`
-    emits a nested object and pretty-prints as `cache.hits=3`).
+    Values are scalars or arbitrarily nested dicts of scalars (grouped
+    sections, e.g. the serving cache section: `cache={"disk": {"hits":
+    3}}` emits the nested object in the JSONL record and pretty-prints
+    as `cache.disk.hits=3` via obs.export.flatten). Every record
+    carries the shared observability `"schema": 1` version field
+    (obs/export.py; see MIGRATING) so consumers can reject records
+    they do not understand.
     """
 
     def __init__(self, path: Optional[str] = None, stdout: bool = True):
@@ -35,24 +39,26 @@ class MetricsLogger:
     def _scalar(v):
         return v if isinstance(v, (str, type(None))) else float(v)
 
+    @classmethod
+    def _convert(cls, v):
+        """Scalar coercion at arbitrary nesting depth."""
+        if isinstance(v, dict):
+            return {k: cls._convert(v2) for k, v2 in v.items()}
+        return cls._scalar(v)
+
     def log(self, step: int, **scalars):
-        record = {"step": int(step),
+        from alphafold2_tpu.obs.export import SCHEMA_VERSION, flatten
+
+        record = {"schema": SCHEMA_VERSION, "step": int(step),
                   "wall_s": round(time.time() - self._t0, 3)}
         for k, v in scalars.items():
-            record[k] = ({k2: self._scalar(v2) for k2, v2 in v.items()}
-                         if isinstance(v, dict) else self._scalar(v))
+            record[k] = self._convert(v)
         if self._fh is not None:
             self._fh.write(json.dumps(record) + "\n")
             self._fh.flush()
         if self.stdout:
-            flat = {}
-            for k, v in record.items():
-                if k in ("step", "wall_s"):
-                    continue
-                if isinstance(v, dict):
-                    flat.update({f"{k}.{k2}": v2 for k2, v2 in v.items()})
-                else:
-                    flat[k] = v
+            flat = flatten({k: v for k, v in record.items()
+                            if k not in ("schema", "step", "wall_s")})
             parts = " ".join(
                 f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
                 for k, v in flat.items())

@@ -32,10 +32,18 @@ def percentile(values: Sequence[float], q: float) -> float:
 
 
 class StepTimer:
-    """Accumulates wall-clock step durations (seconds)."""
+    """Accumulates wall-clock step durations (seconds).
 
-    def __init__(self):
+    `histogram`: optional obs.registry.Histogram every stop() also
+    observes into, so step timings land in the process-wide metrics
+    registry (Prometheus-exportable) without a second timing path. The
+    p50/p90/p99 properties and Histogram.percentile share ONE quantile
+    implementation — `percentile` above — so the two views can never
+    disagree on what a p99 means."""
+
+    def __init__(self, histogram=None):
         self.durations: List[float] = []
+        self.histogram = histogram
         self._start: Optional[float] = None
 
     def start(self):
@@ -44,8 +52,11 @@ class StepTimer:
     def stop(self):
         if self._start is None:
             raise RuntimeError("StepTimer.stop() without start()")
-        self.durations.append(time.perf_counter() - self._start)
+        dur = time.perf_counter() - self._start
+        self.durations.append(dur)
         self._start = None
+        if self.histogram is not None:
+            self.histogram.observe(dur)
 
     @contextlib.contextmanager
     def measure(self):

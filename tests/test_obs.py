@@ -1,0 +1,499 @@
+"""Observability subsystem tests (ISSUE 3): the metrics registry
+(counter/gauge/histogram + Prometheus/JSONL export), request-scoped
+tracing through the serving scheduler (cache hits, coalescing links,
+shed reasons, follower deadlines), and the obs_report tooling.
+
+Scheduler-level tests run against a stub executor (no model, no XLA) so
+trace *propagation* is exercised fast; the real-executor compile/fold
+span split and the 32-request e2e with obs enabled live in
+tests/test_serve.py next to the serving acceptance demo.
+"""
+
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from alphafold2_tpu import obs
+from alphafold2_tpu.obs.trace import NULL_TRACE
+from alphafold2_tpu.serve import (BucketPolicy, FoldCache, FoldRequest,
+                                  Scheduler, SchedulerConfig, ServeMetrics)
+from alphafold2_tpu.utils.logging import MetricsLogger
+from alphafold2_tpu.utils.profiling import StepTimer, percentile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_obs_report():
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(_REPO, "tools", "obs_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+obs_report = _load_obs_report()
+
+
+class _StubResult:
+    def __init__(self, b, n):
+        self.coords = np.zeros((b, n, 3), np.float32)
+        self.confidence = np.ones((b, n), np.float32)
+
+
+class _StubExecutor:
+    """Executor-shaped stand-in: instant folds, optional delay/raise."""
+
+    def __init__(self, delay_s=0.0, boom=False):
+        self.delay_s = delay_s
+        self.boom = boom
+
+    def run(self, batch, num_recycles, trace=NULL_TRACE):
+        if self.boom:
+            raise RuntimeError("boom")
+        with trace.span("fold"):
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            b, n = batch["seq"].shape
+            return _StubResult(b, n)
+
+    def stats(self):
+        return {"hits": 0, "misses": 0, "evictions": 0, "resident": 0,
+                "max_entries": 1, "keys": []}
+
+
+def _requests(*lengths, seed=0, **kwargs):
+    rng = np.random.default_rng(seed)
+    return [FoldRequest(seq=rng.integers(0, 20, n), **kwargs)
+            for n in lengths]
+
+
+@pytest.mark.quick
+class TestRegistry:
+    def test_counter_gauge_labels_and_reuse(self):
+        reg = obs.MetricsRegistry()
+        c = reg.counter("reqs_total", "requests", ("outcome",))
+        c.inc(outcome="ok")
+        c.inc(2, outcome="shed")
+        assert c.value(outcome="ok") == 1 and c.value(outcome="shed") == 2
+        # get-or-create: same object back, counts shared
+        assert reg.counter("reqs_total", label_names=("outcome",)) is c
+        g = reg.gauge("depth")
+        g.set(7)
+        g.inc(-2)
+        assert g.value() == 5
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("reqs_total")
+        with pytest.raises(ValueError, match="labels"):
+            reg.counter("reqs_total", label_names=("other",))
+        with pytest.raises(ValueError, match="labels"):
+            c.inc(bogus="x")
+
+    def test_histogram_buckets_cumulative(self):
+        reg = obs.MetricsRegistry()
+        h = reg.histogram("lat_s", buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.05, 0.5, 5.0):
+            h.observe(v)
+        (sample,) = h.samples()
+        assert sample["count"] == 4
+        assert sample["buckets"] == {"0.01": 1, "0.1": 2, "1": 3,
+                                     "+Inf": 4}
+        assert sample["sum"] == pytest.approx(5.555)
+
+    def test_histogram_percentile_is_the_shared_percentile(self):
+        """Satellite: ONE quantile implementation. The histogram's
+        reservoir percentile must agree exactly with
+        utils.profiling.percentile over the same raw values."""
+        reg = obs.MetricsRegistry()
+        h = reg.histogram("lat_s", reservoir=256)
+        values = [0.001 * (i ** 1.3) for i in range(1, 101)]
+        for v in values:
+            h.observe(v)
+        for q in (50, 90, 99):
+            assert h.percentile(q) == pytest.approx(percentile(values, q))
+
+    def test_steptimer_mirrors_into_histogram(self):
+        reg = obs.MetricsRegistry()
+        h = reg.histogram("step_s")
+        t = StepTimer(histogram=h)
+        for _ in range(5):
+            with t.measure():
+                pass
+        assert h.count() == 5
+        assert h.percentile(90) == pytest.approx(
+            percentile(t.durations, 90))
+        assert t.p90 == pytest.approx(percentile(t.durations, 90))
+
+    def test_serve_metrics_percentiles_from_histogram(self):
+        """ServeMetrics latency tails are registry-histogram-backed and
+        still agree with a direct percentile over the raw latencies."""
+        reg = obs.MetricsRegistry()
+        m = ServeMetrics(registry=reg)
+        lats = [0.01 * i for i in range(1, 42)]
+        for lat in lats:
+            m.record_served(32, lat)
+        snap = m.snapshot()["latency_by_bucket"]["32"]
+        assert snap["count"] == len(lats)
+        for q, key in ((50, "p50_s"), (90, "p90_s"), (99, "p99_s")):
+            assert snap[key] == pytest.approx(percentile(lats, q))
+        # the process-wide mirror saw the same stream
+        mirror = reg.histogram("serve_request_latency_seconds",
+                               label_names=("bucket_len",))
+        assert mirror.count(bucket_len=32) == len(lats)
+
+
+@pytest.mark.quick
+class TestExport:
+    def test_flatten_arbitrary_depth(self):
+        nested = {"a": 1, "b": {"c": 2, "d": {"e": {"f": 3}}}, "g": "x"}
+        assert obs.flatten(nested) == {"a": 1, "b.c": 2, "b.d.e.f": 3,
+                                       "g": "x"}
+        assert obs.flatten({}) == {}
+
+    def test_prometheus_text_parses(self):
+        reg = obs.MetricsRegistry()
+        reg.counter("folds_total", "folds done", ("bucket",)).inc(
+            3, bucket=64)
+        reg.gauge("queue_depth", "depth").set(2)
+        h = reg.histogram("lat_s", "latency", buckets=(0.1, 1.0))
+        h.observe(0.5)
+        text = obs.prometheus_text(reg)
+        assert 'folds_total{bucket="64"} 3' in text
+        assert "# TYPE lat_s histogram" in text
+        assert 'lat_s_bucket{le="+Inf"} 1' in text
+        assert "lat_s_count 1" in text
+        # the report tool's validator accepts what export produces
+        assert obs_report.check_prometheus_text(text) == []
+
+    def test_registry_json_and_jsonl_schema(self, tmp_path):
+        reg = obs.MetricsRegistry()
+        reg.counter("c_total").inc()
+        blob = obs.registry_json(reg)
+        assert blob["schema"] == 1
+        assert blob["metrics"]["c_total"]["samples"][0]["value"] == 1
+        path = tmp_path / "m.jsonl"
+        with obs.JsonlExporter(str(path)) as exp:
+            exp.write_registry(reg)
+            exp.write({"custom": 1})
+        recs = [json.loads(l) for l in path.read_text().splitlines()]
+        assert all(r["schema"] == 1 for r in recs)
+
+    def test_metrics_logger_nested_depth_and_schema(self, tmp_path,
+                                                    capsys):
+        """Satellite: MetricsLogger handles ARBITRARY nesting (was a
+        1-level special case) and stamps the shared schema version."""
+        path = tmp_path / "m.jsonl"
+        with MetricsLogger(str(path), stdout=True) as logger:
+            logger.log(step=3, loss=0.5,
+                       cache={"disk": {"deep": {"hits": 7}}, "misses": 1})
+        rec = json.loads(path.read_text().splitlines()[0])
+        assert rec["schema"] == 1
+        assert rec["cache"]["disk"]["deep"]["hits"] == 7.0
+        out = capsys.readouterr().out
+        assert "cache.disk.deep.hits=7" in out and "loss=0.5" in out
+
+
+@pytest.mark.quick
+class TestTrace:
+    def test_spans_events_and_record(self):
+        tracer = obs.Tracer(slow_k=4)
+        t = tracer.start_trace("req-x")
+        t.begin("submit")
+        t.end("submit")
+        with t.span("fold", bucket_len=32):
+            pass
+        t.add_span("batch_form", time.monotonic(), time.monotonic())
+        t.event("cache_miss")
+        t.link("t99")
+        t.finish("ok")
+        rec = t.record()
+        assert rec["schema"] == 1 and rec["status"] == "ok"
+        assert [s["name"] for s in rec["spans"]] == ["submit", "fold",
+                                                     "batch_form"]
+        assert rec["spans"][1]["attrs"] == {"bucket_len": 32}
+        assert rec["events"][0]["name"] == "cache_miss"
+        assert rec["leader_trace_id"] == "t99"
+        assert tracer.completed == 1 and tracer.slowest()[0] is not None
+
+    def test_finish_idempotent_and_autoclose(self):
+        tracer = obs.Tracer(slow_k=4)
+        t = tracer.start_trace("r")
+        t.begin("queue")           # never explicitly ended
+        t.finish("shed", error="deadline expired before folding")
+        t.finish("ok")             # second finish: no-op
+        rec = t.record()
+        assert rec["status"] == "shed"
+        assert rec["error"] == "deadline expired before folding"
+        (span,) = rec["spans"]
+        assert span["name"] == "queue" and span["attrs"]["auto_closed"]
+        assert tracer.completed == 1
+
+    def test_slow_ring_keeps_k_slowest(self):
+        tracer = obs.Tracer(slow_k=2)
+        for i in range(5):
+            t = tracer.start_trace(f"r{i}")
+            t.add_span("fold", 0.0, 0.0)
+            t._t0 -= i * 0.1       # synthetic duration: r4 slowest
+            t.finish("ok")
+        slow = tracer.slowest()
+        assert [r["request_id"] for r in slow] == ["r4", "r3"]
+        assert tracer.completed == 5
+
+    def test_null_tracer_is_free_and_inert(self):
+        t = obs.NULL_TRACER.start_trace("x")
+        assert t is NULL_TRACE and not t.enabled
+        t.begin("a")
+        t.end("a")
+        with t.span("fold"):
+            pass
+        t.event("e")
+        t.finish("ok")
+        assert not t.finished and obs.NULL_TRACER.slowest() == []
+
+    def test_jsonl_sink(self, tmp_path):
+        path = tmp_path / "traces.jsonl"
+        with obs.Tracer(jsonl_path=str(path)) as tracer:
+            for i in range(3):
+                tr = tracer.start_trace(f"r{i}")
+                with tr.span("fold"):
+                    pass
+                tr.finish("ok")
+        recs, errors = obs_report.load_traces(str(path))
+        assert len(recs) == 3 and not errors
+        assert obs_report.check_traces(recs) == []
+
+
+class _SchedulerHarness:
+    """One traced stub-executor scheduler + its obs surfaces."""
+
+    def __init__(self, tmp_path, executor=None, cache=True, **cfg_kwargs):
+        self.registry = obs.MetricsRegistry()
+        self.trace_path = str(tmp_path / "traces.jsonl")
+        self.tracer = obs.Tracer(jsonl_path=self.trace_path, slow_k=8)
+        self.metrics = ServeMetrics(registry=self.registry)
+        cfg = SchedulerConfig(
+            **{"max_batch_size": 2, "max_wait_ms": 10.0,
+               "num_recycles": 0, **cfg_kwargs})
+        self.scheduler = Scheduler(
+            executor or _StubExecutor(), BucketPolicy((16,)), cfg,
+            self.metrics,
+            cache=FoldCache(registry=self.registry) if cache else None,
+            model_tag="test", tracer=self.tracer, registry=self.registry)
+
+    def records(self):
+        self.tracer.close()
+        recs, errors = obs_report.load_traces(self.trace_path)
+        assert not errors
+        return recs
+
+
+class TestSchedulerTracing:
+    def test_cache_hit_gets_complete_short_trace(self, tmp_path):
+        h = _SchedulerHarness(tmp_path)
+        (req,) = _requests(8)
+        dup = FoldRequest(seq=req.seq.copy())
+        with h.scheduler as sched:
+            assert sched.submit(req).result(timeout=30).ok
+            resp = sched.submit(dup).result(timeout=30)
+        assert resp.source == "cache"
+        by_id = {r["request_id"]: r for r in h.records()}
+        hit = by_id[dup.request_id]
+        assert hit["status"] == "ok" and hit["source"] == "cache"
+        assert [s["name"] for s in hit["spans"]] == ["submit"]
+        assert any(e["name"] == "cache_hit" for e in hit["events"])
+        # the original fold's trace covers the full pipeline
+        fold = by_id[req.request_id]
+        names = [s["name"] for s in fold["spans"]]
+        assert names[:2] == ["submit", "queue"]
+        assert "fold" in names and "writeback" in names
+
+    def test_follower_trace_links_to_leader(self, tmp_path):
+        h = _SchedulerHarness(tmp_path,
+                              executor=_StubExecutor(delay_s=0.1))
+        (req,) = _requests(8)
+        dup = FoldRequest(seq=req.seq.copy())
+        with h.scheduler as sched:
+            t_lead = sched.submit(req)
+            t_foll = sched.submit(dup)
+            assert t_lead.result(timeout=30).ok
+            assert t_foll.result(timeout=30).source == "coalesced"
+        by_id = {r["request_id"]: r for r in h.records()}
+        leader, follower = by_id[req.request_id], by_id[dup.request_id]
+        assert follower["leader_trace_id"] == leader["trace_id"]
+        assert follower["source"] == "coalesced"
+        assert any(e["name"] == "coalesced" for e in follower["events"])
+        assert "parked" in [s["name"] for s in follower["spans"]]
+
+    def test_shed_trace_carries_reason(self, tmp_path):
+        h = _SchedulerHarness(tmp_path, cache=False)
+        (req,) = _requests(8)
+        req.deadline_s = 0.0
+        with h.scheduler as sched:
+            resp = sched.submit(req).result(timeout=30)
+        assert resp.status == "shed"
+        (rec,) = h.records()
+        assert rec["status"] == "shed"
+        assert "deadline expired" in rec["error"]
+
+    def test_follower_own_deadline_enforced(self, tmp_path):
+        """Satellite: a parked follower whose deadline passes is shed
+        with its OWN terminal state (follower_deadline_exceeded) while
+        the leader keeps folding."""
+        # leader can never batch (huge wait/batch): follower must time
+        # out on its own
+        h = _SchedulerHarness(tmp_path, max_batch_size=8,
+                              max_wait_ms=60_000.0, poll_ms=5.0)
+        (req,) = _requests(8)
+        dup = FoldRequest(seq=req.seq.copy(), deadline_s=0.05)
+        sched = h.scheduler.start()
+        t_lead = sched.submit(req)
+        t_foll = sched.submit(dup)
+        resp = t_foll.result(timeout=10)
+        assert resp.status == "shed" and resp.source == "coalesced"
+        assert "follower_deadline_exceeded" in resp.error
+        assert not t_lead.done()        # leader unaffected, still queued
+        sched.stop(drain=True)          # leader folds on drain
+        assert t_lead.result(timeout=10).ok
+        assert h.registry.counter(
+            "serve_follower_deadline_exceeded_total").value() == 1
+        assert h.metrics.snapshot()["shed"] == 1
+        by_id = {r["request_id"]: r for r in h.records()}
+        foll_rec = by_id[dup.request_id]
+        assert foll_rec["status"] == "shed"
+        assert any(e["name"] == "follower_deadline_exceeded"
+                   for e in foll_rec["events"])
+        assert by_id[req.request_id]["status"] == "ok"
+
+    def test_every_terminal_state_exactly_one_complete_trace(
+            self, tmp_path):
+        """Acceptance: fold / cache / coalesced / shed / error each
+        yield exactly one complete trace covering submit->terminal, and
+        the obs_report tripwire passes over the emitted JSONL."""
+        h = _SchedulerHarness(tmp_path,
+                              executor=_StubExecutor(delay_s=0.05))
+        reqs = _requests(8, 12)
+        dup_coalesce = FoldRequest(seq=reqs[0].seq.copy())
+        dup_cache = FoldRequest(seq=reqs[1].seq.copy())
+        shed_req = _requests(10, seed=1)[0]
+        shed_req.deadline_s = 0.0
+        with h.scheduler as sched:
+            t0 = sched.submit(reqs[0])
+            tc = sched.submit(dup_coalesce)          # -> coalesced
+            t1 = sched.submit(reqs[1])
+            for t in (t0, tc, t1):
+                t.result(timeout=30)
+            th = sched.submit(dup_cache)             # -> cache hit
+            ts = sched.submit(shed_req)              # -> shed
+            th.result(timeout=30)
+            ts.result(timeout=30)
+        # error terminal: a second scheduler whose executor raises
+        boom = _SchedulerHarness(tmp_path / "boom",
+                                 executor=_StubExecutor(boom=True),
+                                 cache=False)
+        (err_req,) = _requests(8, seed=2)
+        with boom.scheduler as sched:
+            err = sched.submit(err_req).result(timeout=30)
+        assert err.status == "error"
+
+        recs = h.records() + boom.records()
+        all_reqs = [reqs[0], dup_coalesce, reqs[1], dup_cache, shed_req,
+                    err_req]
+        by_id = {}
+        for rec in recs:
+            assert rec["request_id"] not in by_id, "duplicate trace"
+            by_id[rec["request_id"]] = rec
+        assert len(by_id) == len(all_reqs)
+        expect = {reqs[0].request_id: ("ok", "fold"),
+                  dup_coalesce.request_id: ("ok", "coalesced"),
+                  reqs[1].request_id: ("ok", "fold"),
+                  dup_cache.request_id: ("ok", "cache"),
+                  shed_req.request_id: ("shed", "fold"),
+                  err_req.request_id: ("error", "fold")}
+        for rid, (status, source) in expect.items():
+            rec = by_id[rid]
+            assert rec["status"] == status, rec
+            assert rec["source"] == source, rec
+            assert rec["spans"][0]["name"] == "submit"
+        # the smoke tripwire agrees: complete, schema'd, no orphans
+        assert obs_report.check_traces(recs) == []
+        stats = obs_report.stage_stats(recs)
+        assert stats["fold"]["count"] == 2     # two real batches folded
+        assert obs_report.render_waterfall(stats)
+
+    def test_serve_stats_exposes_slowest_traces(self, tmp_path):
+        h = _SchedulerHarness(tmp_path, cache=False,
+                              executor=_StubExecutor(delay_s=0.02))
+        with h.scheduler as sched:
+            for r in _requests(8, 12, 9):
+                sched.submit(r).result(timeout=30)
+            stats = sched.serve_stats()
+        assert stats["traces"], "slow-trace ring empty"
+        assert all(t["status"] == "ok" for t in stats["traces"])
+        assert stats["traces"][0]["duration_s"] == max(
+            t["duration_s"] for t in stats["traces"])
+
+    def test_untraced_scheduler_unchanged(self):
+        """No tracer -> NULL_TRACER: serving works, no traces, zero
+        obs residue in responses."""
+        reg = obs.MetricsRegistry()
+        sched = Scheduler(_StubExecutor(), BucketPolicy((16,)),
+                          SchedulerConfig(max_batch_size=2,
+                                          max_wait_ms=10.0,
+                                          num_recycles=0),
+                          ServeMetrics(registry=reg), registry=reg)
+        with sched:
+            resp = sched.submit(_requests(8)[0]).result(timeout=30)
+        assert resp.ok
+        assert sched.serve_stats()["traces"] == []
+
+
+@pytest.mark.quick
+class TestObsReportTool:
+    def test_check_flags_orphans_and_missing_schema(self):
+        good = {"schema": 1, "trace_id": "t1", "request_id": "r1",
+                "status": "ok", "source": "fold", "duration_s": 1.0,
+                "spans": [{"name": "fold", "start_s": 0.1,
+                           "dur_s": 0.5}], "events": []}
+        assert obs_report.check_traces([good]) == []
+        no_schema = dict(good, schema=None)
+        unfinished = dict(good, status=None)
+        orphan = dict(good, spans=[{"name": "fold", "start_s": 0.5,
+                                    "dur_s": 2.0}])
+        foldless = dict(good, spans=[])
+        problems = obs_report.check_traces(
+            [no_schema, unfinished, orphan, foldless])
+        assert len(problems) == 4
+        assert "schema" in problems[0]
+        assert "incomplete" in problems[1]
+        assert "escapes" in problems[2]
+        assert "no non-zero fold span" in problems[3]
+
+    def test_prometheus_validator_rejects_garbage(self):
+        assert obs_report.check_prometheus_text("") != []
+        assert obs_report.check_prometheus_text("what even is this") != []
+        ok = '# TYPE x counter\nx{a="b"} 1\n'
+        assert obs_report.check_prometheus_text(ok) == []
+
+    def test_main_check_roundtrip(self, tmp_path, capsys):
+        path = tmp_path / "t.jsonl"
+        with obs.Tracer(jsonl_path=str(path)) as tracer:
+            tr = tracer.start_trace("r0")
+            with tr.span("fold"):
+                time.sleep(0.001)
+            tr.finish("ok")
+        prom = tmp_path / "m.prom"
+        reg = obs.MetricsRegistry()
+        reg.counter("x_total").inc()
+        obs.write_prometheus(str(prom), reg)
+        assert obs_report.main([str(path), "--check",
+                                "--prom", str(prom)]) == 0
+        assert obs_report.main([str(path), "--json"]) == 0
+        out = capsys.readouterr().out
+        summary = json.loads(out.strip().splitlines()[-1])
+        assert summary["traces"] == 1 and not summary["problems"]
+        # a corrupt file fails the tripwire
+        path.write_text('{"schema": 99, "spans": []}\n')
+        assert obs_report.main([str(path), "--check"]) == 1

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from alphafold2_tpu import Alphafold2
+from alphafold2_tpu import Alphafold2, obs
 from alphafold2_tpu.data.synthetic import synthetic_requests
 from alphafold2_tpu.serve import (BucketPolicy, FoldExecutor, FoldRequest,
                                   QueueFullError, Scheduler,
@@ -220,6 +220,24 @@ class TestExecutor:
         stats = ex.stats()
         assert stats["misses"] == 1 and stats["hits"] == 1
 
+    def test_compile_vs_fold_spans(self, model_and_params):
+        """Cold key: the trace attributes XLA compile separately from
+        the device run; warm key: fold span only."""
+        ex = FoldExecutor(*model_and_params, max_entries=4)
+        policy = BucketPolicy((16,))
+        batch, _ = policy.assemble(requests_of((8,)), 16, 1)
+        tracer = obs.Tracer(slow_k=4)
+        cold = tracer.start_trace("cold")
+        ex.run(batch, 0, trace=cold)
+        cold.finish("ok")
+        names = [s["name"] for s in cold.record()["spans"]]
+        assert names == ["compile", "fold"]
+        warm = tracer.start_trace("warm")
+        ex.run(batch, 0, trace=warm)
+        warm.finish("ok")
+        (span,) = warm.record()["spans"]
+        assert span["name"] == "fold" and span["dur_s"] > 0
+
 
 class TestScheduler:
     def test_batch_formation_under_max_wait(self, model_and_params):
@@ -295,11 +313,16 @@ class TestScheduler:
             sched.submit(requests_of((8,))[0])
 
     def test_end_to_end_mixed_lengths(self, model_and_params, tmp_path):
-        """ISSUE 1 acceptance demo: >= 32 concurrent synthetic requests
-        of >= 3 distinct lengths all complete with per-request shapes,
-        distinct compilations <= buckets used, and the JSONL carries
-        queue-depth and p99-latency records."""
+        """ISSUE 1 acceptance demo (+ ISSUE 3 obs enabled): >= 32
+        concurrent synthetic requests of >= 3 distinct lengths all
+        complete with per-request shapes, distinct compilations <=
+        buckets used, the JSONL carries queue-depth and p99-latency
+        records, and EVERY request yields exactly one complete trace
+        whose span tree covers submit -> terminal with a non-zero fold
+        span."""
         jsonl = str(tmp_path / "serve.jsonl")
+        trace_jsonl = str(tmp_path / "traces.jsonl")
+        tracer = obs.Tracer(jsonl_path=trace_jsonl, slow_k=8)
         ex = FoldExecutor(*model_and_params, max_entries=4)
         metrics = ServeMetrics(jsonl)
         config = SchedulerConfig(max_batch_size=4, max_wait_ms=20.0,
@@ -312,7 +335,8 @@ class TestScheduler:
         tickets = []
         tickets_lock = threading.Lock()
 
-        with Scheduler(ex, policy, config, metrics) as sched:
+        with Scheduler(ex, policy, config, metrics,
+                       tracer=tracer) as sched:
             def submit_slice(i):
                 for r in reqs[i::4]:
                     t = sched.submit(r)
@@ -348,3 +372,24 @@ class TestScheduler:
         for rec in records:
             assert "queue_depth" in rec
             assert "p99_latency_s" in rec and rec["p99_latency_s"] > 0
+
+        # ISSUE 3 acceptance: exactly one complete trace per request,
+        # span tree covering submit -> terminal with non-zero fold time
+        tracer.close()
+        traces = [json.loads(line) for line in open(trace_jsonl)]
+        trace_by_id = {}
+        for tr in traces:
+            assert tr["schema"] == 1
+            assert tr["request_id"] not in trace_by_id, "duplicate trace"
+            trace_by_id[tr["request_id"]] = tr
+        assert set(trace_by_id) == set(by_id)
+        for tr in traces:
+            assert tr["status"] == "ok" and tr["source"] == "fold"
+            names = [s["name"] for s in tr["spans"]]
+            assert names[0] == "submit" and "queue" in names
+            fold_s = sum(s["dur_s"] for s in tr["spans"]
+                         if s["name"] in ("fold", "compile"))
+            assert fold_s > 0, tr
+        assert stats["misses"] <= policy.num_buckets  # tracing minted
+        # no extra executables; the slow-trace ring is populated
+        assert sched.serve_stats()["traces"]

@@ -1,0 +1,256 @@
+"""Render a per-stage latency waterfall + slowest traces from trace JSONL.
+
+Input is the file `alphafold2_tpu.obs.Tracer(jsonl_path=...)` appends to
+(one `"schema": 1` record per completed request trace; see README
+"Observability"). The report answers the two questions stage-level
+timing exists for:
+
+- WHERE does a typical request spend its time? -> the waterfall:
+  p50/p90/p99 per stage (submit / queue / parked / batch_form /
+  compile / fold / writeback), with proportional bars;
+- WHICH requests were pathological? -> top-K slowest traces with their
+  span breakdown, terminal status, and leader links.
+
+`--check` turns the report into a tripwire (tools/serve_smoke.sh's
+observability phase): exit 1 when any record is missing its schema
+version, any trace is incomplete (no terminal status), any span is an
+orphan (negative timing or escaping its trace's window), or any
+accelerator-served request (`source == "fold"`, status ok) lacks a
+non-zero `fold` span. `--prom FILE` additionally validates that a
+Prometheus text exposition (obs.export.prometheus_text / loadtest
+--prom-path) parses.
+
+  python tools/obs_report.py /tmp/serve_traces.jsonl
+  python tools/obs_report.py /tmp/serve_traces.jsonl --top 10
+  python tools/obs_report.py traces.jsonl --check --prom metrics.prom
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from alphafold2_tpu.obs.export import SCHEMA_VERSION  # noqa: E402
+from alphafold2_tpu.utils.profiling import percentile  # noqa: E402
+
+# canonical stage order for the waterfall; unknown span names append
+STAGE_ORDER = ("submit", "queue", "parked", "batch_form", "compile",
+               "fold", "writeback", "cache_lookup", "write")
+
+_EPS = 1e-6   # span/trace boundary slack: offsets are rounded to 1e-6
+
+
+def load_traces(path: str) -> Tuple[List[dict], List[str]]:
+    """Parse a trace JSONL file. Returns (records, parse_errors)."""
+    records, errors = [], []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError as exc:
+                errors.append(f"line {lineno}: unparseable JSON ({exc})")
+    return records, errors
+
+
+def check_traces(records: List[dict]) -> List[str]:
+    """Structural tripwire. Returns a list of violations (empty = ok)."""
+    problems = []
+    for i, rec in enumerate(records):
+        where = f"record {i} ({rec.get('trace_id', '?')})"
+        if rec.get("schema") != SCHEMA_VERSION:
+            problems.append(f"{where}: missing/unknown schema version "
+                            f"{rec.get('schema')!r}")
+            continue
+        status = rec.get("status")
+        if not status:
+            problems.append(f"{where}: incomplete trace (no terminal "
+                            "status)")
+            continue
+        duration = rec.get("duration_s", 0.0)
+        if duration < 0:
+            problems.append(f"{where}: negative duration {duration}")
+        for span in rec.get("spans", ()):
+            name = span.get("name", "?")
+            t0, dur = span.get("start_s"), span.get("dur_s")
+            if t0 is None or dur is None or t0 < -_EPS or dur < 0:
+                problems.append(f"{where}: orphan span {name!r} "
+                                f"(start={t0}, dur={dur})")
+            elif t0 + dur > duration + _EPS:
+                problems.append(f"{where}: span {name!r} escapes its "
+                                f"trace window ({t0}+{dur} > {duration})")
+        if status == "ok" and rec.get("source") == "fold":
+            fold_time = sum(s.get("dur_s", 0.0)
+                            for s in rec.get("spans", ())
+                            if s.get("name") in ("fold", "compile"))
+            if fold_time <= 0:
+                problems.append(f"{where}: served from the accelerator "
+                                "but has no non-zero fold span")
+    return problems
+
+
+def stage_stats(records: List[dict]) -> dict:
+    """{stage: {count, p50_s, p90_s, p99_s, total_s}} over all spans."""
+    by_stage = {}
+    for rec in records:
+        for span in rec.get("spans", ()):
+            by_stage.setdefault(span.get("name", "?"), []).append(
+                float(span.get("dur_s", 0.0)))
+    out = {}
+    names = [s for s in STAGE_ORDER if s in by_stage]
+    names += sorted(set(by_stage) - set(STAGE_ORDER))
+    for name in names:
+        durs = by_stage[name]
+        out[name] = {"count": len(durs),
+                     "p50_s": percentile(durs, 50),
+                     "p90_s": percentile(durs, 90),
+                     "p99_s": percentile(durs, 99),
+                     "total_s": sum(durs)}
+    return out
+
+
+def render_waterfall(stats: dict, width: int = 40) -> str:
+    """ASCII waterfall: one bar per stage, scaled to the largest p90."""
+    if not stats:
+        return "(no spans)"
+    scale = max(s["p90_s"] for s in stats.values()) or 1.0
+    lines = [f"{'stage':>12}  {'count':>6}  {'p50':>9}  {'p90':>9}  "
+             f"{'p99':>9}  waterfall(p90)"]
+    for name, s in stats.items():
+        bar = "#" * max(1, int(round(s["p90_s"] / scale * width))) \
+            if s["p90_s"] > 0 else ""
+        lines.append(f"{name:>12}  {s['count']:>6}  {s['p50_s']:>9.4f}  "
+                     f"{s['p90_s']:>9.4f}  {s['p99_s']:>9.4f}  {bar}")
+    return "\n".join(lines)
+
+
+def render_slowest(records: List[dict], top: int = 5) -> str:
+    ranked = sorted(records, key=lambda r: -float(r.get("duration_s", 0)))
+    lines = []
+    for rec in ranked[:top]:
+        spans = " ".join(
+            f"{s.get('name')}={s.get('dur_s', 0.0):.4f}s"
+            for s in rec.get("spans", ()))
+        link = (f" leader={rec['leader_trace_id']}"
+                if rec.get("leader_trace_id") else "")
+        err = f" error={rec['error']!r}" if rec.get("error") else ""
+        lines.append(
+            f"{rec.get('duration_s', 0.0):9.4f}s  "
+            f"{rec.get('trace_id', '?'):>6}  {rec.get('request_id', '?')} "
+            f"[{rec.get('status')}/{rec.get('source')}]{link}  "
+            f"{spans}{err}")
+    return "\n".join(lines) if lines else "(no traces)"
+
+
+# one sample line of Prometheus text exposition format 0.0.4
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"                 # metric name
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*\})?"
+    r" [-+]?(?:[0-9.eE+-]+|Inf|NaN)$")
+
+
+def check_prometheus_text(text: str) -> List[str]:
+    """Validate exposition text; returns violations (empty = parses)."""
+    problems = []
+    samples = 0
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if not re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ",
+                            line):
+                problems.append(f"prom line {lineno}: malformed comment "
+                                f"{line!r}")
+            continue
+        if not _PROM_SAMPLE.match(line):
+            problems.append(f"prom line {lineno}: unparseable sample "
+                            f"{line!r}")
+        else:
+            samples += 1
+    if samples == 0:
+        problems.append("prom exposition has no samples")
+    return problems
+
+
+def summarize(records: List[dict]) -> dict:
+    by_status, by_source = {}, {}
+    for rec in records:
+        by_status[rec.get("status")] = by_status.get(rec.get("status"),
+                                                     0) + 1
+        by_source[rec.get("source")] = by_source.get(rec.get("source"),
+                                                     0) + 1
+    durs = [float(r.get("duration_s", 0.0)) for r in records]
+    return {"traces": len(records), "by_status": by_status,
+            "by_source": by_source,
+            "p50_s": percentile(durs, 50), "p99_s": percentile(durs, 99),
+            "linked_followers": sum(1 for r in records
+                                    if r.get("leader_trace_id"))}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace_jsonl", help="Tracer JSONL file")
+    ap.add_argument("--top", type=int, default=5,
+                    help="slowest traces to list")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on schema/orphan-span/empty-fold "
+                         "violations")
+    ap.add_argument("--prom", default="",
+                    help="also validate this Prometheus exposition file")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON summary line instead of the "
+                         "human report")
+    args = ap.parse_args(argv)
+
+    records, parse_errors = load_traces(args.trace_jsonl)
+    problems = list(parse_errors)
+    if not records:
+        problems.append(f"no trace records in {args.trace_jsonl}")
+    problems += check_traces(records)
+    if args.prom:
+        try:
+            with open(args.prom) as fh:
+                problems += check_prometheus_text(fh.read())
+        except OSError as exc:
+            problems.append(f"prom file unreadable: {exc}")
+
+    if args.json:
+        out = summarize(records)
+        out["stages"] = stage_stats(records)
+        out["problems"] = problems[:20]
+        print(json.dumps(out))
+    else:
+        s = summarize(records)
+        print(f"== {args.trace_jsonl}: {s['traces']} traces "
+              f"(status {s['by_status']}, source {s['by_source']}, "
+              f"{s['linked_followers']} linked followers) ==")
+        print(render_waterfall(stage_stats(records)))
+        print(f"\n-- top {args.top} slowest --")
+        print(render_slowest(records, args.top))
+        if problems:
+            print(f"\n-- {len(problems)} problems --")
+            for p in problems[:20]:
+                print(f"  {p}")
+
+    if args.check and problems:
+        print(f"OBS CHECK FAIL: {len(problems)} violations "
+              f"({problems[0]})", file=sys.stderr)
+        return 1
+    if args.check:
+        print(f"OBS CHECK OK: {len(records)} complete traces, "
+              "0 orphan spans", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,13 @@ dup-rate > 0); the report then carries the cache section (hit ratio,
 coalesced count) and `executor_calls_avoided` — requests that never
 occupied the accelerator — next to folds/hour and padding waste.
 
+`--trace-path F` enables request-scoped tracing (`obs.Tracer`): one
+JSONL record per completed request covering submit -> terminal with
+per-stage spans (submit/queue/batch_form/compile/fold/writeback),
+rendered by `tools/obs_report.py`; `--prom-path F` dumps the process
+metrics registry as Prometheus text exposition on exit. Together they
+are the observability phase of tools/serve_smoke.sh.
+
 `--smoke` (tools/serve_smoke.sh) exits 1 on ANY shed / timeout / error /
 rejected request at trivial load — the serving regression tripwire. With
 a duplicated workload (`--dup-rate` > 0, cache on) it additionally fails
@@ -74,6 +81,15 @@ def parse_args(argv=None):
     ap.add_argument("--dim", type=int, default=32)
     ap.add_argument("--depth", type=int, default=1)
     ap.add_argument("--metrics-path", default="/tmp/serve_loadtest.jsonl")
+    ap.add_argument("--trace-path", default="",
+                    help="enable request tracing (obs.Tracer) and append "
+                         "one JSONL record per completed trace here; "
+                         "render with tools/obs_report.py")
+    ap.add_argument("--trace-slow-k", type=int, default=8,
+                    help="slowest traces retained in serve_stats()")
+    ap.add_argument("--prom-path", default="",
+                    help="dump the process metrics registry as "
+                         "Prometheus text exposition here on exit")
     ap.add_argument("--platform", default="cpu",
                     choices=("cpu", "ambient"))
     ap.add_argument("--smoke", action="store_true",
@@ -124,8 +140,14 @@ def main(argv=None) -> int:
     cache = None
     if cache_on:
         cache = serve.FoldCache(disk_dir=args.cache_dir or None)
+    tracer = None
+    if args.trace_path:
+        from alphafold2_tpu import obs
+        tracer = obs.Tracer(jsonl_path=args.trace_path,
+                            slow_k=args.trace_slow_k)
     scheduler = serve.Scheduler(executor, policy, config, metrics,
-                                cache=cache, model_tag="serve_loadtest")
+                                cache=cache, model_tag="serve_loadtest",
+                                tracer=tracer)
 
     warmup_timer = StepTimer()
     with warmup_timer.measure():
@@ -256,6 +278,17 @@ def main(argv=None) -> int:
         "metrics_path": args.metrics_path,
         "failures": failures[:8],
     }
+    if tracer is not None:
+        tracer.close()
+        slowest = snap["traces"]
+        report["trace_path"] = args.trace_path
+        report["traces_completed"] = tracer.completed
+        report["slowest_trace_s"] = (slowest[0]["duration_s"]
+                                     if slowest else 0.0)
+    if args.prom_path:
+        from alphafold2_tpu import obs
+        obs.write_prometheus(args.prom_path)
+        report["prom_path"] = args.prom_path
     if cache_on:
         report["cache_store"] = {
             k: cache_snap["store"][k]

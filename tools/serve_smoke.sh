@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Serving smoke, two phases over the serve.Scheduler on CPU:
+# Serving smoke, three phases over the serve.Scheduler on CPU:
 #
 #   1. 30-second mixed-length load test. FAILS (exit 1) on any shed,
 #      timeout, error, or rejected request at this trivial load — the
@@ -8,10 +8,16 @@
 #      the cache never hits, any coalesced ticket deadlocks/times out,
 #      or any request sheds/errors — the dedup-subsystem tripwire
 #      (serve_loadtest.py --smoke enforces all of it in-process).
+#   3. observability: both phases ran with request tracing + a
+#      Prometheus registry dump; tools/obs_report.py --check FAILS on
+#      any trace missing its schema version, any incomplete trace or
+#      orphan span, any accelerator-served request without a non-zero
+#      fold span, or unparseable Prometheus exposition — the
+#      obs-subsystem tripwire.
 #
 # Invoked standalone from the test-tier docs (README "Tests");
-# tests/test_serve.py + tests/test_cache.py cover the same paths
-# in-process under `-m 'not slow'`.
+# tests/test_serve.py + tests/test_cache.py + tests/test_obs.py cover
+# the same paths in-process under `-m 'not slow'`.
 #
 #   bash tools/serve_smoke.sh            # default 30s serving window
 #   SMOKE_DURATION_S=10 bash tools/serve_smoke.sh
@@ -22,6 +28,8 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 DURATION="${SMOKE_DURATION_S:-30}"
+
+rm -f /tmp/serve_smoke_traces.jsonl /tmp/serve_smoke_dup_traces.jsonl
 
 timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     python tools/serve_loadtest.py \
@@ -34,9 +42,11 @@ timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     --concurrency 2 \
     --deadline-s 120 \
     --num-recycles 0 \
-    --metrics-path /tmp/serve_smoke.jsonl
+    --metrics-path /tmp/serve_smoke.jsonl \
+    --trace-path /tmp/serve_smoke_traces.jsonl \
+    --prom-path /tmp/serve_smoke.prom
 
-exec timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     python tools/serve_loadtest.py \
     --smoke \
     --requests 48 \
@@ -49,4 +59,17 @@ exec timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     --concurrency 2 \
     --deadline-s 120 \
     --num-recycles 0 \
-    --metrics-path /tmp/serve_smoke_dup.jsonl
+    --metrics-path /tmp/serve_smoke_dup.jsonl \
+    --trace-path /tmp/serve_smoke_dup_traces.jsonl \
+    --prom-path /tmp/serve_smoke_dup.prom
+
+# phase 3: every completed request left exactly one complete trace
+# (non-zero fold span for accelerator-served ones, no orphan spans,
+# schema-versioned) and the Prometheus exposition parses
+timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/obs_report.py /tmp/serve_smoke_traces.jsonl \
+    --check --prom /tmp/serve_smoke.prom
+
+exec timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/obs_report.py /tmp/serve_smoke_dup_traces.jsonl \
+    --check --prom /tmp/serve_smoke_dup.prom
