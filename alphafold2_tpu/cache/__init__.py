@@ -23,4 +23,5 @@ deduplication").
 from alphafold2_tpu.cache.coalesce import InflightRegistry  # noqa: F401
 from alphafold2_tpu.cache.keys import KEY_SCHEMA, fold_key  # noqa: F401
 from alphafold2_tpu.cache.store import (CachedFold, CacheStats,  # noqa: F401
-                                        FoldCache)
+                                        FoldCache, decode_fold,
+                                        encode_fold)

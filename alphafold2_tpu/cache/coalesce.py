@@ -19,6 +19,18 @@ follower-deadline policy: `evict_followers(predicate)` lets the owner
 pull out parked followers whose own deadline expired and shed them with
 their own terminal state instead of inheriting the leader's timing.
 
+Settlement is not the only exit for a leader: a leader that is SHED
+(deadline expired while queued) or rejected at submit never produced a
+result, but its followers may still be viable — error-resolving the
+whole group would turn one dead request into N. `promote(key, pick)`
+instead crowns a surviving follower (the owner's `pick` chooses; the
+scheduler picks the tightest deadline — it has the least slack to
+re-queue) as the new leader: it leaves the parked set, the remaining
+followers stay attached under it, and a later settle() of the key fans
+out from the new leader. Promotions are counted (`leader_promotions`
+in `snapshot()`, `coalesce_leader_promotions_total` in the metrics
+registry).
+
 `attach` also records the leader object, so a follower's request trace
 can link to the leader's trace (`attach_with_leader`). Lifetime
 counters mirror into the process metrics registry
@@ -45,12 +57,17 @@ class InflightRegistry:
         self._leader_objs: Dict[str, Any] = {}
         self.leaders = 0               # lifetime counters, lock-guarded
         self.coalesced = 0
+        self.leader_promotions = 0
         reg = registry or get_registry()
         self._m_leaders = reg.counter(
             "coalesce_leaders_total", "keys that started an in-flight fold")
         self._m_followers = reg.counter(
             "coalesce_followers_total",
             "submissions parked behind an in-flight leader")
+        self._m_promotions = reg.counter(
+            "coalesce_leader_promotions_total",
+            "followers promoted to leader after their leader was shed "
+            "or rejected")
 
     def attach(self, key: str, follower: Any) -> bool:
         """Returns True if the caller is the leader for `key` (it must do
@@ -98,6 +115,33 @@ class InflightRegistry:
             self._leader_objs.pop(key, None)
             return self._followers.pop(key, [])
 
+    def promote(self, key: str,
+                pick: Callable[[List[Any]], Any]) -> Optional[Any]:
+        """The leader of `key` dropped out WITHOUT reaching a terminal
+        result (shed while queued, rejected at submit): crown one of
+        its parked followers instead of dissolving the group.
+
+        `pick(followers)` chooses from the non-empty parked list (the
+        scheduler picks the tightest deadline) and must return one of
+        its elements. The chosen follower is removed from the parked
+        set, recorded as the key's leader object (later attachers link
+        to ITS trace), and returned — the caller owns re-enqueueing it.
+        Returns None when no followers are parked; the key is then
+        fully cleared (equivalent to settle() of an empty group) and
+        the next attach starts fresh."""
+        with self._lock:
+            waiting = self._followers.get(key)
+            if not waiting:
+                self._followers.pop(key, None)
+                self._leader_objs.pop(key, None)
+                return None
+            new_leader = pick(waiting)
+            waiting.remove(new_leader)
+            self._leader_objs[key] = new_leader
+            self.leader_promotions += 1
+        self._m_promotions.inc()
+        return new_leader
+
     def evict_followers(self,
                         predicate: Callable[[Any], bool]) -> List[Any]:
         """Remove and return every parked follower matching `predicate`
@@ -130,4 +174,5 @@ class InflightRegistry:
                     "waiting_followers":
                         sum(len(v) for v in self._followers.values()),
                     "leaders": self.leaders,
-                    "coalesced": self.coalesced}
+                    "coalesced": self.coalesced,
+                    "leader_promotions": self.leader_promotions}

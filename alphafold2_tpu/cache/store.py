@@ -1,4 +1,4 @@
-"""Two-tier fold result store: in-memory LRU over an optional disk tier.
+"""Fold result store: in-memory LRU over optional disk and peer tiers.
 
 The memory tier is a byte-budgeted LRU (coords for a 512-residue fold
 are ~6 KB; a default 256 MB budget holds tens of thousands of results —
@@ -9,7 +9,21 @@ reader trusts. Anything wrong with a disk entry — unreadable npz,
 missing fields, key mismatch, shape nonsense — is treated as a MISS and
 the file is quarantined (renamed `*.quarantined`), never re-read and
 never raised to the serving path: a corrupt cache must cost a
-recompute, not an outage.
+recompute, not an outage. Quarantine also reconciles the memory tier:
+any resident copy of the poisoned key is dropped WITH its
+`bytes_resident` accounting (a quarantine that left the bytes counted
+would drift the budget until restart).
+
+`peer` mounts a third tier below disk (memory -> disk -> peer): any
+object with `get(key, trace=) -> Optional[CachedFold]` — a
+`fleet.PeerCacheClient` fetching npz-over-HTTP from the key's ring
+owner, or a `fleet.ObjectStorePeer` over a shared volume. Peer lookups
+share the disk tier's trust model (validated via `decode_fold`, any
+trouble degrades to a miss) and a peer hit is promoted into the local
+memory AND disk tiers so the fleet converges instead of re-fetching.
+`peer_write_through=True` additionally pushes local puts to
+`peer.put()` (object-store deployments; the HTTP client is read-only —
+the owner already holds what it folded). Off by default.
 
 Expiry is TTL-based (wall clock at put time, both tiers) plus
 max-entries / max-bytes LRU eviction in memory. `CacheStats` counts
@@ -19,6 +33,7 @@ stats embed.
 
 from __future__ import annotations
 
+import io
 import os
 import threading
 import time
@@ -46,6 +61,33 @@ class CachedFold:
         return int(self.coords.nbytes + self.confidence.nbytes)
 
 
+def encode_fold(key: str, value: CachedFold) -> bytes:
+    """One cached fold as self-identifying npz bytes — THE wire/disk
+    format: the disk tier, the peer HTTP protocol, and object-store
+    backends all carry exactly these bytes, so every tier validates
+    with the same `decode_fold`."""
+    buf = io.BytesIO()
+    np.savez(buf, coords=value.coords, confidence=value.confidence,
+             key=np.frombuffer(key.encode("utf-8"), np.uint8))
+    return buf.getvalue()
+
+
+def decode_fold(key: str, data: bytes) -> CachedFold:
+    """Parse + validate `encode_fold` bytes. Raises on anything wrong
+    (unreadable, key mismatch, shape nonsense); callers translate that
+    into their tier's miss/quarantine semantics."""
+    with np.load(io.BytesIO(data)) as z:
+        stored_key = bytes(z["key"]).decode("utf-8")
+        value = CachedFold(
+            coords=np.asarray(z["coords"], np.float32),
+            confidence=np.asarray(z["confidence"], np.float32))
+    if (stored_key != key or value.coords.ndim != 2
+            or value.coords.shape[1] != 3
+            or value.confidence.shape != (value.coords.shape[0],)):
+        raise ValueError(f"cache entry {key} fails validation")
+    return value
+
+
 class CacheStats:
     """Thread-safe counters for every cache outcome.
 
@@ -55,7 +97,7 @@ class CacheStats:
     instance's `snapshot()` stays its own."""
 
     FIELDS = ("hits", "misses", "puts", "evictions", "expirations",
-              "disk_hits", "disk_errors")
+              "disk_hits", "disk_errors", "peer_hits", "peer_errors")
 
     def __init__(self, registry: Optional[MetricsRegistry] = None):
         self._lock = threading.Lock()
@@ -94,24 +136,37 @@ class _Entry:
 
 
 class FoldCache:
-    """Content-addressed fold result cache (memory LRU + optional disk).
+    """Content-addressed fold cache (memory LRU + optional disk + peer).
 
     max_bytes / max_entries bound the memory tier only; the disk tier
     is bounded by TTL (and by whoever owns the directory). ttl_s=None
     disables expiry. `clock` is injectable for tests.
+
+    peer: optional third tier consulted after a disk miss — any object
+        with `get(key, trace=) -> Optional[CachedFold]` that never lets
+        an exception escape as anything but a miss (fleet.PeerCacheClient,
+        fleet.ObjectStorePeer). A peer hit is promoted into memory and
+        disk with a fresh TTL (the peer already refuses entries expired
+        on ITS clock, so a value's total lifetime is bounded by one TTL
+        per tier hop, not unbounded bouncing).
+    peer_write_through: also push local puts to `peer.put(key, value)`
+        when the peer supports it (shared-volume object stores).
     """
 
     def __init__(self, max_bytes: int = 256 << 20, max_entries: int = 4096,
                  ttl_s: Optional[float] = None,
                  disk_dir: Optional[str] = None,
                  clock: Callable[[], float] = time.time,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 peer=None, peer_write_through: bool = False):
         if max_bytes < 0 or max_entries < 0:
             raise ValueError("max_bytes and max_entries must be >= 0")
         self.max_bytes = int(max_bytes)
         self.max_entries = int(max_entries)
         self.ttl_s = ttl_s
         self.disk_dir = disk_dir
+        self.peer = peer
+        self.peer_write_through = bool(peer_write_through)
         self._clock = clock
         self._lock = threading.Lock()
         self._mem: "OrderedDict[str, _Entry]" = OrderedDict()
@@ -171,14 +226,35 @@ class FoldCache:
             self._m_bytes.set(self._bytes)
             self._m_entries.set(len(self._mem))
 
+    def _mem_drop(self, key: str) -> bool:
+        """Remove a memory-resident entry WITH its byte accounting.
+        Every invalidation path (quarantine, explicit invalidate) must
+        come through here: popping from `_mem` without the `_bytes`
+        decrement leaks resident-byte accounting until restart."""
+        with self._lock:
+            entry = self._mem.pop(key, None)
+            if entry is None:
+                return False
+            self._bytes -= entry.value.nbytes
+            self._m_bytes.set(self._bytes)
+            self._m_entries.set(len(self._mem))
+            return True
+
     # -- disk tier -------------------------------------------------------
 
     def _path(self, key: str) -> str:
         return os.path.join(self.disk_dir, key[:2], f"{key}.npz")
 
-    def _quarantine(self, path: str, trace=NULL_TRACE):
+    def _quarantine(self, path: str, key: Optional[str] = None,
+                    trace=NULL_TRACE):
         self.stats.bump("disk_errors")
         trace.event("cache_quarantine")
+        if key is not None:
+            # the durable copy of `key` failed validation: drop any
+            # memory-resident copy too (reconciling bytes_resident) so
+            # a poisoned key costs one clean recompute, not a tier that
+            # keeps serving while its backing entry is quarantined
+            self._mem_drop(key)
         try:
             os.replace(path, path + _QUARANTINE_SUFFIX)
         except OSError:
@@ -203,18 +279,10 @@ class FoldCache:
         except OSError:
             return None
         try:
-            with np.load(path) as z:
-                stored_key = bytes(z["key"]).decode("utf-8")
-                value = CachedFold(
-                    coords=np.asarray(z["coords"], np.float32),
-                    confidence=np.asarray(z["confidence"], np.float32))
-            if (stored_key != key or value.coords.ndim != 2
-                    or value.coords.shape[1] != 3
-                    or value.confidence.shape
-                    != (value.coords.shape[0],)):
-                raise ValueError(f"cache entry {key} fails validation")
+            with open(path, "rb") as fh:
+                value = decode_fold(key, fh.read())
         except Exception:              # unreadable/garbage/wrong entry
-            self._quarantine(path, trace)
+            self._quarantine(path, key, trace)
             return None
         return value, expires_at
 
@@ -224,9 +292,7 @@ class FoldCache:
         try:
             os.makedirs(os.path.dirname(path), exist_ok=True)
             with open(tmp, "wb") as fh:
-                np.savez(fh, coords=value.coords,
-                         confidence=value.confidence,
-                         key=np.frombuffer(key.encode("utf-8"), np.uint8))
+                fh.write(encode_fold(key, value))
             os.replace(tmp, path)      # atomic: readers see old or new
         except Exception:
             self.stats.bump("disk_errors")
@@ -237,10 +303,18 @@ class FoldCache:
 
     # -- public API ------------------------------------------------------
 
-    def get(self, key: str, trace=NULL_TRACE) -> Optional[CachedFold]:
-        """Lookup; never raises. Disk hits are promoted into memory.
-        `trace` (obs.Trace; zero-cost NULL_TRACE default) receives
-        cache_hit / cache_miss / cache_quarantine events so a request
+    def get(self, key: str, trace=NULL_TRACE,
+            peer: bool = True) -> Optional[CachedFold]:
+        """Lookup; never raises. Tier order memory -> disk -> peer;
+        lower-tier hits are promoted upward (a peer hit lands in memory
+        AND disk, so the fleet converges instead of re-fetching).
+        `peer=False` skips the network tier — the scheduler passes it
+        for keys it is about to FORWARD to their owner (the owner's
+        cache answers at the forwarded submit; a guaranteed-miss HTTP
+        round trip first, worst case a full peer timeout when the
+        owner is down, would only delay the hop). `trace` (obs.Trace;
+        zero-cost NULL_TRACE default) receives cache_hit / cache_miss /
+        cache_quarantine events plus a `peer_fetch` span so a request
         trace shows where its result came from."""
         value = self._mem_get(key)
         tier = "memory"
@@ -251,12 +325,33 @@ class FoldCache:
                 tier = "disk"
                 self.stats.bump("disk_hits")
                 self._mem_put(key, value, expires_at=expires_at)
+        if value is None and peer and self.peer is not None:
+            value = self._peer_get(key, trace)
+            if value is not None:
+                tier = "peer"
         if value is None:
             self.stats.bump("misses")
             trace.event("cache_miss")
             return None
         self.stats.bump("hits")
         trace.event("cache_hit", tier=tier)
+        return value
+
+    def _peer_get(self, key: str, trace=NULL_TRACE) -> Optional[CachedFold]:
+        """Consult the peer tier; any trouble degrades to a miss (a
+        partitioned fleet must cost recomputes, never outages)."""
+        try:
+            with trace.span("peer_fetch"):
+                value = self.peer.get(key, trace=trace)
+        except Exception:
+            self.stats.bump("peer_errors")
+            return None
+        if value is None:
+            return None
+        self.stats.bump("peer_hits")
+        self._mem_put(key, value)
+        if self.disk_dir:
+            self._disk_put(key, value)
         return value
 
     def put(self, key: str, coords, confidence) -> CachedFold:
@@ -268,7 +363,49 @@ class FoldCache:
         self._mem_put(key, value)
         if self.disk_dir:
             self._disk_put(key, value)
+        if self.peer_write_through and self.peer is not None \
+                and hasattr(self.peer, "put"):
+            try:
+                self.peer.put(key, value)
+            except Exception:
+                self.stats.bump("peer_errors")
         return value
+
+    def read_raw(self, key: str) -> Optional[bytes]:
+        """The key's entry as `encode_fold` bytes, or None — what a
+        `fleet.PeerCacheServer` sends to a fetching peer. Serves from
+        memory when resident (no disk round-trip on the hot set);
+        otherwise reads and VALIDATES the disk file before shipping it
+        (a corrupt entry is quarantined — including dropping any
+        memory-resident copy with its bytes accounting — never sent:
+        the peer protocol's trust model starts at the sender). Does not
+        consult this cache's own peer tier (peers answer for what THEY
+        hold; fan-out chains would re-introduce unbounded forwarding).
+        TTL semantics match `get`."""
+        value = self._mem_get(key)
+        if value is not None:
+            return encode_fold(key, value)
+        if not self.disk_dir:
+            return None
+        hit = self._disk_get(key)
+        if hit is None:
+            return None
+        value, expires_at = hit
+        self._mem_put(key, value, expires_at=expires_at)
+        return encode_fold(key, value)
+
+    def invalidate(self, key: str) -> bool:
+        """Drop `key` from the local tiers (memory accounting included;
+        the disk file is removed, not quarantined — invalidation is
+        policy, not corruption). Returns True when anything was held."""
+        dropped = self._mem_drop(key)
+        if self.disk_dir:
+            try:
+                os.remove(self._path(key))
+                dropped = True
+            except OSError:
+                pass
+        return dropped
 
     # -- views -----------------------------------------------------------
 
@@ -290,4 +427,6 @@ class FoldCache:
         out["max_entries"] = self.max_entries
         out["ttl_s"] = self.ttl_s
         out["disk_dir"] = self.disk_dir
+        out["peer"] = (None if self.peer is None
+                       else type(self.peer).__name__)
         return out

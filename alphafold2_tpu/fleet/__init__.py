@@ -1,0 +1,39 @@
+"""alphafold2_tpu.fleet — N replicas as one logical serving fleet.
+
+The serve/cache layers made one process efficient (batching, result
+cache, coalescing); this package makes N of them add up instead of
+multiply: behind a dumb load balancer, every replica folds the Zipf
+head independently — with the fleet tier, each fold_key has ONE owner
+and one cached home. Pieces, each usable alone:
+
+- registry:     ReplicaRegistry — membership + health + membership
+                epochs; RolloutState — the fleet-wide (model_tag,
+                epoch) that weight rollout bumps atomically
+- router:       ConsistentHashRouter — fold_key -> owner replica over
+                a vnode hash ring; one-hop bounded forwarding with
+                local fallback (`Scheduler(router=...)`)
+- peer:         PeerCacheClient/PeerCacheServer — npz-over-HTTP peer
+                cache tier (`FoldCache(peer=client)`), stdlib only,
+                same validation/quarantine trust model as the disk
+                tier, rollout-tag checked at both ends
+- object_store: ObjectStoreBackend/FilesystemObjectStore/
+                ObjectStorePeer — the same peer tier over a shared
+                volume instead of HTTP
+- local:        InProcessFleet — N fully-wired replicas in one process
+                (the loadtest/smoke/test harness and the deployment's
+                executable spec)
+
+Everything is OFF by default: a Scheduler without `router=` and a
+FoldCache without `peer=` behave exactly as before (README "Fleet
+serving", MIGRATING "Fleet").
+"""
+
+from alphafold2_tpu.fleet.local import FleetReplica, InProcessFleet  # noqa: F401
+from alphafold2_tpu.fleet.object_store import (FilesystemObjectStore,  # noqa: F401
+                                               ObjectStoreBackend,
+                                               ObjectStorePeer)
+from alphafold2_tpu.fleet.peer import PeerCacheClient, PeerCacheServer  # noqa: F401
+from alphafold2_tpu.fleet.registry import (ReplicaInfo, ReplicaRegistry,  # noqa: F401
+                                           RolloutState)
+from alphafold2_tpu.fleet.router import (ConsistentHashRouter,  # noqa: F401
+                                         RouteDecision)

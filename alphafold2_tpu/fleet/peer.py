@@ -1,0 +1,272 @@
+"""Peer cache protocol: npz-over-HTTP between replicas, stdlib only.
+
+One replica's `FoldCache` becomes fleet-readable through a
+`PeerCacheServer` (stdlib `ThreadingHTTPServer`; GET
+`/cache/<key>?tag=<model_tag>` returns the entry as `encode_fold` npz
+bytes) and fleet-reading through a `PeerCacheClient` mounted as the
+cache's third tier (`FoldCache(peer=client)`): on a local memory+disk
+miss the client asks the key's consistent-hash owner, validates the
+bytes with the same `decode_fold` the disk tier trusts, and hands back
+a `CachedFold` for promotion into the local tiers.
+
+Rollout safety is enforced at BOTH ends (HelixFold's rule that the
+model version namespaces everything cached):
+
+- the client stamps every fetch with its current `RolloutState` tag;
+- the server 409s any fetch whose tag differs from its own current tag
+  (`stale_tag` counters on both sides), so during a rollout a replica
+  that has not switched yet and one that has can never exchange folds —
+  the epoch bump invalidates peer lookups for the old tag atomically,
+  without touching a single stored entry (keys already embed the tag,
+  so old entries are unreachable garbage, not hazards).
+
+Failure model: every client-side problem — connect refused, timeout,
+HTTP error, corrupt bytes — is a MISS plus a counter, never an
+exception into the serving path. `fail_threshold` consecutive transport
+errors against one peer mark it down in the registry (bumping the
+membership epoch, so routers stop selecting it) until something marks
+it back up; corrupt bytes additionally count as `corrupt` but do NOT
+mark the peer down (its other entries are likely fine).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib import error as urlerror
+from urllib import parse as urlparse
+from urllib import request as urlrequest
+
+from alphafold2_tpu.cache.store import CachedFold, decode_fold
+from alphafold2_tpu.fleet.registry import ReplicaRegistry, RolloutState
+from alphafold2_tpu.fleet.router import ConsistentHashRouter
+from alphafold2_tpu.obs.registry import MetricsRegistry, get_registry
+from alphafold2_tpu.obs.trace import NULL_TRACE
+
+_TAG_HEADER = "X-Model-Tag"
+
+
+class PeerCacheServer:
+    """Serve one replica's FoldCache to its peers over localhost HTTP.
+
+    Read-only by design: peers fetch what this replica folded; nothing
+    is ever written through this surface, so a misbehaving peer can
+    cost bandwidth but never poison the store. `port=0` binds an
+    ephemeral port (the in-process harness registers the resolved
+    address). `rollout=None` disables the tag check (single-tag
+    deployments that never roll weights in place).
+    """
+
+    def __init__(self, cache, rollout: Optional[RolloutState] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 replica_id: str = "",
+                 metrics: Optional[MetricsRegistry] = None):
+        self.cache = cache
+        self.rollout = rollout
+        self.replica_id = replica_id
+        m_served = (metrics or get_registry()).counter(
+            "fleet_peer_served_total",
+            "peer-protocol fetches served by this process, by outcome",
+            ("replica", "outcome"))
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            # one fetch per connection is fine at fold granularity;
+            # keep-alive would only pin threads
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, *args):      # stdlib default spams stderr
+                pass
+
+            def _count(self, outcome: str):
+                m_served.inc(replica=server.replica_id, outcome=outcome)
+
+            def _reply(self, code: int, body: bytes,
+                       content_type: str = "application/octet-stream"):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                if server.rollout is not None:
+                    self.send_header(_TAG_HEADER, server.rollout.tag)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    parsed = urlparse.urlsplit(self.path)
+                    if parsed.path == "/healthz":
+                        snap = {"replica": server.replica_id,
+                                "tag": (server.rollout.tag
+                                        if server.rollout else ""),
+                                "epoch": (server.rollout.epoch
+                                          if server.rollout else 0)}
+                        self._reply(200, json.dumps(snap).encode(),
+                                    "application/json")
+                        return
+                    if not parsed.path.startswith("/cache/"):
+                        self._reply(404, b"not found", "text/plain")
+                        return
+                    key = parsed.path[len("/cache/"):]
+                    tag = urlparse.parse_qs(parsed.query).get(
+                        "tag", [""])[0]
+                    if server.rollout is not None \
+                            and tag != server.rollout.tag:
+                        # cross-tag fetch: the requester and this
+                        # replica disagree on the current weights —
+                        # refuse, never guess (rollout invalidation)
+                        self._count("stale_tag")
+                        self._reply(409, b"model tag mismatch",
+                                    "text/plain")
+                        return
+                    data = server.cache.read_raw(key)
+                    if data is None:
+                        self._count("miss")
+                        self._reply(404, b"miss", "text/plain")
+                        return
+                    self._count("hit")
+                    self._reply(200, data)
+                except Exception:
+                    # a broken fetch must cost the REQUESTER a miss,
+                    # never wedge the serving replica's handler thread
+                    self._count("error")
+                    try:
+                        self._reply(500, b"peer error", "text/plain")
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        host, port = self._httpd.server_address[:2]
+        return str(host), int(port)
+
+    def start(self) -> "PeerCacheServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True,
+                name=f"peer-cache-{self.replica_id or self.address[1]}")
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "PeerCacheServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class PeerCacheClient:
+    """`FoldCache(peer=...)` tier that fetches from the key's owner.
+
+    get(key) resolves the key's consistent-hash owner through `router`,
+    skips the fetch when the owner is this replica (or unknown), and
+    otherwise GETs the entry from the owner's PeerCacheServer with this
+    replica's current rollout tag. Validation mirrors the disk tier
+    (`decode_fold`); a response whose `X-Model-Tag` no longer matches
+    ours is discarded as stale even on HTTP 200 (defense in depth — the
+    server also 409s). Never raises out of get(); every outcome lands
+    in `fleet_peer_fetch_total{peer,outcome}` and the fetch-latency
+    histogram `fleet_peer_fetch_seconds`.
+    """
+
+    def __init__(self, registry: ReplicaRegistry, self_id: str,
+                 router: Optional[ConsistentHashRouter] = None,
+                 rollout: Optional[RolloutState] = None,
+                 timeout_s: float = 2.0, fail_threshold: int = 3,
+                 metrics: Optional[MetricsRegistry] = None):
+        self.registry = registry
+        self.self_id = self_id
+        self.router = router or ConsistentHashRouter(
+            registry, self_id, metrics=metrics)
+        self.rollout = rollout if rollout is not None else registry.rollout
+        self.timeout_s = float(timeout_s)
+        self.fail_threshold = max(1, int(fail_threshold))
+        self._lock = threading.Lock()
+        self._consecutive_failures: dict = {}
+        reg = metrics or get_registry()
+        self._m_fetch = reg.counter(
+            "fleet_peer_fetch_total",
+            "peer-tier fetch attempts by owner and outcome",
+            ("peer", "outcome"))
+        self._m_latency = reg.histogram(
+            "fleet_peer_fetch_seconds",
+            "wall time of one peer-tier fetch attempt")
+        self.stale_tag_hits = 0   # 200s discarded on tag mismatch (== 0
+        #                           unless a server is misbehaving)
+
+    def _note_transport_failure(self, peer_id: str):
+        with self._lock:
+            n = self._consecutive_failures.get(peer_id, 0) + 1
+            if n >= self.fail_threshold:
+                # reset on trip: when something marks the peer back up
+                # it gets its full strike tolerance again, not a
+                # hair-trigger leftover count
+                self._consecutive_failures.pop(peer_id, None)
+            else:
+                self._consecutive_failures[peer_id] = n
+        if n >= self.fail_threshold:
+            # stop routing at it until something marks it back up; the
+            # registry bump makes every router rebuild its ring view
+            self.registry.mark(peer_id, up=False)
+
+    def _note_transport_ok(self, peer_id: str):
+        with self._lock:
+            self._consecutive_failures.pop(peer_id, None)
+
+    def get(self, key: str, trace=NULL_TRACE) -> Optional[CachedFold]:
+        owner = self.router.owner_for(key)
+        if owner is None or owner == self.self_id:
+            return None
+        info = self.registry.get(owner)
+        if info is None or info.peer_addr is None:
+            return None
+        tag = self.rollout.tag if self.rollout is not None else ""
+        host, port = info.peer_addr
+        url = (f"http://{host}:{port}/cache/"
+               f"{urlparse.quote(key, safe='')}"
+               f"?tag={urlparse.quote(tag, safe='')}")
+        t0 = time.monotonic()
+        outcome, value = "error", None
+        try:
+            with urlrequest.urlopen(url, timeout=self.timeout_s) as resp:
+                served_tag = resp.headers.get(_TAG_HEADER)
+                body = resp.read()
+            if served_tag is not None and served_tag != tag:
+                with self._lock:
+                    self.stale_tag_hits += 1
+                outcome = "stale_tag"
+            else:
+                value = decode_fold(key, body)
+                outcome = "hit"
+            self._note_transport_ok(owner)
+        except urlerror.HTTPError as exc:
+            # 404 = clean miss, 409 = rollout tag mismatch; both prove
+            # the transport is alive
+            outcome = ("miss" if exc.code == 404
+                       else "stale_tag" if exc.code == 409 else "error")
+            self._note_transport_ok(owner)
+            if outcome == "error":
+                self._note_transport_failure(owner)
+        except ValueError:
+            outcome = "corrupt"       # decode_fold: bad bytes, live peer
+            self._note_transport_ok(owner)
+        except Exception:
+            outcome = "error"         # refused/timeout/reset
+            self._note_transport_failure(owner)
+        self._m_latency.observe(time.monotonic() - t0)
+        self._m_fetch.inc(peer=owner, outcome=outcome)
+        trace.event("peer_fetch", peer=owner, outcome=outcome)
+        return value

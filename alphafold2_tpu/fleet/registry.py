@@ -1,0 +1,256 @@
+"""Fleet membership + weight-rollout state.
+
+Two kinds of epoch live here, deliberately separate:
+
+- the MEMBERSHIP epoch (`ReplicaRegistry.epoch`) bumps whenever the set
+  of replicas or their health marks change, so consistent-hash routers
+  know to rebuild their ring — a router never scans the registry on the
+  submit hot path, it compares one integer;
+- the MODEL epoch (`RolloutState.epoch`) bumps on weight rollout
+  (`bump(new_tag)`), atomically retagging every component that keys or
+  serves cached folds. Cache keys already namespace by `model_tag`
+  (cache/keys.py), so a bump makes every pre-rollout entry unreachable
+  by construction; the peer protocol additionally REJECTS cross-tag
+  fetches (HTTP 409) so a replica that has not rolled yet can never be
+  served a stale fold by one that has, or vice versa — HelixFold's
+  operational rule that the model version namespaces everything cached.
+
+Health is mark-driven plus optional heartbeat staleness: a replica is
+healthy iff it is marked up AND (when `heartbeat_timeout_s` is set) its
+last heartbeat is fresh. Mark changes bump the membership epoch;
+heartbeat staleness does not (routers skip unhealthy members at lookup
+time, so the ring itself need not rebuild).
+
+Everything is process-local state: in a real deployment this registry
+is fed by whatever control plane owns membership (k8s endpoints, a
+gossip layer); the serving stack only ever reads it through this
+interface, so the in-process two-replica harness (fleet/local.py) and a
+networked deployment exercise identical code paths.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from alphafold2_tpu.obs.registry import MetricsRegistry, get_registry
+
+
+class RolloutState:
+    """Fleet-wide (model_tag, epoch), thread-safe, with subscribers.
+
+    `bump(new_tag)` is THE weight-rollout switch: it advances the model
+    epoch, re-tags the fleet, and notifies subscribers (schedulers
+    re-key, peer servers start rejecting the old tag) before returning —
+    so by the time a rollout driver sees `bump` return, no component
+    will serve or fetch a stale-tag fold. Subscribers run under the
+    state lock: keep them O(1) attribute writes (the in-process harness
+    uses them to swap each Scheduler.model_tag)."""
+
+    def __init__(self, model_tag: str = "",
+                 registry: Optional[MetricsRegistry] = None):
+        self._lock = threading.Lock()
+        self._tag = model_tag
+        self._epoch = 0
+        self._subscribers: List[Callable[[str, int], None]] = []
+        reg = registry or get_registry()
+        self._m_epoch = reg.gauge(
+            "fleet_model_epoch", "current weight-rollout epoch")
+        self._m_rollouts = reg.counter(
+            "fleet_rollouts_total", "model_tag epoch bumps")
+        self._m_epoch.set(0)
+
+    @property
+    def tag(self) -> str:
+        with self._lock:
+            return self._tag
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def current(self) -> Tuple[str, int]:
+        with self._lock:
+            return self._tag, self._epoch
+
+    def subscribe(self, fn: Callable[[str, int], None]):
+        """fn(tag, epoch) runs on every bump, under the state lock."""
+        with self._lock:
+            self._subscribers.append(fn)
+
+    def bump(self, new_tag: str) -> int:
+        """Roll the fleet to `new_tag`. Returns the new model epoch."""
+        with self._lock:
+            if new_tag == self._tag:
+                return self._epoch      # idempotent re-announce
+            self._tag = new_tag
+            self._epoch += 1
+            epoch = self._epoch
+            subs = list(self._subscribers)
+            for fn in subs:
+                try:
+                    fn(new_tag, epoch)
+                except Exception:
+                    pass    # a broken subscriber must not block rollout
+        self._m_rollouts.inc()
+        self._m_epoch.set(epoch)
+        return epoch
+
+
+@dataclass
+class ReplicaInfo:
+    """One fleet member as the registry sees it.
+
+    peer_addr: (host, port) of its PeerCacheServer, None when the
+        replica exposes no peer cache tier.
+    submit: transport for request forwarding — a callable taking a
+        FoldRequest and returning a FoldTicket (in-process: the peer
+        Scheduler.submit bound method; a networked deployment plugs an
+        RPC stub with the same signature). None = not forwardable.
+    """
+
+    replica_id: str
+    peer_addr: Optional[Tuple[str, int]] = None
+    submit: Optional[Callable[[Any], Any]] = None
+    marked_up: bool = True
+    last_heartbeat_s: float = field(default=0.0)
+
+
+class ReplicaRegistry:
+    """Membership + health + epochs for one logical serving fleet.
+
+    heartbeat_timeout_s: when set, a replica also needs a heartbeat
+        within this window to count as healthy; None (default) makes
+        health purely mark-driven — deterministic for tests and for
+        control planes that push liveness instead of pulling it.
+    `clock` is injectable for tests (monotonic seconds).
+    """
+
+    def __init__(self, heartbeat_timeout_s: Optional[float] = None,
+                 model_tag: str = "",
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: Optional[MetricsRegistry] = None):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._members: Dict[str, ReplicaInfo] = {}
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.epoch = 0                 # membership epoch, lock-guarded
+        reg = registry or get_registry()
+        self.rollout = RolloutState(model_tag, registry=reg)
+        self._m_healthy = reg.gauge(
+            "fleet_replicas_healthy", "replicas currently routable")
+        self._m_members = reg.gauge(
+            "fleet_replicas_registered", "replicas in the registry")
+
+    # -- membership ------------------------------------------------------
+
+    def register(self, replica_id: str,
+                 peer_addr: Optional[Tuple[str, int]] = None,
+                 submit: Optional[Callable] = None) -> ReplicaInfo:
+        """Add (or re-announce) a member; bumps the membership epoch.
+        A re-announce UPDATES the existing row: fields not provided
+        (peer_addr/submit left None) are preserved, as is an
+        administrative down-mark — a periodic control-plane re-announce
+        must neither strip a live member's forwarding transport nor
+        resurrect a replica an operator pulled out."""
+        with self._lock:
+            info = self._members.get(replica_id)
+            if info is None:
+                info = ReplicaInfo(replica_id, peer_addr=peer_addr,
+                                   submit=submit,
+                                   last_heartbeat_s=self._clock())
+                self._members[replica_id] = info
+            else:
+                if peer_addr is not None:
+                    info.peer_addr = peer_addr
+                if submit is not None:
+                    info.submit = submit
+                info.last_heartbeat_s = self._clock()
+            self.epoch += 1
+        self._report_gauges()
+        return info
+
+    def deregister(self, replica_id: str):
+        with self._lock:
+            if self._members.pop(replica_id, None) is not None:
+                self.epoch += 1
+        self._report_gauges()
+
+    def get(self, replica_id: str) -> Optional[ReplicaInfo]:
+        with self._lock:
+            return self._members.get(replica_id)
+
+    def members(self) -> List[ReplicaInfo]:
+        """All registered members, sorted by id (healthy or not)."""
+        with self._lock:
+            return [self._members[k] for k in sorted(self._members)]
+
+    def member_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    # -- health ----------------------------------------------------------
+
+    def heartbeat(self, replica_id: str):
+        """Freshness ping; does NOT bump the epoch (routers check
+        staleness at lookup time, the ring does not change)."""
+        with self._lock:
+            info = self._members.get(replica_id)
+            if info is not None:
+                info.last_heartbeat_s = self._clock()
+
+    def mark(self, replica_id: str, up: bool):
+        """Administrative health mark; epoch bumps only on a change."""
+        changed = False
+        with self._lock:
+            info = self._members.get(replica_id)
+            if info is not None and info.marked_up != up:
+                info.marked_up = up
+                if up:
+                    info.last_heartbeat_s = self._clock()
+                self.epoch += 1
+                changed = True
+        if changed:
+            self._report_gauges()
+
+    def is_healthy(self, replica_id: str) -> bool:
+        with self._lock:
+            return self._healthy_locked(self._members.get(replica_id))
+
+    def _healthy_locked(self, info: Optional[ReplicaInfo]) -> bool:
+        if info is None or not info.marked_up:
+            return False
+        if self.heartbeat_timeout_s is None:
+            return True
+        return (self._clock() - info.last_heartbeat_s
+                <= self.heartbeat_timeout_s)
+
+    # -- views -----------------------------------------------------------
+
+    def _report_gauges(self):
+        with self._lock:
+            healthy = sum(1 for i in self._members.values()
+                          if self._healthy_locked(i))
+            total = len(self._members)
+        self._m_healthy.set(healthy)
+        self._m_members.set(total)
+
+    def snapshot(self) -> dict:
+        tag, model_epoch = self.rollout.current()
+        with self._lock:
+            members = {
+                rid: {"healthy": self._healthy_locked(info),
+                      "marked_up": info.marked_up,
+                      "peer_addr": (list(info.peer_addr)
+                                    if info.peer_addr else None),
+                      "forwardable": info.submit is not None}
+                for rid, info in sorted(self._members.items())}
+            return {"epoch": self.epoch,
+                    "model_tag": tag,
+                    "model_epoch": model_epoch,
+                    "replicas": members,
+                    "healthy": sum(1 for m in members.values()
+                                   if m["healthy"])}

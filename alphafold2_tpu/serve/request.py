@@ -34,6 +34,10 @@ class FoldRequest:
     priority: higher folds first when a batch is formed from a backlog.
     deadline_s: wall-clock budget from submit; past it the request is
         shed with status "shed" instead of occupying accelerator time.
+    forwarded: this request already took its one fleet-routing hop
+        (fleet.ConsistentHashRouter); the receiving scheduler serves it
+        locally regardless of its own ring view, so divergent membership
+        views can bounce a request once, never loop it.
     """
 
     seq: np.ndarray
@@ -41,6 +45,7 @@ class FoldRequest:
     request_id: str = field(default_factory=_next_request_id)
     priority: int = 0
     deadline_s: Optional[float] = None
+    forwarded: bool = False
 
     def __post_init__(self):
         self.seq = np.asarray(self.seq, dtype=np.int32)
@@ -70,7 +75,10 @@ class FoldResponse:
     source: how the result was obtained — "fold" (ran on the
             accelerator), "cache" (content-addressed result store hit),
             "coalesced" (attached to an identical in-flight fold; for
-            non-ok statuses this marks leader-state propagation).
+            non-ok statuses this marks leader-state propagation),
+            "forwarded" (routed to its fleet owner replica, which
+            folded/served it; the local process never touched the
+            accelerator for it).
     """
 
     request_id: str
@@ -94,10 +102,38 @@ class FoldTicket:
         self.request_id = request_id
         self._event = threading.Event()
         self._response: Optional[FoldResponse] = None
+        self._lock = threading.Lock()
+        self._callbacks: list = []
 
     def _resolve(self, response: FoldResponse):
         self._response = response
         self._event.set()
+        with self._lock:
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            try:
+                cb(response)
+            except Exception:
+                pass          # a broken observer never blocks resolution
+
+    def add_done_callback(self, fn):
+        """Run `fn(response)` when (or immediately if) this ticket
+        resolves. Callbacks run on the resolving thread (the scheduler
+        worker for folded requests) — keep them short and never let
+        them block; exceptions are swallowed. This is the chaining seam
+        fleet forwarding uses: a local ticket resolves off the remote
+        replica's ticket without parking a waiter thread per request."""
+        run_now = False
+        with self._lock:
+            if self._event.is_set():
+                run_now = True
+            else:
+                self._callbacks.append(fn)
+        if run_now:
+            try:
+                fn(self._response)
+            except Exception:
+                pass
 
     def done(self) -> bool:
         return self._event.is_set()

@@ -29,6 +29,15 @@ unique traffic (worst-case transient residency is < 2x queue_limit:
 a leader gates its own enqueue on queue depth alone — counting its own
 parked followers there would be a circular wait).
 
+With a `router` (fleet.ConsistentHashRouter — OFF by default), a novel
+fold whose key hashes to another healthy replica takes one bounded
+forwarding hop to that owner at submit, so duplicate traffic coalesces
+fleet-wide on one leader instead of once per process; any forwarding
+trouble falls back to folding locally. A leader that is shed (or
+rejected at submit) no longer sheds its parked followers: the
+tightest-deadline survivor is PROMOTED to leader and enqueued, the
+rest stay parked behind it (`coalesce_leader_promotions_total`).
+
 Unlike a leader, a parked follower DOES get its own deadline enforced:
 if it expires while waiting on the leader, the follower is shed with
 its own terminal state (`status="shed"`, reason
@@ -55,6 +64,7 @@ with a `parallel.mesh`-sharded one and this file does not change.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from collections import deque
@@ -104,7 +114,7 @@ class SchedulerConfig:
 
 class _Entry:
     __slots__ = ("request", "ticket", "bucket_len", "enqueued_at",
-                 "deadline", "cache_key", "store_key", "trace")
+                 "deadline", "cache_key", "store_key", "trace", "route")
 
     def __init__(self, request: FoldRequest, bucket_len: int):
         self.request = request
@@ -116,6 +126,7 @@ class _Entry:
         # populates the store, it just has no followers to settle
         self.store_key: Optional[str] = None
         self.trace = NULL_TRACE                # set by submit()
+        self.route = None       # fleet RouteDecision, computed at most once
         self.mark_enqueued()
 
     def resolve(self, response: FoldResponse):
@@ -144,11 +155,24 @@ class Scheduler:
         coalescing (both off when None — the default). model_tag
         namespaces cache keys by model identity; REQUIRED to be
         meaningful whenever the cache outlives one (model, params),
-        e.g. any disk-backed store shared across restarts.
+        e.g. any disk-backed store shared across restarts. Reassigning
+        `model_tag` (a weight rollout — fleet.RolloutState subscribers
+        do this) atomically re-keys every subsequent submit; old-tag
+        entries become unreachable by construction.
     tracer: optional obs.Tracer for request-scoped traces (None — the
         default — is the zero-cost NULL_TRACER).
     registry: obs.MetricsRegistry the coalescing/follower-deadline
         counters report into (None = process default).
+    router: optional fleet.ConsistentHashRouter (OFF when None — the
+        default). When set, a request whose fold_key hashes to another
+        healthy replica is FORWARDED there (one hop, bounded by
+        FoldRequest.forwarded) so duplicate traffic coalesces fleet-wide
+        on the key's owner; any forwarding trouble — owner down, no
+        transport, remote backpressure — falls back to folding locally
+        (fleet state can cost efficiency, never availability). The
+        remote result resolves the local ticket via a done-callback and
+        populates the local store on the way, so repeat traffic for the
+        key turns into local cache hits.
     """
 
     def __init__(self, executor: FoldExecutor, buckets: BucketPolicy,
@@ -157,13 +181,15 @@ class Scheduler:
                  cache: Optional[FoldCache] = None,
                  model_tag: str = "",
                  tracer: Optional[Tracer] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 router=None):
         self.executor = executor
         self.buckets = buckets
         self.config = config or SchedulerConfig()
         self.metrics = metrics or ServeMetrics()
         self.cache = cache
         self.model_tag = model_tag
+        self.router = router
         self.tracer = tracer or NULL_TRACER
         self._c_follower_deadline = (registry or get_registry()).counter(
             "serve_follower_deadline_exceeded_total",
@@ -227,12 +253,15 @@ class Scheduler:
         entry = _Entry(request, bucket_len)
         entry.trace = self.tracer.start_trace(request.request_id)
         entry.trace.begin("submit")
-        if self.cache is not None:
+        if self.cache is not None or self.router is not None:
             with self._cond:
                 if not self._running:
                     entry.trace.finish("error", error="submit before start")
                     raise RuntimeError("Scheduler.submit() before start()")
-            if self._serve_from_cache_or_coalesce(entry):
+            if self.cache is not None \
+                    and self._serve_from_cache_or_coalesce(entry):
+                return entry.ticket
+            if self._maybe_forward(entry):
                 return entry.ticket
         try:
             with self._cond:
@@ -268,16 +297,20 @@ class Scheduler:
                 self._cond.notify_all()
         except BaseException as exc:
             # a leader that never made it into the queue still owes its
-            # followers a settlement — error out anyone who attached in
-            # the window between precheck and the raise
-            entry.trace.finish(
-                "rejected" if isinstance(exc, QueueFullError) else "error",
-                error=str(exc))
-            self._settle_followers(entry, FoldResponse(
-                request_id=request.request_id, status="error",
-                bucket_len=bucket_len,
-                error="coalescing leader rejected at submit "
-                      "(queue full or scheduler stopped)"))
+            # followers an exit: on a queue-full rejection, promote the
+            # tightest-deadline survivor to leader (its siblings stay
+            # parked behind it) — a rejected leader must not turn N
+            # viable duplicates into N errors; on anything else (the
+            # scheduler stopped mid-submit) error out the group
+            rejected = isinstance(exc, QueueFullError)
+            entry.trace.finish("rejected" if rejected else "error",
+                               error=str(exc))
+            if not (rejected and self._promote_follower(entry)):
+                self._settle_followers(entry, FoldResponse(
+                    request_id=request.request_id, status="error",
+                    bucket_len=bucket_len,
+                    error="coalescing leader rejected at submit "
+                          "(queue full or scheduler stopped)"))
             raise
         self.metrics.record_enqueued(depth)
         return entry.ticket
@@ -297,7 +330,15 @@ class Scheduler:
         broken cache must cost a recompute, never fail a submit."""
         try:
             key = self._cache_key_for(entry.request)
-            cached = self.cache.get(key, trace=entry.trace)
+            # route BEFORE the cache lookup: a key this replica is
+            # about to forward must not pay a guaranteed-miss peer
+            # fetch to the very owner the request is going to (worst
+            # case a full peer timeout when the owner is down, ahead
+            # of a forward that would also fail) — the memory/disk
+            # tiers still answer, only the network tier is skipped
+            will_forward = self._route(entry, key)
+            cached = self.cache.get(key, trace=entry.trace,
+                                    peer=not will_forward)
         except Exception:                     # get() never raises; keying
             self.metrics.record_cache_miss()  # trouble degrades to a miss
             return False
@@ -354,6 +395,138 @@ class Scheduler:
         entry.cache_key = key                 # leader: enqueue + settle
         return False
 
+    # -- fleet routing ---------------------------------------------------
+
+    def _route(self, entry: _Entry, key: str) -> bool:
+        """Compute (once) and remember the routing decision for `key`;
+        True iff the plan is to forward. Routing trouble of any kind
+        means 'serve locally'."""
+        if self.router is None or entry.request.forwarded:
+            return False
+        try:
+            entry.route = self.router.route(key)
+        except Exception:
+            return False
+        return not entry.route.is_local
+
+    def _maybe_forward(self, entry: _Entry) -> bool:
+        """submit() fleet hop: True when the entry was handed to its
+        consistent-hash owner (the remote ticket resolves ours via a
+        done-callback). False — fold locally — when routing is off, the
+        request already took its one hop, the key hashes home, or
+        ANYTHING about forwarding fails: fleet state degrades to
+        single-host behavior, it never degrades availability."""
+        if self.router is None or entry.request.forwarded:
+            return False
+        key = entry.cache_key or entry.store_key
+        if key is None:               # router without cache still routes
+            try:
+                key = self._cache_key_for(entry.request)
+            except Exception:
+                return False
+        if entry.route is None:      # not computed by the cache fast path
+            self._route(entry, key)
+        decision = entry.route
+        if decision is None:         # routing trouble: serve locally
+            return False
+        if decision.is_local:
+            if decision.reason != "local_owner":
+                entry.trace.event("routed", owner=decision.owner_id or "",
+                                  reason=decision.reason)
+            return False
+        owner = decision.owner_id
+        entry.trace.event("routed", owner=owner, reason=decision.reason)
+        entry.trace.begin("forward")
+        try:
+            remote = self.router.forward(
+                owner, dataclasses.replace(entry.request, forwarded=True))
+        except Exception:
+            # owner vanished / transport error / remote backpressure:
+            # local fallback (the fold is still correct, just not
+            # fleet-deduplicated)
+            self.router.note_fallback("forward_error")
+            entry.trace.end("forward", failed=True)
+            return False
+        entry.trace.end("submit")
+
+        def _on_remote(resp: FoldResponse):
+            now = time.monotonic()
+            entry.trace.end("forward", owner=owner)
+            try:
+                local = FoldResponse(
+                    request_id=entry.request.request_id,
+                    status=resp.status,
+                    coords=(None if resp.coords is None
+                            else np.array(resp.coords, np.float32,
+                                          copy=True)),
+                    confidence=(None if resp.confidence is None
+                                else np.array(resp.confidence, np.float32,
+                                              copy=True)),
+                    bucket_len=(resp.bucket_len
+                                if resp.bucket_len is not None
+                                else entry.bucket_len),
+                    latency_s=now - entry.enqueued_at,
+                    # "forwarded", not the remote's source: THIS replica
+                    # did not fold it, and the trace checker's
+                    # fold-span-required rule keys off source == "fold"
+                    error=resp.error, source="forwarded")
+            except Exception as exc:   # e.g. MemoryError on the copies
+                local = FoldResponse(
+                    request_id=entry.request.request_id, status="error",
+                    bucket_len=entry.bucket_len,
+                    error=f"forwarded response adaptation failed: "
+                          f"{exc!r}")
+            try:
+                # populates the local store (repeat traffic for this key
+                # becomes a local hit) and settles local followers
+                self._resolve_entry(entry, local)
+            except Exception:
+                entry.resolve(local)   # never orphan the caller's ticket
+
+        remote.add_done_callback(_on_remote)
+        return True
+
+    def _promote_follower(self, entry: _Entry) -> bool:
+        """A coalescing leader dropped out without a result (shed while
+        queued, rejected at submit): crown its tightest-deadline parked
+        follower as the new leader and enqueue it; the remaining
+        followers stay parked behind the new leader. Returns False when
+        there is nothing to promote (not a leader, no followers, or the
+        scheduler is no longer running — the caller then settles the
+        group with the old leader's terminal state)."""
+        if entry.cache_key is None:
+            return False
+
+        def _tightest(followers: List[_Entry]) -> _Entry:
+            # min absolute deadline first; deadline-free followers have
+            # infinite slack and go last
+            return min(followers,
+                       key=lambda f: (f.deadline is None,
+                                      f.deadline if f.deadline is not None
+                                      else 0.0))
+
+        with self._cond:
+            if not self._running:
+                return False
+            # lock order _cond -> registry lock, same as the attach path
+            promoted = self._inflight.promote(entry.cache_key, _tightest)
+            if promoted is None:
+                return False
+            promoted.cache_key = entry.cache_key
+            promoted.trace.event("leader_promoted",
+                                 from_trace=entry.trace.trace_id)
+            promoted.trace.end("parked")
+            promoted.trace.begin("queue")
+            # parked -> queued conversion: waiting() shrank by one as
+            # _depth grows by one, so the bounded-queue invariant
+            # (depth + waiting <= limit) is preserved, not re-checked
+            self._incoming.append(promoted)
+            self._depth += 1
+            depth = self._depth
+            self._cond.notify_all()
+        self.metrics.record_enqueued(depth)
+        return True
+
     def _settle_followers(self, entry: _Entry, response: FoldResponse):
         """Fan the leader's terminal response out to its followers.
         Called from EVERY path that resolves a leader ticket, success or
@@ -394,7 +567,10 @@ class Scheduler:
     def _resolve_entry(self, entry: _Entry, response: FoldResponse):
         """Terminal state for one queued entry: populate the store (ok
         only, BEFORE followers settle so late duplicates hit the cache),
-        resolve the leader ticket, fan out to followers."""
+        resolve the leader ticket, fan out to followers — except a SHED
+        leader, whose surviving followers get a promoted leader instead
+        of inheriting the shed (the group's work is still viable; only
+        this request's deadline died)."""
         put_key = entry.cache_key or entry.store_key
         if response.status == "ok" and self.cache is not None \
                 and put_key is not None:
@@ -405,6 +581,8 @@ class Scheduler:
                 except Exception:
                     pass              # a full/broken store never blocks
         entry.resolve(response)
+        if response.status == "shed" and self._promote_follower(entry):
+            return
         self._settle_followers(entry, response)
 
     def serve_stats(self) -> dict:
@@ -419,6 +597,8 @@ class Scheduler:
         if self.cache is not None:
             stats["cache"]["store"] = self.cache.snapshot()
             stats["cache"]["inflight"] = self._inflight.snapshot()
+        if self.router is not None:
+            stats["router"] = self.router.snapshot()
         with self._cond:
             stats["running"] = self._running
         return stats

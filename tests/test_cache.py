@@ -268,6 +268,61 @@ class TestFoldCacheDisk:
         assert fresh.stats.expirations == 1
         assert not os.path.exists(path)
 
+    def test_quarantine_reconciles_memory_resident_bytes(self, tmp_path):
+        """Regression (ISSUE 4): quarantining a corrupt disk entry whose
+        key is ALSO memory-resident must drop the memory copy WITH its
+        bytes accounting — a pop without the `bytes_resident` decrement
+        would leak the byte budget until restart."""
+        d = str(tmp_path / "store")
+        cache = FoldCache(disk_dir=d)
+        cache.put("dead0123", *fold_result())
+        assert cache.bytes_resident > 0 and len(cache) == 1
+        path = cache._path("dead0123")
+        with open(path, "wb") as fh:
+            fh.write(b"corrupt")
+        # the quarantine seam every corrupt-disk discovery (get /
+        # read_raw / a racing peer read) funnels through: it must
+        # reconcile the memory tier, not just rename the file
+        cache._quarantine(path, "dead0123")
+        assert cache.stats.disk_errors == 1
+        assert cache.bytes_resident == 0 and len(cache) == 0
+        snap = cache.snapshot()
+        assert snap["bytes_resident"] == 0
+        assert snap["entries_resident"] == 0
+        assert os.path.exists(path + ".quarantined")
+        assert cache.read_raw("dead0123") is None   # nothing re-served
+
+    def test_quarantine_drops_memory_copy_of_poisoned_key(self, tmp_path):
+        """get() on a corrupt disk entry quarantines AND purges any
+        memory-resident copy of the key, with bytes_resident reconciled
+        to zero — the two tiers never disagree about a poisoned key."""
+        d = str(tmp_path / "store")
+        now = [1000.0]
+        cache = FoldCache(ttl_s=60.0, disk_dir=d, clock=lambda: now[0])
+        cache.put("f00d0001", *fold_result())
+        path = cache._path("f00d0001")
+        with open(path, "wb") as fh:
+            fh.write(b"corrupt")
+        # keep the disk file inside its TTL window under the injected
+        # clock while the memory entry expires: get() then consults the
+        # (corrupt) disk exactly as a restarted/TTL-churned server would
+        os.utime(path, (1010.0, 1010.0))    # disk lease runs to 1070
+        now[0] = 1061.0                     # memory expired, disk not
+        assert cache.get("f00d0001") is None
+        assert cache.stats.disk_errors == 1
+        assert cache.bytes_resident == 0 and len(cache) == 0
+        assert os.path.exists(path + ".quarantined")
+
+    def test_invalidate_drops_both_tiers_with_accounting(self, tmp_path):
+        d = str(tmp_path / "store")
+        cache = FoldCache(disk_dir=d)
+        cache.put("aa00bb11", *fold_result())
+        assert cache.invalidate("aa00bb11")
+        assert cache.bytes_resident == 0 and len(cache) == 0
+        assert not os.path.exists(cache._path("aa00bb11"))
+        assert cache.get("aa00bb11") is None
+        assert not cache.invalidate("aa00bb11")   # idempotent
+
 
 @pytest.mark.quick
 class TestInflightRegistry:

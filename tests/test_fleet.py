@@ -1,0 +1,523 @@
+"""Fleet tier tests (ISSUE 4): replica registry + rollout epochs,
+consistent-hash routing (determinism, rebalance bounds, health walks),
+the npz-over-HTTP peer cache tier (hit/miss/409/corruption/failure
+markdown), the shared-volume object-store tier, coalescing leader
+promotion, and the two-replica in-process fleet end-to-end (route ->
+fleet-wide coalesce, owner-down local fallback, peer fetch feeding the
+local tiers, epoch-bump invalidation with zero stale-tag hits).
+
+The unit tier is no-model and (mostly) no-network; the peer-protocol
+tests use real localhost HTTP but no model; only the end-to-end class
+folds through a tiny Alphafold2 — everything stays in tier-1 (CPU,
+`-m 'not slow'`).
+"""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from alphafold2_tpu import fleet
+from alphafold2_tpu.cache import (FoldCache, InflightRegistry, decode_fold,
+                                  encode_fold, fold_key)
+from alphafold2_tpu.cache.store import CachedFold
+from alphafold2_tpu.obs.registry import MetricsRegistry
+from alphafold2_tpu.serve import (BucketPolicy, FoldRequest, Scheduler,
+                                  SchedulerConfig)
+
+MSA_DEPTH = 3
+
+
+def fold_value(n=6, seed=0):
+    rng = np.random.default_rng(seed)
+    return CachedFold(rng.normal(size=(n, 3)).astype(np.float32),
+                      rng.uniform(size=(n,)).astype(np.float32))
+
+
+@pytest.mark.quick
+class TestRolloutState:
+    def test_bump_epochs_and_subscribers(self):
+        st = fleet.RolloutState("v1", registry=MetricsRegistry())
+        seen = []
+        st.subscribe(lambda tag, epoch: seen.append((tag, epoch)))
+        assert st.current() == ("v1", 0)
+        assert st.bump("v2") == 1
+        assert st.current() == ("v2", 1)
+        # idempotent re-announce of the current tag: no epoch churn
+        assert st.bump("v2") == 1
+        assert seen == [("v2", 1)]
+
+    def test_broken_subscriber_never_blocks_rollout(self):
+        st = fleet.RolloutState("v1", registry=MetricsRegistry())
+        st.subscribe(lambda tag, epoch: 1 / 0)
+        assert st.bump("v2") == 1
+
+
+@pytest.mark.quick
+class TestReplicaRegistry:
+    def test_membership_epoch_bumps_on_change_only(self):
+        reg = fleet.ReplicaRegistry(registry=MetricsRegistry())
+        e0 = reg.epoch
+        reg.register("a")
+        reg.register("b")
+        assert reg.epoch == e0 + 2
+        reg.mark("a", up=False)
+        e1 = reg.epoch
+        reg.mark("a", up=False)          # no change, no bump
+        assert reg.epoch == e1
+        reg.heartbeat("b")               # freshness, not membership
+        assert reg.epoch == e1
+        reg.deregister("b")
+        assert reg.epoch == e1 + 1
+        assert reg.member_ids() == ["a"]
+
+    def test_heartbeat_timeout_health(self):
+        clock = [0.0]
+        reg = fleet.ReplicaRegistry(heartbeat_timeout_s=5.0,
+                                    clock=lambda: clock[0],
+                                    registry=MetricsRegistry())
+        reg.register("a")
+        assert reg.is_healthy("a")
+        clock[0] = 4.0
+        assert reg.is_healthy("a")
+        clock[0] = 6.0
+        assert not reg.is_healthy("a")   # stale heartbeat
+        reg.heartbeat("a")
+        assert reg.is_healthy("a")
+        reg.mark("a", up=False)          # admin mark beats freshness
+        assert not reg.is_healthy("a")
+
+
+@pytest.mark.quick
+class TestConsistentHashRouter:
+    def _fleet(self, ids=("a", "b", "c")):
+        reg = fleet.ReplicaRegistry(registry=MetricsRegistry())
+        for rid in ids:
+            reg.register(rid)
+        return reg
+
+    def test_deterministic_across_router_instances(self):
+        reg = self._fleet()
+        ra = fleet.ConsistentHashRouter(reg, "a",
+                                        metrics=MetricsRegistry())
+        rb = fleet.ConsistentHashRouter(reg, "b",
+                                        metrics=MetricsRegistry())
+        keys = [f"key{i}" for i in range(200)]
+        # every replica computes the same ownership map (blake2b, not
+        # process-seeded hash()) — the property fleet-wide coalescing
+        # rests on
+        assert [ra.owner_for(k) for k in keys] \
+            == [rb.owner_for(k) for k in keys]
+
+    def test_rebalance_moves_only_departed_keys(self):
+        reg = self._fleet()
+        router = fleet.ConsistentHashRouter(reg, "a",
+                                            metrics=MetricsRegistry())
+        keys = [f"key{i}" for i in range(400)]
+        before = {k: router.owner_for(k) for k in keys}
+        reg.deregister("c")
+        after = {k: router.owner_for(k) for k in keys}
+        # consistent hashing's contract: keys NOT owned by the departed
+        # replica keep their owner
+        for k in keys:
+            if before[k] != "c":
+                assert after[k] == before[k]
+        assert all(o in ("a", "b") for o in after.values())
+
+    def test_unhealthy_owner_skipped_and_empty_ring(self):
+        reg = self._fleet(("a", "b"))
+        router = fleet.ConsistentHashRouter(reg, "a",
+                                            metrics=MetricsRegistry())
+        k = next(f"key{i}" for i in range(1000)
+                 if router.owner_for(f"key{i}") == "b")
+        reg.mark("b", up=False)
+        assert router.owner_for(k) == "a"
+        reg.mark("a", up=False)
+        assert router.owner_for(k) is None
+        assert router.route(k).is_local   # never errors, always a seat
+
+    def test_route_decisions(self):
+        reg = self._fleet(("a", "b"))
+        router = fleet.ConsistentHashRouter(reg, "a",
+                                            metrics=MetricsRegistry())
+        k_local = next(f"key{i}" for i in range(1000)
+                       if router.owner_for(f"key{i}") == "a")
+        k_remote = next(f"key{i}" for i in range(1000)
+                        if router.owner_for(f"key{i}") == "b")
+        assert router.route(k_local).reason == "local_owner"
+        # b exposes no submit transport: local fold, reason says why
+        d = router.route(k_remote)
+        assert d.is_local and d.reason == "not_forwardable"
+        tickets = []
+        reg.get("b").submit = lambda req: tickets.append(req) or "ticket"
+        d = router.route(k_remote)
+        assert not d.is_local and d.reason == "forward"
+        assert router.forward("b", "the-request") == "ticket"
+        assert tickets == ["the-request"]
+
+
+@pytest.mark.quick
+class TestObjectStoreTier:
+    def test_filesystem_roundtrip_and_corruption(self, tmp_path):
+        store = fleet.FilesystemObjectStore(str(tmp_path))
+        v = fold_value()
+        store.put("k1", encode_fold("k1", v))
+        assert decode_fold("k1", store.get("k1")).coords.shape == (6, 3)
+        assert store.get("absent") is None
+        assert len(store) == 1
+        peer = fleet.ObjectStorePeer(store, metrics=MetricsRegistry())
+        got = peer.get("k1")
+        assert np.allclose(got.coords, v.coords)
+        # corrupt object: miss, and deleted so the fleet stops re-parsing
+        store.put("bad", b"not an npz")
+        assert peer.get("bad") is None
+        assert store.get("bad") is None
+
+    def test_fold_cache_write_through_shares_across_replicas(
+            self, tmp_path):
+        store = fleet.FilesystemObjectStore(str(tmp_path))
+        reg = MetricsRegistry()
+        a = FoldCache(peer=fleet.ObjectStorePeer(store, metrics=reg),
+                      peer_write_through=True, registry=reg)
+        b = FoldCache(peer=fleet.ObjectStorePeer(store, metrics=reg),
+                      registry=reg)
+        v = fold_value(n=5, seed=3)
+        a.put("k", v.coords, v.confidence)
+        got = b.get("k")                  # b never folded: shared-store hit
+        assert got is not None and np.allclose(got.coords, v.coords)
+        assert b.stats.snapshot()["peer_hits"] == 1
+        assert b.get("k") is not None     # promoted into b's memory tier
+        assert b.stats.snapshot()["peer_hits"] == 1
+
+
+class TestPeerProtocol:
+    """Real localhost HTTP, no model: the npz-over-HTTP tier."""
+
+    def _wire(self, model_tag="v1"):
+        reg = fleet.ReplicaRegistry(model_tag=model_tag,
+                                    registry=MetricsRegistry())
+        owner_cache = FoldCache(registry=MetricsRegistry())
+        srv = fleet.PeerCacheServer(owner_cache, rollout=reg.rollout,
+                                    replica_id="r1",
+                                    metrics=MetricsRegistry()).start()
+        reg.register("r0")
+        reg.register("r1", peer_addr=srv.address)
+        router = fleet.ConsistentHashRouter(reg, "r0",
+                                            metrics=MetricsRegistry())
+        client = fleet.PeerCacheClient(reg, "r0", router=router,
+                                       rollout=reg.rollout,
+                                       metrics=MetricsRegistry())
+        local = FoldCache(peer=client, registry=MetricsRegistry())
+        k = next(f"key{i}" for i in range(1000)
+                 if router.owner_for(f"key{i}") == "r1")
+        return reg, owner_cache, srv, client, local, k
+
+    def test_remote_hit_promotes_into_local_memory(self):
+        reg, owner_cache, srv, client, local, k = self._wire()
+        try:
+            v = fold_value(n=7, seed=1)
+            owner_cache.put(k, v.coords, v.confidence)
+            got = local.get(k)
+            assert got is not None and np.allclose(got.coords, v.coords)
+            snap = local.stats.snapshot()
+            assert snap["peer_hits"] == 1 and snap["hits"] == 1
+            # second get: memory tier, no second fetch
+            assert local.get(k) is not None
+            assert local.stats.snapshot()["peer_hits"] == 1
+        finally:
+            srv.stop()
+
+    def test_miss_and_owner_side_keys(self):
+        reg, owner_cache, srv, client, local, k = self._wire()
+        try:
+            assert local.get(k) is None               # clean remote miss
+            assert local.stats.snapshot()["misses"] == 1
+        finally:
+            srv.stop()
+
+    def test_stale_tag_rejected_409(self):
+        reg, owner_cache, srv, client, local, k = self._wire()
+        try:
+            v = fold_value()
+            owner_cache.put(k, v.coords, v.confidence)
+            host, port = srv.address
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://{host}:{port}/cache/{k}?tag=WRONG",
+                    timeout=5)
+            assert ei.value.code == 409
+            # a straggler client still on the old tag after a fleet
+            # bump gets misses, never stale folds
+            straggler = fleet.PeerCacheClient(
+                reg, "r0", rollout=fleet.RolloutState(
+                    "old", registry=MetricsRegistry()),
+                metrics=MetricsRegistry())
+            assert straggler.get(k) is None
+            assert straggler.stale_tag_hits == 0
+        finally:
+            srv.stop()
+
+    def test_corrupt_bytes_is_miss_not_error(self):
+        # a hostile/buggy peer returning 200 with garbage: the client's
+        # decode_fold validation turns it into a clean miss and does
+        # NOT mark the (transport-healthy) peer down
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        class _Garbage(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                body = b"definitely not an npz"
+                self.send_response(200)
+                self.send_header("X-Model-Tag", "v1")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Garbage)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            reg = fleet.ReplicaRegistry(model_tag="v1",
+                                        registry=MetricsRegistry())
+            reg.register("r0")
+            host, port = httpd.server_address[:2]
+            reg.register("r1", peer_addr=(str(host), int(port)))
+            client = fleet.PeerCacheClient(reg, "r0",
+                                           rollout=reg.rollout,
+                                           metrics=MetricsRegistry())
+            local = FoldCache(peer=client, registry=MetricsRegistry())
+            k = next(f"key{i}" for i in range(1000)
+                     if client.router.owner_for(f"key{i}") == "r1")
+            assert local.get(k) is None
+            assert reg.is_healthy("r1")
+            assert local.stats.snapshot()["misses"] == 1
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_transport_failures_mark_owner_down(self):
+        reg, owner_cache, srv, client, local, k = self._wire()
+        srv.stop()                        # owner gone; registry not told
+        for _ in range(client.fail_threshold):
+            assert local.get(k) is None
+        # consecutive transport failures marked it down: routing (and
+        # further peer fetches) now skip it
+        assert not reg.is_healthy("r1")
+        assert client.router.owner_for(k) == "r0"
+
+
+class _OkExecutor:
+    """Stub executor: deterministic coords, optional pre-run delay."""
+
+    def __init__(self, delay_s=0.0):
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def run(self, batch, num_recycles):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        self.calls += 1
+        b, n = batch["seq"].shape
+
+        class R:
+            coords = np.zeros((b, n, 3), np.float32)
+            confidence = np.full((b, n), 0.5, np.float32)
+
+        return R()
+
+    def stats(self):
+        return {"calls": self.calls}
+
+
+@pytest.mark.quick
+class TestLeaderPromotion:
+    def test_registry_promote_picks_and_keeps_rest_parked(self):
+        reg = InflightRegistry(registry=MetricsRegistry())
+        assert reg.attach("k", "leader")
+        assert not reg.attach("k", "f1")
+        assert not reg.attach("k", "f2")
+        promoted = reg.promote("k", lambda fs: fs[-1])
+        assert promoted == "f2"
+        assert reg.waiting() == 1          # f1 still parked
+        # later attachers see the NEW leader
+        is_leader, leader = reg.attach_with_leader("k", "f3")
+        assert not is_leader and leader == "f2"
+        assert sorted(reg.settle("k")) == ["f1", "f3"]
+        assert reg.snapshot()["leader_promotions"] == 1
+
+    def test_promote_with_no_followers_dissolves_group(self):
+        reg = InflightRegistry(registry=MetricsRegistry())
+        assert reg.attach("k", "leader")
+        assert reg.promote("k", lambda fs: fs[0]) is None
+        assert reg.attach("k", "fresh")    # next attach leads again
+        assert reg.snapshot()["leader_promotions"] == 0
+
+    def test_shed_leader_promotes_tightest_deadline_follower(self):
+        policy = BucketPolicy((16,))
+        config = SchedulerConfig(max_batch_size=4, max_wait_ms=600.0,
+                                 poll_ms=5.0, msa_depth=0)
+        cache = FoldCache(registry=MetricsRegistry())
+        sched = Scheduler(_OkExecutor(), policy, config, cache=cache,
+                          model_tag="promo", registry=MetricsRegistry())
+        seq = np.arange(12, dtype=np.int32) % 20
+        with sched:
+            # leader's deadline expires while queued (batch of 4 never
+            # fills, max_wait 600ms not reached at shed time)
+            t_lead = sched.submit(FoldRequest(seq=seq, deadline_s=0.15))
+            t_tight = sched.submit(FoldRequest(seq=seq, deadline_s=5.0))
+            t_loose = sched.submit(FoldRequest(seq=seq))   # no deadline
+            r_lead = t_lead.result(timeout=10)
+            r_tight = t_tight.result(timeout=10)
+            r_loose = t_loose.result(timeout=10)
+        assert r_lead.status == "shed"
+        # the group survived its leader: the tightest-deadline follower
+        # folded as the new leader, the loose one settled off it
+        assert r_tight.ok and r_tight.source == "fold"
+        assert r_loose.ok and r_loose.source == "coalesced"
+        assert np.allclose(r_tight.coords, r_loose.coords)
+        assert sched._inflight.snapshot()["leader_promotions"] == 1
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    import jax
+    import jax.numpy as jnp
+
+    from alphafold2_tpu import Alphafold2
+
+    model = Alphafold2(dim=32, depth=1, heads=2, dim_head=16,
+                       predict_coords=True, structure_module_depth=1)
+    n = 16
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, n), jnp.int32),
+        msa=jnp.zeros((1, MSA_DEPTH, n), jnp.int32),
+        mask=jnp.ones((1, n), bool),
+        msa_mask=jnp.ones((1, MSA_DEPTH, n), bool))
+    return model, params
+
+
+def _two_replica_fleet(model_and_params, **kwargs):
+    from alphafold2_tpu import serve
+
+    model, params = model_and_params
+    policy = BucketPolicy((16,))
+    config = SchedulerConfig(max_batch_size=2, max_wait_ms=10.0,
+                             msa_depth=MSA_DEPTH, poll_ms=2.0)
+    return fleet.InProcessFleet(
+        lambda: serve.FoldExecutor(model, params, max_entries=2),
+        policy, config, n_replicas=2, **kwargs)
+
+
+def _request(seed=0, n=12):
+    rng = np.random.default_rng(seed)
+    return FoldRequest(
+        seq=rng.integers(0, 20, size=n).astype(np.int32),
+        msa=rng.integers(0, 20, size=(MSA_DEPTH, n)).astype(np.int32))
+
+
+def _key_for(fl, req):
+    cfg = fl.replicas[0].scheduler.config
+    return fold_key(req.seq, req.msa, msa_depth=cfg.msa_depth,
+                    num_recycles=cfg.num_recycles,
+                    model_tag=fl.replicas[0].scheduler.model_tag)
+
+
+class TestTwoReplicaFleet:
+    def test_duplicates_across_replicas_fold_once(self, model_and_params):
+        with _two_replica_fleet(model_and_params, model_tag="v1") as fl:
+            req = _request(seed=1)
+            dup = FoldRequest(seq=req.seq, msa=req.msa)
+            t0 = fl.submit(req, replica=0)
+            t1 = fl.submit(dup, replica=1)
+            a, b = t0.result(timeout=120), t1.result(timeout=120)
+            assert a.ok and b.ok
+            assert np.allclose(a.coords, b.coords)
+            agg = fl.stats()["aggregate"]
+            # one of the two submits crossed a replica boundary (routing
+            # owns the key on exactly one side); fleet-wide the work ran
+            # once
+            assert agg["batches"] == 1
+            assert agg["cache_hits"] + agg["coalesced"] == 1
+            assert {a.source, b.source} <= {"fold", "forwarded",
+                                            "cache", "coalesced"}
+
+    def test_owner_down_local_fallback(self, model_and_params):
+        with _two_replica_fleet(model_and_params, model_tag="v1") as fl:
+            # find a request owned by r1 as seen from r0, then take r1
+            # down: r0 must fold it locally, not error
+            router = fl.replicas[0].router
+            req = next(r for r in (_request(seed=s) for s in range(50))
+                       if router.owner_for(_key_for(fl, r)) == "r1")
+            fl.mark("r1", up=False)
+            resp = fl.submit(req, replica=0).result(timeout=120)
+            assert resp.ok and resp.source == "fold"
+            assert fl.stats()["replicas"]["r0"]["served"] == 1
+
+    def test_forward_transport_error_falls_back_local(
+            self, model_and_params):
+        with _two_replica_fleet(model_and_params, model_tag="v1") as fl:
+            router = fl.replicas[0].router
+            req = next(r for r in (_request(seed=s) for s in range(50))
+                       if router.owner_for(_key_for(fl, r)) == "r1")
+
+            def _broken(request):
+                raise ConnectionError("transport down")
+
+            fl.registry.get("r1").submit = _broken
+            resp = fl.submit(req, replica=0).result(timeout=120)
+            assert resp.ok and resp.source == "fold"
+
+    def test_peer_fetch_feeds_local_memory_tier(self, model_and_params):
+        with _two_replica_fleet(model_and_params, model_tag="v1") as fl:
+            router = fl.replicas[0].router
+            req = next(r for r in (_request(seed=s) for s in range(50))
+                       if router.owner_for(_key_for(fl, r)) == "r1")
+            k = _key_for(fl, req)
+            # owner folds it through its own front door (no forwarding)
+            assert fl.submit(req, replica=1).result(timeout=120).ok
+            # r0 never folded the key: its cache answers via the peer
+            # tier and promotes into local memory
+            got = fl.replicas[0].cache.get(k)
+            assert got is not None
+            snap = fl.replicas[0].cache.stats.snapshot()
+            assert snap["peer_hits"] == 1
+            assert fl.replicas[0].cache.get(k) is not None
+            assert fl.replicas[0].cache.stats.snapshot()["peer_hits"] == 1
+
+    def test_epoch_bump_invalidates_old_tag_everywhere(
+            self, model_and_params):
+        with _two_replica_fleet(model_and_params, model_tag="v1") as fl:
+            req = _request(seed=9)
+            k_v1 = _key_for(fl, req)
+            assert fl.submit(req, replica=0).result(timeout=120).ok
+            assert fl.submit(
+                FoldRequest(seq=req.seq, msa=req.msa),
+                replica=0).result(timeout=120).source == "cache"
+
+            epoch = fl.bump_model_tag("v2")
+            assert epoch == 1
+            # every scheduler re-keyed before bump() returned
+            assert all(r.scheduler.model_tag == "v2"
+                       for r in fl.replicas)
+            # same content now folds fresh: old-tag entries unreachable
+            resp = fl.submit(FoldRequest(seq=req.seq, msa=req.msa),
+                             replica=0).result(timeout=120)
+            assert resp.ok and resp.source in ("fold", "forwarded")
+            # peer protocol refuses the old tag outright: a straggler
+            # client still keyed to v1 sees misses, zero stale hits
+            straggler = fleet.PeerCacheClient(
+                fl.registry, "r0",
+                rollout=fleet.RolloutState("v1",
+                                           registry=MetricsRegistry()),
+                metrics=MetricsRegistry())
+            assert straggler.get(k_v1) is None
+            assert straggler.stale_tag_hits == 0
+            for replica in fl.replicas:
+                client = replica.cache.peer
+                assert client is None or client.stale_tag_hits == 0

@@ -40,11 +40,19 @@ sys.path.insert(0, _REPO)
 from alphafold2_tpu.obs.export import SCHEMA_VERSION  # noqa: E402
 from alphafold2_tpu.utils.profiling import percentile  # noqa: E402
 
-# canonical stage order for the waterfall; unknown span names append
-STAGE_ORDER = ("submit", "queue", "parked", "batch_form", "compile",
-               "fold", "writeback", "cache_lookup", "write")
+# canonical stage order for the waterfall; unknown span names append.
+# forward (fleet routing hop) and peer_fetch (peer cache tier) arrive
+# with ISSUE 4 — --check's orphan-span rules apply to them unchanged.
+STAGE_ORDER = ("submit", "forward", "queue", "parked", "batch_form",
+               "compile", "fold", "writeback", "peer_fetch",
+               "cache_lookup", "write")
 
-_EPS = 1e-6   # span/trace boundary slack: offsets are rounded to 1e-6
+# span/trace boundary slack: start_s, dur_s, and duration_s are each
+# INDEPENDENTLY rounded to 1e-6 when emitted, so a span auto-closed at
+# finish time can legitimately show start+dur up to 1.5e-6 past the
+# trace duration (three half-ulp roundings) before float noise — 1e-6
+# exactly was a latent off-by-one-rounding flake
+_EPS = 2e-6
 
 
 def load_traces(path: str) -> Tuple[List[dict], List[str]]:

@@ -21,6 +21,19 @@ dup-rate > 0); the report then carries the cache section (hit ratio,
 coalesced count) and `executor_calls_avoided` — requests that never
 occupied the accelerator — next to folds/hour and padding waste.
 
+`--replicas N` (with N > 1) runs the workload against an in-process
+FLEET (`alphafold2_tpu.fleet.InProcessFleet`): N full serving stacks —
+each with its own executor, cache, and localhost peer-cache server —
+split the traffic round-robin (the dumb-load-balancer model).
+`--fleet {auto,on,off}` controls the fleet wiring itself (consistent-
+hash routing + peer cache tier; auto = on iff replicas > 1); `off` is
+the two-independent-replicas baseline the fleet run is measured
+against. `--rollout-at F` bumps the fleet-wide model tag after
+fraction F of the request budget — the report's `rollout` section
+carries `stale_tag_hits`, which must be 0 (the epoch bump's whole
+contract). The fleet report aggregates served/batches/hit-ratio
+fleet-wide plus forwards, peer hits, and leader promotions.
+
 `--trace-path F` enables request-scoped tracing (`obs.Tracer`): one
 JSONL record per completed request covering submit -> terminal with
 per-stage spans (submit/queue/batch_form/compile/fold/writeback),
@@ -77,7 +90,21 @@ def parse_args(argv=None):
                     help="result cache + coalescing; auto = on iff "
                          "--dup-rate > 0")
     ap.add_argument("--cache-dir", default="",
-                    help="optional on-disk tier for the result cache")
+                    help="optional on-disk tier for the result cache "
+                         "(per-replica subdirs in fleet mode)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="in-process serving replicas; > 1 runs the "
+                         "fleet harness with round-robin traffic split")
+    ap.add_argument("--fleet", default="auto",
+                    choices=("auto", "on", "off"),
+                    help="wire replicas into one fleet (consistent-hash "
+                         "routing + peer cache); auto = on iff "
+                         "--replicas > 1, off = independent-replicas "
+                         "baseline")
+    ap.add_argument("--rollout-at", type=float, default=0.0,
+                    help="bump the fleet-wide model tag after this "
+                         "fraction of the request budget (0 = never); "
+                         "fleet mode only")
     ap.add_argument("--dim", type=int, default=32)
     ap.add_argument("--depth", type=int, default=1)
     ap.add_argument("--metrics-path", default="/tmp/serve_loadtest.jsonl")
@@ -97,26 +124,49 @@ def parse_args(argv=None):
     return ap.parse_args(argv)
 
 
-def main(argv=None) -> int:
-    args = parse_args(argv)
-    import __graft_entry__
-    if args.platform == "cpu":
-        __graft_entry__.force_cpu_fallback()
+def _zipf_schedule(args, pool_len: int):
+    """Submission schedule over prototype indices: with --dup-rate, a
+    submission repeats an ALREADY-USED prototype with probability
+    dup_rate, picking it Zipf-ishly (first-seen rank r with weight
+    1/(r+1)) — duplicates are exact (same seq AND msa), so they are
+    cache/coalesce candidates. dup_rate=0 degenerates to the old
+    round-robin over unique prototypes."""
+    import numpy as np
 
-    import jax
-    import jax.numpy as jnp
+    sched_rng = np.random.default_rng(2)
+    schedule_len = args.requests if args.duration_s <= 0 else 4096
+    schedule, used = [], []
+    fresh_i = 0
 
-    from alphafold2_tpu import Alphafold2, serve
-    from alphafold2_tpu.data.synthetic import synthetic_requests
-    from alphafold2_tpu.utils.profiling import StepTimer
+    def zipf_pick():
+        w = 1.0 / (np.arange(len(used)) + 1.0)
+        return used[int(sched_rng.choice(len(used), p=w / w.sum()))]
 
-    lengths = tuple(int(x) for x in args.lengths.split(",") if x)
-    if args.buckets:
-        policy = serve.BucketPolicy(
-            int(x) for x in args.buckets.split(",") if x)
-    else:
-        policy = serve.BucketPolicy.powers_of_two(
-            min(lengths), max(max(lengths), min(lengths)))
+    for _ in range(max(schedule_len, 1)):
+        if used and sched_rng.random() < args.dup_rate:
+            j = zipf_pick()
+        elif fresh_i < pool_len:
+            j = fresh_i
+            fresh_i += 1
+            used.append(j)
+        elif args.dup_rate > 0:
+            # unique budget exhausted on a duplicate-heavy run: an
+            # explicit Zipf repeat, keeping `used` duplicate-free so the
+            # 1/(rank+1) weights stay meaningful
+            j = zipf_pick()
+        else:
+            # dup_rate=0: plain round-robin over the pool, exactly the
+            # pre-cache behavior (no popularity skew in baselines)
+            j = fresh_i % pool_len
+            fresh_i += 1
+        schedule.append(j)
+    return schedule
+
+
+def _build_tiny_model(args, jax, jnp, policy):
+    """The loadtest's synthetic serving model + params (shared by the
+    single-scheduler and fleet paths)."""
+    from alphafold2_tpu import Alphafold2
 
     model = Alphafold2(dim=args.dim, depth=args.depth, heads=2,
                        dim_head=16, predict_coords=True,
@@ -128,6 +178,33 @@ def main(argv=None) -> int:
         init_kwargs["msa"] = jnp.zeros((1, args.msa_depth, n0), jnp.int32)
         init_kwargs["msa_mask"] = jnp.ones((1, args.msa_depth, n0), bool)
     params = model.init(jax.random.PRNGKey(0), seq, **init_kwargs)
+    return model, params
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    import __graft_entry__
+    if args.platform == "cpu":
+        __graft_entry__.force_cpu_fallback()
+    if args.replicas > 1:
+        return _run_fleet(args)
+
+    import jax
+    import jax.numpy as jnp
+
+    from alphafold2_tpu import serve
+    from alphafold2_tpu.data.synthetic import synthetic_requests
+    from alphafold2_tpu.utils.profiling import StepTimer
+
+    lengths = tuple(int(x) for x in args.lengths.split(",") if x)
+    if args.buckets:
+        policy = serve.BucketPolicy(
+            int(x) for x in args.buckets.split(",") if x)
+    else:
+        policy = serve.BucketPolicy.powers_of_two(
+            min(lengths), max(max(lengths), min(lengths)))
+
+    model, params = _build_tiny_model(args, jax, jnp, policy)
 
     executor = serve.FoldExecutor(model, params,
                                   max_entries=policy.num_buckets)
@@ -168,39 +245,7 @@ def main(argv=None) -> int:
         jax.random.PRNGKey(1), num=pool_n,
         lengths=lengths, msa_depth=args.msa_depth, deadline_s=deadline_s)
 
-    # submission schedule over prototype indices: with --dup-rate, a
-    # submission repeats an ALREADY-USED prototype with probability
-    # dup_rate, picking it Zipf-ishly (first-seen rank r with weight
-    # 1/(r+1)) — duplicates are exact (same seq AND msa), so they are
-    # cache/coalesce candidates. dup_rate=0 degenerates to the old
-    # round-robin over unique prototypes.
-    sched_rng = np.random.default_rng(2)
-    schedule_len = args.requests if args.duration_s <= 0 else 4096
-    schedule, used = [], []
-    fresh_i = 0
-
-    def zipf_pick():
-        w = 1.0 / (np.arange(len(used)) + 1.0)
-        return used[int(sched_rng.choice(len(used), p=w / w.sum()))]
-
-    for _ in range(max(schedule_len, 1)):
-        if used and sched_rng.random() < args.dup_rate:
-            j = zipf_pick()
-        elif fresh_i < len(pool):
-            j = fresh_i
-            fresh_i += 1
-            used.append(j)
-        elif args.dup_rate > 0:
-            # unique budget exhausted on a duplicate-heavy run: an
-            # explicit Zipf repeat, keeping `used` duplicate-free so the
-            # 1/(rank+1) weights stay meaningful
-            j = zipf_pick()
-        else:
-            # dup_rate=0: plain round-robin over the pool, exactly the
-            # pre-cache behavior (no popularity skew in baselines)
-            j = fresh_i % len(pool)
-            fresh_i += 1
-        schedule.append(j)
+    schedule = _zipf_schedule(args, len(pool))
 
     failures = []
     lock = threading.Lock()
@@ -316,6 +361,235 @@ def main(argv=None) -> int:
                  f"{cache_snap['coalesced']} coalesced"
                  if cache_on else "")
         print(f"SMOKE OK: {snap['served']} folds, 0 shed/errors{extra}",
+              file=sys.stderr)
+    return 0
+
+
+def _run_fleet(args) -> int:
+    """--replicas > 1: drive an in-process fleet (or its independent-
+    replicas baseline with --fleet off) and report fleet-wide numbers.
+    One JSON line, `"metric": "serve_loadtest_fleet"`."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from alphafold2_tpu import fleet, obs, serve
+    from alphafold2_tpu.data.synthetic import synthetic_requests
+    from alphafold2_tpu.utils.profiling import StepTimer
+
+    lengths = tuple(int(x) for x in args.lengths.split(",") if x)
+    if args.buckets:
+        policy = serve.BucketPolicy(
+            int(x) for x in args.buckets.split(",") if x)
+    else:
+        policy = serve.BucketPolicy.powers_of_two(
+            min(lengths), max(max(lengths), min(lengths)))
+    model, params = _build_tiny_model(args, jax, jnp, policy)
+
+    fleet_on = args.fleet != "off"
+    model_tag = "serve_loadtest@v1"
+    deadline_s = args.deadline_s or None
+    config = serve.SchedulerConfig(
+        max_batch_size=args.max_batch, max_wait_ms=args.max_wait_ms,
+        num_recycles=args.num_recycles, msa_depth=args.msa_depth)
+    tracer = None
+    if args.trace_path:
+        tracer = obs.Tracer(jsonl_path=args.trace_path,
+                            slow_k=args.trace_slow_k)
+    cache_kwargs = {}
+    if args.cache_dir:
+        cache_kwargs["disk_dir"] = args.cache_dir
+    fl = fleet.InProcessFleet(
+        lambda: serve.FoldExecutor(model, params,
+                                   max_entries=policy.num_buckets),
+        policy, config, n_replicas=args.replicas, model_tag=model_tag,
+        cache_kwargs=cache_kwargs, fleet=fleet_on, tracer=tracer,
+        metrics_factory=lambda i: serve.ServeMetrics(
+            f"{args.metrics_path}.r{i}"))
+
+    warmup_timer = StepTimer()
+    with warmup_timer.measure():
+        compiles = fl.warmup()
+    fl.start()
+
+    pool_n = max(args.requests, 64)
+    if args.duration_s > 0 and (args.cache == "on" or args.dup_rate > 0):
+        pool_n = max(pool_n, 1024)
+    pool = synthetic_requests(
+        jax.random.PRNGKey(1), num=pool_n, lengths=lengths,
+        msa_depth=args.msa_depth, deadline_s=deadline_s)
+    schedule = _zipf_schedule(args, len(pool))
+
+    # mid-run weight rollout: request index >= bump_at keys under the
+    # new tag (count mode only; the shared counter makes exactly one
+    # submitter perform the bump)
+    bump_at = 0
+    if args.rollout_at > 0 and args.duration_s <= 0:
+        bump_at = max(1, int(args.requests * args.rollout_at))
+    rolled_tag = model_tag + "+rolled"
+
+    failures = []
+    lock = threading.Lock()
+    counter = [0]
+
+    def run_submitter(stop_at, budget):
+        while True:
+            with lock:
+                i = counter[0]
+                if (stop_at and time.monotonic() >= stop_at) or \
+                        (budget and i >= budget):
+                    return
+                counter[0] = i + 1
+            if bump_at and i == bump_at:
+                fl.bump_model_tag(rolled_tag)
+            req_proto = pool[schedule[i % len(schedule)]]
+            req = serve.FoldRequest(seq=req_proto.seq, msa=req_proto.msa,
+                                    deadline_s=deadline_s)
+            try:
+                # round-robin by index: the dumb-load-balancer split the
+                # router is supposed to beat
+                resp = fl.submit(req, replica=i % args.replicas) \
+                    .result(timeout=600)
+            except Exception as exc:
+                with lock:
+                    failures.append(repr(exc))
+                return
+            if not resp.ok:
+                with lock:
+                    failures.append(f"{resp.status}: {resp.error}")
+            elif resp.coords.shape != (req.length, 3) or \
+                    not np.isfinite(resp.coords).all():
+                with lock:
+                    failures.append(
+                        f"bad coords {resp.coords.shape} for "
+                        f"n={req.length}")
+
+    t0 = time.monotonic()
+    stop_at = t0 + args.duration_s if args.duration_s > 0 else 0.0
+    budget = 0 if args.duration_s > 0 else args.requests
+    threads = [threading.Thread(target=run_submitter,
+                                args=(stop_at, budget), daemon=True)
+               for _ in range(max(args.concurrency, 1))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    serving_wall = time.monotonic() - t0
+
+    # the rollout tripwire must EXERCISE the rejection path, not just
+    # count a by-construction-zero: probe the live peer servers with a
+    # straggler client still pinned to the PRE-bump tag, asking for a
+    # key that was folded (and cached on its owner) before the bump —
+    # the fleet must refuse (409), never return a value
+    stale_probe = None
+    if bump_at and fleet_on:
+        from alphafold2_tpu.cache import fold_key
+        from alphafold2_tpu.obs.registry import MetricsRegistry
+
+        proto = pool[schedule[0]]          # Zipf rank-0: folded pre-bump
+        old_key = fold_key(
+            np.asarray(proto.seq),
+            None if proto.msa is None else np.asarray(proto.msa),
+            msa_depth=args.msa_depth, num_recycles=args.num_recycles,
+            model_tag=model_tag)
+        probe_reg = MetricsRegistry()
+        straggler = fleet.PeerCacheClient(
+            fl.registry, "old-tag-probe",
+            rollout=fleet.RolloutState(model_tag, registry=probe_reg),
+            metrics=probe_reg)
+        returned = straggler.get(old_key)
+        fetch = probe_reg.snapshot().get("fleet_peer_fetch_total",
+                                         {"samples": []})
+        refusals = sum(
+            s["value"] for s in fetch["samples"]
+            if s["labels"].get("outcome") == "stale_tag")
+        stale_probe = {"returned_value": returned is not None,
+                       "refusals_409": int(refusals)}
+
+    fl.stop()
+
+    st = fl.stats()
+    agg = st["aggregate"]
+    total = counter[0]
+    hit_ratio = ((agg["cache_hits"] + agg["coalesced"]) / total
+                 if total else 0.0)
+    stale_tag_hits = sum(
+        r.cache.peer.stale_tag_hits
+        for r in fl.replicas
+        if r.cache is not None and getattr(r.cache, "peer", None)
+        is not None and hasattr(r.cache.peer, "stale_tag_hits"))
+    forwards = 0
+    fwd_metric = obs.get_registry().snapshot().get("fleet_forwards_total")
+    if fwd_metric:
+        forwards = int(sum(s["value"] for s in fwd_metric["samples"]))
+    bad = sum(st["replicas"][r]["shed"] + st["replicas"][r]["errors"]
+              + st["replicas"][r]["rejected"] for r in st["replicas"])
+
+    report = {
+        "metric": "serve_loadtest_fleet",
+        "platform": args.platform,
+        "replicas": args.replicas,
+        "fleet_enabled": fleet_on,
+        "requests": total,
+        "unique_requests": len({schedule[i % len(schedule)]
+                                for i in range(total)}),
+        "dup_rate": args.dup_rate,
+        "served": agg["served"],
+        "batches": agg["batches"],
+        "hit_ratio": round(hit_ratio, 4),
+        "cache_hits": agg["cache_hits"],
+        "coalesced": agg["coalesced"],
+        "peer_hits": agg["peer_hits"],
+        "forwards": forwards,
+        "leader_promotions": agg["leader_promotions"],
+        "bad_outcomes": bad,
+        "serving_wall_s": round(serving_wall, 3),
+        "warmup_s": round(warmup_timer.mean * warmup_timer.count, 3),
+        "compiles": compiles,
+        "rollout": (None if not bump_at else {
+            "at_request": bump_at,
+            "old_tag": model_tag, "new_tag": rolled_tag,
+            "model_epoch": st["fleet"]["model_epoch"],
+            "stale_tag_hits": stale_tag_hits,
+            "stale_probe": stale_probe}),
+        "per_replica": {
+            rid: {k: snap[k] for k in ("served", "batches", "shed",
+                                       "errors", "rejected")}
+            for rid, snap in st["replicas"].items()},
+        "failures": failures[:8],
+    }
+    if tracer is not None:
+        tracer.close()
+        report["trace_path"] = args.trace_path
+        report["traces_completed"] = tracer.completed
+    if args.prom_path:
+        obs.write_prometheus(args.prom_path)
+        report["prom_path"] = args.prom_path
+    print(json.dumps(report))
+
+    if args.smoke:
+        if bad or failures or agg["served"] == 0:
+            print(f"SMOKE FAIL (fleet): {bad} bad outcomes, "
+                  f"{len(failures)} failures, {agg['served']} served",
+                  file=sys.stderr)
+            return 1
+        if args.dup_rate > 0 and \
+                agg["cache_hits"] + agg["coalesced"] == 0:
+            print("SMOKE FAIL (fleet): duplicated workload with 0 "
+                  "fleet-wide hits/coalesces", file=sys.stderr)
+            return 1
+        if stale_tag_hits:
+            print(f"SMOKE FAIL (fleet): {stale_tag_hits} stale-tag "
+                  "cache hits after the epoch bump", file=sys.stderr)
+            return 1
+        if stale_probe is not None and (stale_probe["returned_value"]
+                                        or not stale_probe["refusals_409"]):
+            print(f"SMOKE FAIL (fleet): old-tag probe not refused "
+                  f"({stale_probe})", file=sys.stderr)
+            return 1
+        print(f"SMOKE OK (fleet): {agg['served']} folds across "
+              f"{args.replicas} replicas, hit_ratio {hit_ratio:.3f}, "
+              f"{forwards} forwards, 0 stale-tag hits",
               file=sys.stderr)
     return 0
 

@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Serving smoke, three phases over the serve.Scheduler on CPU:
+# Serving smoke, four phases over the serve.Scheduler on CPU:
 #
 #   1. 30-second mixed-length load test. FAILS (exit 1) on any shed,
 #      timeout, error, or rejected request at this trivial load — the
@@ -14,6 +14,16 @@
 #      orphan span, any accelerator-served request without a non-zero
 #      fold span, or unparseable Prometheus exposition — the
 #      obs-subsystem tripwire.
+#   4. fleet: the same --dup-rate 0.5 workload split round-robin across
+#      TWO in-process replicas, run twice — --fleet off (independent
+#      replicas, the baseline) then --fleet on (consistent-hash routing
+#      + peer cache tier) with a mid-run model-tag epoch bump in BOTH
+#      runs (symmetric handicap). FAILS if the fleet run's fleet-wide
+#      hit ratio is not ABOVE the baseline's, its executor batch
+#      executions are not BELOW the baseline's, any stale-tag cache hit
+#      follows the epoch bump, or tools/obs_report.py --check finds
+#      orphan routing spans in the fleet run's traces — the
+#      fleet-subsystem tripwire.
 #
 # Invoked standalone from the test-tier docs (README "Tests");
 # tests/test_serve.py + tests/test_cache.py + tests/test_obs.py cover
@@ -70,6 +80,76 @@ timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     python tools/obs_report.py /tmp/serve_smoke_traces.jsonl \
     --check --prom /tmp/serve_smoke.prom
 
-exec timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     python tools/obs_report.py /tmp/serve_smoke_dup_traces.jsonl \
     --check --prom /tmp/serve_smoke_dup.prom
+
+# phase 4: two-replica fleet vs the two-independent-replica baseline on
+# the identical duplicated workload (same schedule, same round-robin
+# split, same mid-run epoch bump)
+rm -f /tmp/serve_smoke_fleet_traces.jsonl
+
+fleet_phase() {  # $1 = on|off, $2 = report path, extra args follow
+    local mode="$1" out="$2"; shift 2
+    timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+        python tools/serve_loadtest.py \
+        --smoke \
+        --requests 48 \
+        --dup-rate 0.5 \
+        --cache on \
+        --replicas 2 \
+        --fleet "$mode" \
+        --rollout-at 0.75 \
+        --lengths 24,48 \
+        --buckets 32,64 \
+        --msa-depth 3 \
+        --max-batch 2 \
+        --concurrency 2 \
+        --deadline-s 120 \
+        --num-recycles 0 \
+        "$@" > "$out"
+    cat "$out"
+}
+
+fleet_phase off /tmp/serve_smoke_fleet_base.json \
+    --metrics-path /tmp/serve_smoke_fleet_base.jsonl
+fleet_phase on /tmp/serve_smoke_fleet.json \
+    --metrics-path /tmp/serve_smoke_fleet.jsonl \
+    --trace-path /tmp/serve_smoke_fleet_traces.jsonl \
+    --prom-path /tmp/serve_smoke_fleet.prom
+
+timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/obs_report.py /tmp/serve_smoke_fleet_traces.jsonl \
+    --check --prom /tmp/serve_smoke_fleet.prom
+
+# the fleet must measurably beat independent replicas on the same
+# duplicated traffic, and the epoch bump must have produced zero
+# stale-tag hits
+exec env -u PYTHONPATH python - <<'EOF'
+import json, sys
+base = json.load(open("/tmp/serve_smoke_fleet_base.json"))
+fleet = json.load(open("/tmp/serve_smoke_fleet.json"))
+problems = []
+if fleet["hit_ratio"] <= base["hit_ratio"]:
+    problems.append(f"fleet hit_ratio {fleet['hit_ratio']} <= "
+                    f"baseline {base['hit_ratio']}")
+if fleet["batches"] >= base["batches"]:
+    problems.append(f"fleet batches {fleet['batches']} >= "
+                    f"baseline {base['batches']}")
+rollout = fleet.get("rollout") or {}
+if rollout.get("stale_tag_hits", 0):
+    problems.append(f"{rollout['stale_tag_hits']} stale-tag cache hits "
+                    "after the epoch bump")
+probe = rollout.get("stale_probe") or {}
+if probe and (probe.get("returned_value")
+              or not probe.get("refusals_409")):
+    problems.append(f"old-tag peer probe not refused: {probe}")
+if problems:
+    print("FLEET SMOKE FAIL: " + "; ".join(problems), file=sys.stderr)
+    sys.exit(1)
+print(f"FLEET SMOKE OK: hit_ratio {fleet['hit_ratio']} > "
+      f"{base['hit_ratio']}, batches {fleet['batches']} < "
+      f"{base['batches']}, {fleet['forwards']} forwards, "
+      f"{fleet['peer_hits']} peer hits, 0 stale-tag hits",
+      file=sys.stderr)
+EOF
