@@ -158,7 +158,8 @@ class FoldCache:
                  disk_dir: Optional[str] = None,
                  clock: Callable[[], float] = time.time,
                  registry: Optional[MetricsRegistry] = None,
-                 peer=None, peer_write_through: bool = False):
+                 peer=None, peer_write_through: bool = False,
+                 faults=None):
         if max_bytes < 0 or max_entries < 0:
             raise ValueError("max_bytes and max_entries must be >= 0")
         self.max_bytes = int(max_bytes)
@@ -167,6 +168,10 @@ class FoldCache:
         self.disk_dir = disk_dir
         self.peer = peer
         self.peer_write_through = bool(peer_write_through)
+        # optional serve.faults.FaultPlan: chaos-corrupts disk bytes
+        # BEFORE validation, so injected corruption exercises exactly
+        # the quarantine path a real bit-rotted entry would
+        self.faults = faults
         self._clock = clock
         self._lock = threading.Lock()
         self._mem: "OrderedDict[str, _Entry]" = OrderedDict()
@@ -280,7 +285,10 @@ class FoldCache:
             return None
         try:
             with open(path, "rb") as fh:
-                value = decode_fold(key, fh.read())
+                data = fh.read()
+            if self.faults is not None:
+                data = self.faults.corrupt_cache_bytes(key, data)
+            value = decode_fold(key, data)
         except Exception:              # unreadable/garbage/wrong entry
             self._quarantine(path, key, trace)
             return None

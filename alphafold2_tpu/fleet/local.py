@@ -22,6 +22,7 @@ against.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import threading
 from typing import Callable, List, Optional
@@ -63,6 +64,11 @@ class InProcessFleet:
         no peer tier, registry still tracks members for bookkeeping).
     metrics_factory: per-replica ServeMetrics factory (index -> metrics),
         e.g. distinct JSONL paths; None = in-memory defaults.
+    retry: optional serve.resilience.RetryPolicy applied to EVERY
+        replica's scheduler (failure-domain hardening; off when None).
+    faults: optional serve.faults.FaultPlan threaded into every
+        replica's FoldCache and PeerCacheClient (chaos harness; the
+        executor side is the caller's to wire via make_executor).
     """
 
     def __init__(self, make_executor: Callable[[], object],
@@ -75,7 +81,9 @@ class InProcessFleet:
                  tracer=None,
                  metrics_factory: Optional[
                      Callable[[int], ServeMetrics]] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 retry=None,
+                 faults=None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self.fleet_enabled = bool(fleet)
@@ -94,7 +102,7 @@ class InProcessFleet:
                 # separate hosts in production); shared-volume
                 # deployments mount an ObjectStorePeer instead
                 kw["disk_dir"] = os.path.join(kw["disk_dir"], rid)
-            cache = FoldCache(registry=registry, **kw)
+            cache = FoldCache(registry=registry, faults=faults, **kw)
             peer_server = None
             if self.fleet_enabled:
                 peer_server = PeerCacheServer(
@@ -109,12 +117,20 @@ class InProcessFleet:
                                               metrics=registry)
                 cache.peer = PeerCacheClient(
                     self.registry, rid, router=router,
-                    rollout=self.registry.rollout, metrics=registry)
+                    rollout=self.registry.rollout, metrics=registry,
+                    faults=faults)
+            # each replica gets its own policy copy with a per-replica
+            # seed: identical jitter streams would make the fleet back
+            # off in lockstep after a correlated transient episode,
+            # defeating the thundering-herd protection
+            rep_retry = (None if retry is None else
+                         dataclasses.replace(retry,
+                                             seed=retry.seed + i))
             scheduler = Scheduler(
                 make_executor(), buckets, config,
                 metrics=(metrics_factory(i) if metrics_factory else None),
                 cache=cache, model_tag=model_tag, tracer=tracer,
-                registry=registry, router=router)
+                registry=registry, router=router, retry=rep_retry)
             # the forwarding transport IS the peer scheduler's submit;
             # registered after construction so the registry row is
             # complete before any router can pick this owner

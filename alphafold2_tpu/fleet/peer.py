@@ -24,9 +24,19 @@ Failure model: every client-side problem — connect refused, timeout,
 HTTP error, corrupt bytes — is a MISS plus a counter, never an
 exception into the serving path. `fail_threshold` consecutive transport
 errors against one peer mark it down in the registry (bumping the
-membership epoch, so routers stop selecting it) until something marks
-it back up; corrupt bytes additionally count as `corrupt` but do NOT
-mark the peer down (its other entries are likely fine).
+membership epoch, so routers stop selecting it); corrupt bytes
+additionally count as `corrupt` but do NOT mark the peer down (its
+other entries are likely fine).
+
+Markdown is NOT forever: the client remembers which peers IT marked
+down and, once `recovery_cooldown_s` has passed, half-open-probes the
+peer's `/healthz` (at most one probe per peer per cooldown window,
+triggered by the next get() but run on a daemon thread so a dead
+host's connect timeout never delays a live request) — a 200 marks the
+peer back up in the registry (`fleet_peer_recoveries_total`), so a
+restarted replica rejoins the peer tier without operator action; a
+failed probe resets the cooldown clock. A peer someone ELSE marked
+down (an operator, a different client) is never resurrected from here.
 """
 
 from __future__ import annotations
@@ -186,7 +196,9 @@ class PeerCacheClient:
                  router: Optional[ConsistentHashRouter] = None,
                  rollout: Optional[RolloutState] = None,
                  timeout_s: float = 2.0, fail_threshold: int = 3,
-                 metrics: Optional[MetricsRegistry] = None):
+                 recovery_cooldown_s: float = 5.0,
+                 metrics: Optional[MetricsRegistry] = None,
+                 faults=None):
         self.registry = registry
         self.self_id = self_id
         self.router = router or ConsistentHashRouter(
@@ -194,8 +206,15 @@ class PeerCacheClient:
         self.rollout = rollout if rollout is not None else registry.rollout
         self.timeout_s = float(timeout_s)
         self.fail_threshold = max(1, int(fail_threshold))
+        self.recovery_cooldown_s = float(recovery_cooldown_s)
+        # optional serve.faults.FaultPlan: injected transport failures
+        # (chaos) land in the same markdown/recovery machinery as real
+        # ones
+        self.faults = faults
         self._lock = threading.Lock()
         self._consecutive_failures: dict = {}
+        self._down: dict = {}     # peer_id -> monotonic mark-down time
+        self.recoveries = 0
         reg = metrics or get_registry()
         self._m_fetch = reg.counter(
             "fleet_peer_fetch_total",
@@ -204,6 +223,9 @@ class PeerCacheClient:
         self._m_latency = reg.histogram(
             "fleet_peer_fetch_seconds",
             "wall time of one peer-tier fetch attempt")
+        self._m_recoveries = reg.counter(
+            "fleet_peer_recoveries_total",
+            "marked-down peers recovered by a half-open health probe")
         self.stale_tag_hits = 0   # 200s discarded on tag mismatch (== 0
         #                           unless a server is misbehaving)
 
@@ -215,6 +237,7 @@ class PeerCacheClient:
                 # it gets its full strike tolerance again, not a
                 # hair-trigger leftover count
                 self._consecutive_failures.pop(peer_id, None)
+                self._down[peer_id] = time.monotonic()
             else:
                 self._consecutive_failures[peer_id] = n
         if n >= self.fail_threshold:
@@ -226,7 +249,56 @@ class PeerCacheClient:
         with self._lock:
             self._consecutive_failures.pop(peer_id, None)
 
+    def _maybe_probe_down_peers(self):
+        """Half-open recovery: for each peer THIS client marked down
+        whose cooldown elapsed, probe its /healthz once and mark it
+        back up on a 200. Triggered by get() but probed on a short-
+        lived daemon thread — a dead host answers a health probe with
+        a full connect timeout, and that wait must tax the probe, not
+        the live fold request that happened to trip it. The cooldown
+        bookkeeping (one probe per peer per window, stamped before the
+        thread starts) bounds the threads the same way it bounded the
+        inline probes."""
+        if not self._down:
+            return
+        now = time.monotonic()
+        with self._lock:
+            due = [pid for pid, t in self._down.items()
+                   if now - t >= self.recovery_cooldown_s]
+            for pid in due:
+                self._down[pid] = now       # one probe per window
+        for pid in due:
+            threading.Thread(target=self._probe_peer, args=(pid,),
+                             name=f"peer-probe-{pid}",
+                             daemon=True).start()
+
+    def _probe_peer(self, peer_id: str):
+        info = self.registry.get(peer_id)
+        if info is None or info.peer_addr is None \
+                or self.registry.is_healthy(peer_id):
+            # deregistered, unprobeable, or already recovered elsewhere:
+            # stop tracking it either way
+            with self._lock:
+                self._down.pop(peer_id, None)
+            return
+        host, port = info.peer_addr
+        try:
+            if self.faults is not None:
+                self.faults.on_peer_fetch(peer_id)
+            with urlrequest.urlopen(f"http://{host}:{port}/healthz",
+                                    timeout=self.timeout_s) as resp:
+                ok = resp.status == 200
+        except Exception:
+            ok = False                  # still down; cooldown restarts
+        if ok:
+            with self._lock:
+                self._down.pop(peer_id, None)
+                self.recoveries += 1
+            self.registry.mark(peer_id, up=True)
+            self._m_recoveries.inc()
+
     def get(self, key: str, trace=NULL_TRACE) -> Optional[CachedFold]:
+        self._maybe_probe_down_peers()
         owner = self.router.owner_for(key)
         if owner is None or owner == self.self_id:
             return None
@@ -241,6 +313,11 @@ class PeerCacheClient:
         t0 = time.monotonic()
         outcome, value = "error", None
         try:
+            if self.faults is not None:
+                # injected transport failure: caught by the generic
+                # handler below, so chaos exercises the real
+                # markdown/recovery machinery
+                self.faults.on_peer_fetch(owner)
             with urlrequest.urlopen(url, timeout=self.timeout_s) as resp:
                 served_tag = resp.headers.get(_TAG_HEADER)
                 body = resp.read()

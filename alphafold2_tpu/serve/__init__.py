@@ -11,6 +11,14 @@ The serving stack, bottom-up:
              (all mirrored into the process-wide obs.MetricsRegistry;
              pass `Scheduler(..., tracer=obs.Tracer(...))` for
              request-scoped traces — README "Observability")
+- resilience: RetryPolicy/CircuitBreaker/Quarantine — pass
+             `Scheduler(..., retry=RetryPolicy(...))` for transient-
+             batch retry, poison isolation by bisection + quarantine,
+             non-finite output validation, the executor watchdog, and
+             degraded mode (README "Failure handling & degraded mode")
+- faults:    FaultPlan — seeded chaos injection threaded through
+             FoldExecutor / FoldCache / fleet.PeerCacheClient behind
+             no-op defaults (tools/serve_loadtest.py --chaos)
 
 `FoldCache` (re-exported from alphafold2_tpu.cache) makes the server
 content-addressed: pass `Scheduler(..., cache=FoldCache(...),
@@ -37,8 +45,13 @@ from alphafold2_tpu.obs import (MetricsRegistry, Tracer,  # noqa: F401
                                 get_registry, prometheus_text)
 from alphafold2_tpu.serve.bucketing import BucketPolicy, default_policy  # noqa: F401
 from alphafold2_tpu.serve.executor import FoldExecutor  # noqa: F401
+from alphafold2_tpu.serve.faults import FaultInjected, FaultPlan  # noqa: F401
 from alphafold2_tpu.serve.metrics import ServeMetrics  # noqa: F401
 from alphafold2_tpu.serve.request import (FoldRequest, FoldResponse,  # noqa: F401
                                           FoldTicket)
+from alphafold2_tpu.serve.resilience import (CircuitBreaker,  # noqa: F401
+                                             Quarantine, RetryPolicy,
+                                             TransientExecutorError,
+                                             WatchdogTimeout)
 from alphafold2_tpu.serve.scheduler import (QueueFullError, Scheduler,  # noqa: F401
                                             SchedulerConfig)

@@ -37,18 +37,35 @@ ExecKey = Tuple[int, int, int, int]
 
 
 class FoldExecutor:
-    """LRU cache of compiled fold executables, keyed by shape signature."""
+    """LRU cache of compiled fold executables, keyed by shape signature.
 
-    def __init__(self, model, params, max_entries: int = 8):
+    faults: optional serve.faults.FaultPlan — chaos-injection hook
+        (exceptions / latency spikes before the device call, NaN
+        mutation after); None (default) costs nothing on the hot path.
+    """
+
+    def __init__(self, model, params, max_entries: int = 8, faults=None):
         assert model.predict_coords, "serving needs predict_coords=True"
         self.model = model
         self.params = params
         self.max_entries = max(1, int(max_entries))
+        self.faults = faults
         self._cache: "OrderedDict[ExecKey, callable]" = OrderedDict()
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+
+    def rebuild(self) -> "FoldExecutor":
+        """Fresh executor over the same (model, params): empty
+        executable cache, zeroed counters. The scheduler's watchdog
+        swaps a hung executor for this — compiled state owned by a
+        wedged device call is not trustworthy, and the zombie watchdog
+        thread keeps the OLD instance alive until it dies, so its late
+        result can never land in the serving path."""
+        return FoldExecutor(self.model, self.params,
+                            max_entries=self.max_entries,
+                            faults=self.faults)
 
     def _build(self, num_recycles: int):
         def run(params, seq, mask, msa, msa_mask) -> FoldResult:
@@ -111,8 +128,16 @@ class FoldExecutor:
                             num_recycles=key[3]):
                 fn = self._compile(key, args)
         with trace.span("fold", bucket_len=key[0]):
+            if self.faults is not None:
+                # injected exceptions/latency fire BEFORE the device
+                # call (a chaos fault must not waste real accelerator
+                # time); NaN-poison rows are patched in after
+                self.faults.on_executor_run(batch)
             result = fn(*args)
-            return jax.block_until_ready(result)
+            result = jax.block_until_ready(result)
+            if self.faults is not None:
+                result = self.faults.mutate_result(batch, result)
+            return result
 
     def warmup(self, keys: Iterable[ExecKey],
                timer=None) -> int:

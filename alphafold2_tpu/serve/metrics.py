@@ -45,6 +45,10 @@ class ServeMetrics:
         self.errors = 0
         self.cancelled = 0
         self.rejected = 0           # backpressure: submit refused
+        # resilience outcomes (all zero without a RetryPolicy)
+        self.degraded = 0           # fast-shed while the breaker is open
+        self.poisoned = 0           # quarantined poison terminal states
+        self.retried = 0            # re-enqueues after transient failures
         self.batches = 0
         self.queue_depth = 0
         # result-cache outcomes at submit (all zero when caching is off)
@@ -115,6 +119,23 @@ class ServeMetrics:
         with self._lock:
             self.cancelled += n
         self._m_outcomes.inc(n, outcome="cancelled")
+
+    def record_degraded(self, n: int = 1):
+        with self._lock:
+            self.degraded += n
+        self._m_outcomes.inc(n, outcome="degraded")
+
+    def record_poisoned(self, n: int = 1):
+        with self._lock:
+            self.poisoned += n
+        self._m_outcomes.inc(n, outcome="poisoned")
+
+    def record_retried(self, n: int = 1):
+        """Requests re-enqueued after a transient batch failure (NOT a
+        terminal outcome — the same request later lands in served/
+        errors/shed as usual)."""
+        with self._lock:
+            self.retried += n
 
     def record_cache_hit(self):
         with self._lock:
@@ -221,6 +242,9 @@ class ServeMetrics:
                 "errors": self.errors,
                 "cancelled": self.cancelled,
                 "rejected": self.rejected,
+                "degraded": self.degraded,
+                "poisoned": self.poisoned,
+                "retried": self.retried,
                 "batches": self.batches,
                 "queue_depth": self.queue_depth,
                 "padding_waste": waste,

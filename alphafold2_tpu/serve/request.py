@@ -70,8 +70,14 @@ class FoldResponse:
     """Result of one FoldRequest, unpadded back to the request length.
 
     status: "ok" | "shed" (deadline expired before folding) |
-            "error" (executor raised; see .error) |
-            "cancelled" (scheduler stopped without draining).
+            "error" (executor raised, retries exhausted, or the output
+            failed validation; see .error) |
+            "cancelled" (scheduler stopped without draining) |
+            "degraded" (circuit breaker open: novel fold fast-shed at
+            submit while the scheduler recovers) |
+            "poisoned" (the request's content key is quarantined as a
+            poison input — it failed deterministically in isolation or
+            produced non-finite output; duplicates fail fast forever).
     source: how the result was obtained — "fold" (ran on the
             accelerator), "cache" (content-addressed result store hit),
             "coalesced" (attached to an identical in-flight fold; for
@@ -79,6 +85,10 @@ class FoldResponse:
             "forwarded" (routed to its fleet owner replica, which
             folded/served it; the local process never touched the
             accelerator for it).
+    attempts: executor batch executions this request participated in
+            (> 1 iff a RetryPolicy re-enqueued or bisected its batch;
+            stays at the default 1 for results that never had to
+            retry — including cache/coalesced/shed resolutions).
     """
 
     request_id: str
@@ -89,6 +99,7 @@ class FoldResponse:
     latency_s: Optional[float] = None
     error: Optional[str] = None
     source: str = "fold"
+    attempts: int = 1
 
     @property
     def ok(self) -> bool:

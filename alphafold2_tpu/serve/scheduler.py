@@ -55,6 +55,24 @@ to their leader's trace. Completed traces emit as JSONL and the K
 slowest are exposed via `serve_stats()["traces"]`
 (tools/obs_report.py renders the waterfall).
 
+With a `retry` (serve.resilience.RetryPolicy — OFF by default, and
+with it off this scheduler behaves exactly as before the resilience
+layer existed), failure becomes a first-class domain instead of a
+single error path: batches failed by TRANSIENT executor trouble are
+re-enqueued with bounded exponential backoff instead of error-resolving
+their whole cohort; a batch that fails DETERMINISTICALLY is bisected —
+split in half, each half retried as its own isolation group — so one
+poison input is cornered in <= log2(batch) extra executions and
+quarantined (status "poisoned"; its key fails fast forever, covering
+coalesced followers and future duplicates); non-finite coords or
+confidence never leave as "ok" (`nonfinite_output`, counting toward
+poison detection); an optional per-batch WATCHDOG deadline bounds
+executor.run, rebuilding the executor on expiry; and an optional
+CIRCUIT BREAKER flips the scheduler into degraded mode after
+consecutive systemic failures — novel submits fast-shed with status
+"degraded" while cache/coalesce hits keep serving, then a half-open
+probe batch closes the breaker when the device recovers.
+
 Batches are always padded to `max_batch_size` (bucketing.assemble), so
 the compiled-shape set is closed: one executable per (bucket,
 num_recycles), never one per observed batch size. The scheduler/executor
@@ -65,11 +83,13 @@ with a `parallel.mesh`-sharded one and this file does not change.
 from __future__ import annotations
 
 import dataclasses
+import itertools
+import random
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -82,6 +102,9 @@ from alphafold2_tpu.serve.executor import FoldExecutor
 from alphafold2_tpu.serve.metrics import ServeMetrics
 from alphafold2_tpu.serve.request import (FoldRequest, FoldResponse,
                                           FoldTicket)
+from alphafold2_tpu.serve.resilience import (CircuitBreaker, Quarantine,
+                                             RetryPolicy, WatchdogTimeout,
+                                             run_with_watchdog)
 
 
 class QueueFullError(RuntimeError):
@@ -114,7 +137,8 @@ class SchedulerConfig:
 
 class _Entry:
     __slots__ = ("request", "ticket", "bucket_len", "enqueued_at",
-                 "deadline", "cache_key", "store_key", "trace", "route")
+                 "deadline", "cache_key", "store_key", "trace", "route",
+                 "attempts", "not_before", "group")
 
     def __init__(self, request: FoldRequest, bucket_len: int):
         self.request = request
@@ -127,6 +151,11 @@ class _Entry:
         self.store_key: Optional[str] = None
         self.trace = NULL_TRACE                # set by submit()
         self.route = None       # fleet RouteDecision, computed at most once
+        self.attempts = 0       # executor batch executions participated in
+        self.not_before = 0.0   # retry backoff gate (monotonic)
+        # bisection isolation group: entries sharing a group id batch
+        # ONLY with each other, so a failing cohort stays cornered
+        self.group: Optional[int] = None
         self.mark_enqueued()
 
     def resolve(self, response: FoldResponse):
@@ -173,6 +202,16 @@ class Scheduler:
         remote result resolves the local ticket via a done-callback and
         populates the local store on the way, so repeat traffic for the
         key turns into local cache hits.
+    retry: optional serve.resilience.RetryPolicy (OFF when None — the
+        default, which byte-for-byte preserves pre-resilience
+        behavior). Enables transient-batch retry with backoff, poison
+        isolation by bisection + keyed quarantine, non-finite output
+        validation, the executor watchdog (retry.watchdog_s) and the
+        degraded-mode circuit breaker (retry.breaker_threshold).
+    executor_factory: zero-arg callable building a replacement executor
+        after a watchdog fire; None falls back to `executor.rebuild()`
+        when the executor provides it (FoldExecutor does), else the
+        hung executor is kept (better a slow server than none).
     """
 
     def __init__(self, executor: FoldExecutor, buckets: BucketPolicy,
@@ -182,7 +221,9 @@ class Scheduler:
                  model_tag: str = "",
                  tracer: Optional[Tracer] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 router=None):
+                 router=None,
+                 retry: Optional[RetryPolicy] = None,
+                 executor_factory: Optional[Callable[[], object]] = None):
         self.executor = executor
         self.buckets = buckets
         self.config = config or SchedulerConfig()
@@ -190,10 +231,52 @@ class Scheduler:
         self.cache = cache
         self.model_tag = model_tag
         self.router = router
+        self.retry = retry
+        self.executor_factory = executor_factory
         self.tracer = tracer or NULL_TRACER
-        self._c_follower_deadline = (registry or get_registry()).counter(
+        reg = registry or get_registry()
+        self._c_follower_deadline = reg.counter(
             "serve_follower_deadline_exceeded_total",
             "parked followers shed on their own expired deadline")
+        self._quarantine: Optional[Quarantine] = None
+        self._breaker: Optional[CircuitBreaker] = None
+        # lifetime resilience counters (worker-thread writes; racy reads
+        # from serve_stats are fine for a health view)
+        self._n_retries = 0
+        self._n_bisections = 0
+        self._n_watchdog_fires = 0
+        self._n_rebuilds = 0
+        self._n_nonfinite = 0
+        if retry is not None:
+            self._quarantine = Quarantine(registry=registry)
+            # worker-owned jitter stream: a RetryPolicy shared across
+            # schedulers must not race N workers on one RNG. Callers
+            # that fan one policy out across replicas give each copy
+            # its own seed (fleet.InProcessFleet does) so replicas
+            # don't back off in lockstep after a correlated transient
+            # episode — identical streams would defeat the
+            # thundering-herd jitter
+            self._retry_rng = random.Random(retry.seed)
+            if retry.breaker_threshold:
+                self._breaker = CircuitBreaker(
+                    retry.breaker_threshold, retry.breaker_cooldown_s,
+                    registry=registry)
+            self._group_counter = itertools.count(1)
+            self._c_retries = reg.counter(
+                "serve_retries_total",
+                "requests re-enqueued after a transient batch failure")
+            self._c_bisections = reg.counter(
+                "serve_poison_bisections_total",
+                "failing batches split for poison isolation")
+            self._c_watchdog = reg.counter(
+                "serve_watchdog_fires_total",
+                "batches killed by the executor watchdog deadline")
+            self._c_rebuilds = reg.counter(
+                "serve_executor_rebuilds_total",
+                "executors rebuilt after a watchdog fire")
+            self._c_nonfinite = reg.counter(
+                "serve_nonfinite_outputs_total",
+                "fold outputs rejected by non-finite validation")
         self._inflight = InflightRegistry(registry=registry)
         self._cond = threading.Condition()
         self._incoming: deque = deque()
@@ -248,21 +331,44 @@ class Scheduler:
 
     # -- submission ------------------------------------------------------
 
+    def _raise_unless_running(self, entry: _Entry):
+        """Lifecycle gate for submit()'s early-exit paths (quarantine,
+        cache/forward, breaker): a stopped/unstarted scheduler raises
+        for every request — lifecycle wins over content and breaker
+        state, it never tells the caller to wait out a recovery that
+        will never come."""
+        with self._cond:
+            if not self._running:
+                entry.trace.finish("error", error="submit before start")
+                raise RuntimeError("Scheduler.submit() before start()")
+
     def submit(self, request: FoldRequest) -> FoldTicket:
         bucket_len = self.buckets.bucket_for(request.length)  # fail fast
         entry = _Entry(request, bucket_len)
         entry.trace = self.tracer.start_trace(request.request_id)
         entry.trace.begin("submit")
+        # quarantined poison fails fast BEFORE cache/coalesce/forward:
+        # a known-bad key must not re-fold, park followers, or burn a
+        # forwarding hop
+        if self._quarantine is not None and len(self._quarantine):
+            self._raise_unless_running(entry)
+            if self._fail_fast_quarantined(entry):
+                return entry.ticket
         if self.cache is not None or self.router is not None:
-            with self._cond:
-                if not self._running:
-                    entry.trace.finish("error", error="submit before start")
-                    raise RuntimeError("Scheduler.submit() before start()")
+            self._raise_unless_running(entry)
             if self.cache is not None \
                     and self._serve_from_cache_or_coalesce(entry):
                 return entry.ticket
             if self._maybe_forward(entry):
                 return entry.ticket
+        # degraded mode: the breaker is open, so a NOVEL fold would only
+        # queue behind a failing executor — fast-shed it. Cache hits and
+        # coalesce attaches were already served above; forwarding to a
+        # healthy owner also beats shedding, so this sits after both.
+        if self._breaker is not None and not self._breaker.allow_submit():
+            self._raise_unless_running(entry)
+            self._degraded_shed(entry)
+            return entry.ticket
         try:
             with self._cond:
                 if not self._running:
@@ -329,7 +435,9 @@ class Scheduler:
         leader). Cache trouble of any kind degrades to a miss — a
         broken cache must cost a recompute, never fail a submit."""
         try:
-            key = self._cache_key_for(entry.request)
+            # store_key holds the digest when the quarantine check
+            # already paid for it this submit
+            key = entry.store_key or self._cache_key_for(entry.request)
             # route BEFORE the cache lookup: a key this replica is
             # about to forward must not pay a guaranteed-miss peer
             # fetch to the very owner the request is going to (worst
@@ -394,6 +502,58 @@ class Scheduler:
             return True                       # follower: leader settles us
         entry.cache_key = key                 # leader: enqueue + settle
         return False
+
+    # -- resilience: submit side -----------------------------------------
+
+    def _entry_key(self, entry: _Entry) -> Optional[str]:
+        """Best-effort content key for quarantine bookkeeping. Works
+        without a cache attached (fold_key needs no store); keying
+        trouble returns None — an unkeyable request can neither be
+        quarantined nor fail fast, it just folds. The computed digest is
+        memoized on the entry (store_key) so the cache/coalesce path
+        never hashes the same seq+MSA twice."""
+        if entry.cache_key is not None:
+            return entry.cache_key
+        if entry.store_key is not None:
+            return entry.store_key
+        try:
+            entry.store_key = self._cache_key_for(entry.request)
+            return entry.store_key
+        except Exception:
+            return None
+
+    def _fail_fast_quarantined(self, entry: _Entry) -> bool:
+        """True when the entry's key is quarantined poison: resolved
+        status "poisoned" without touching queue, cache, or fleet."""
+        key = self._entry_key(entry)
+        if key is None or key not in self._quarantine:
+            return False
+        self.metrics.record_poisoned()
+        entry.trace.event("quarantine_fastfail")
+        entry.resolve(FoldResponse(
+            request_id=entry.request.request_id, status="poisoned",
+            bucket_len=entry.bucket_len,
+            latency_s=time.monotonic() - entry.enqueued_at,
+            error=f"request key quarantined as poison "
+                  f"({self._quarantine.reason(key)}); failing fast"))
+        return True
+
+    def _degraded_shed(self, entry: _Entry):
+        """Breaker-open fast path: resolve a novel submit as
+        status "degraded" without enqueueing."""
+        self.metrics.record_degraded()
+        entry.trace.event("degraded_shed")
+        resp = FoldResponse(
+            request_id=entry.request.request_id, status="degraded",
+            bucket_len=entry.bucket_len,
+            latency_s=time.monotonic() - entry.enqueued_at,
+            error="circuit breaker open: scheduler in degraded mode, "
+                  "novel folds shed at the door")
+        entry.resolve(resp)
+        # followers that attached in the window between this entry
+        # becoming leader and the breaker check inherit the same state
+        # (no-op for non-leaders)
+        self._settle_followers(entry, resp)
 
     # -- fleet routing ---------------------------------------------------
 
@@ -469,7 +629,11 @@ class Scheduler:
                     # "forwarded", not the remote's source: THIS replica
                     # did not fold it, and the trace checker's
                     # fold-span-required rule keys off source == "fold"
-                    error=resp.error, source="forwarded")
+                    error=resp.error, source="forwarded",
+                    # the owner's retry/bisection cost travels with the
+                    # result (getattr: a pre-resilience peer's response
+                    # has no attempts field)
+                    attempts=getattr(resp, "attempts", 1))
             except Exception as exc:   # e.g. MemoryError on the copies
                 local = FoldResponse(
                     request_id=entry.request.request_id, status="error",
@@ -599,6 +763,19 @@ class Scheduler:
             stats["cache"]["inflight"] = self._inflight.snapshot()
         if self.router is not None:
             stats["router"] = self.router.snapshot()
+        if self.retry is not None:
+            stats["resilience"] = {
+                "retries": self._n_retries,
+                "bisections": self._n_bisections,
+                "watchdog_fires": self._n_watchdog_fires,
+                "executor_rebuilds": self._n_rebuilds,
+                "nonfinite_outputs": self._n_nonfinite,
+                "quarantine": self._quarantine.snapshot(),
+                "breaker": (None if self._breaker is None
+                            else self._breaker.snapshot()),
+                "watchdog_s": self.retry.watchdog_s,
+                "max_attempts": self.retry.max_attempts,
+            }
         with self._cond:
             stats["running"] = self._running
         return stats
@@ -674,6 +851,7 @@ class Scheduler:
                 request_id=e.request.request_id, status="shed",
                 bucket_len=e.bucket_len,
                 latency_s=now - e.enqueued_at,
+                attempts=e.attempts or 1,   # deadline may die mid-backoff
                 error="deadline expired before folding"))
         self._shed_expired_followers(now)
 
@@ -703,29 +881,85 @@ class Scheduler:
 
     def _form_batch(self, stopping: bool):
         """Pick the bucket whose oldest entry has waited longest, if any
-        bucket is ready (full batch, max_wait exceeded, or draining)."""
+        bucket is ready (full batch, max_wait exceeded, or draining).
+        With a retry policy: backoff-gated entries are not ready yet,
+        bisection isolation groups batch only with each other, and an
+        open circuit breaker pauses execution entirely (drain on stop
+        still executes — a stopping scheduler owes every ticket a
+        terminal state and retries are disabled while stopping)."""
         cfg = self.config
         now = time.monotonic()
-        best = None
+        if not stopping and self._breaker is not None \
+                and not self._breaker.allow_execute():
+            return None
+        best = None                      # (oldest, bucket_len, take)
         for bucket_len, entries in self._pending.items():
             if not entries:
                 continue
-            oldest = min(e.enqueued_at for e in entries)
-            ready = (len(entries) >= cfg.max_batch_size
-                     or (now - oldest) * 1000.0 >= cfg.max_wait_ms
-                     or stopping)
-            if ready and (best is None or oldest < best[1]):
-                best = (bucket_len, oldest)
+            cand = self._bucket_candidate(entries, stopping, now)
+            if cand is not None and (best is None or cand[0] < best[0]):
+                best = (cand[0], bucket_len, cand[1])
         if best is None:
             return None
-        bucket_len = best[0]
-        entries = self._pending[bucket_len]
-        # higher priority folds first; FIFO within a priority level
-        entries.sort(key=lambda e: (-e.request.priority, e.enqueued_at))
-        take = entries[:cfg.max_batch_size]
-        self._pending[bucket_len] = entries[cfg.max_batch_size:]
+        _, bucket_len, take = best
+        taken = {id(e) for e in take}
+        self._pending[bucket_len] = [e for e in self._pending[bucket_len]
+                                     if id(e) not in taken]
+        if self._breaker is not None:
+            self._breaker.begin_probe()  # no-op unless half-open
         self._resolve_removed(take)
         return bucket_len, take
+
+    def _bucket_candidate(self, entries: List[_Entry], stopping: bool,
+                          now: float) -> Optional[Tuple[float,
+                                                        List[_Entry]]]:
+        """One bucket's best executable batch as (oldest_enqueued_at,
+        entries), or None when nothing is ready."""
+        if self.retry is None:
+            return self._ready_take(entries, stopping, now)
+        # retry-aware: backoff gates eligibility (ignored while
+        # stopping — drain must terminate), isolation groups jump the
+        # normal ready rules (their members already waited a full
+        # batch's worth; re-bisection only ever shrinks them)
+        eligible = entries if stopping else \
+            [e for e in entries if e.not_before <= now]
+        if not eligible:
+            return None
+        normal: List[_Entry] = []
+        group_best = None
+        groups: Dict[int, List[_Entry]] = {}
+        for e in eligible:
+            if e.group is None:
+                normal.append(e)
+            else:
+                groups.setdefault(e.group, []).append(e)
+        for members in groups.values():
+            oldest = min(e.enqueued_at for e in members)
+            if group_best is None or oldest < group_best[0]:
+                group_best = (oldest, members)
+        if group_best is not None:
+            return group_best
+        # normal is non-empty here: eligible was non-empty and every
+        # grouped entry returned through group_best above
+        return self._ready_take(normal, stopping, now)
+
+    def _ready_take(self, entries: List[_Entry], stopping: bool,
+                    now: float) -> Optional[Tuple[float, List[_Entry]]]:
+        """max_batch/max_wait readiness over one non-empty entry list:
+        (oldest_enqueued_at, take) or None when not ready yet. The one
+        copy of the ready rule, shared by the retry-off and retry-on
+        batching paths so they cannot drift."""
+        cfg = self.config
+        oldest = min(e.enqueued_at for e in entries)
+        ready = (len(entries) >= cfg.max_batch_size
+                 or (now - oldest) * 1000.0 >= cfg.max_wait_ms
+                 or stopping)
+        if not ready:
+            return None
+        # higher priority folds first; FIFO within a priority level
+        take = sorted(entries, key=lambda e: (-e.request.priority,
+                                              e.enqueued_at))
+        return oldest, take[:cfg.max_batch_size]
 
     def _execute(self, bucket_len: int, entries: List[_Entry]):
         cfg = self.config
@@ -733,11 +967,15 @@ class Scheduler:
         if self.tracer.enabled:
             for e in entries:
                 e.trace.end("queue", bucket_len=bucket_len)
+                e.trace.end("retry")   # closes a retry-wait span; no-op
+            #                            on a first execution
             # batch-level spans (assemble / compile / fold) are measured
             # once and fanned out to every member's trace
             batch_trace = MultiTrace([e.trace for e in entries])
         else:
             batch_trace = NULL_TRACE
+        for e in entries:
+            e.attempts += 1
         # the whole assemble -> run -> device-fetch window is guarded:
         # entries already left the queue, so an unresolved exception here
         # would orphan their tickets forever (resolve as error instead)
@@ -747,27 +985,45 @@ class Scheduler:
                 batch, waste = self.buckets.assemble(
                     [e.request for e in entries], bucket_len,
                     cfg.max_batch_size, msa_depth=cfg.msa_depth)
-            # trace kwarg only when tracing: alternate executors (tests,
-            # the future mesh-sharded one) needn't know about obs
-            result = (self.executor.run(batch, cfg.num_recycles)
-                      if batch_trace is NULL_TRACE else
-                      self.executor.run(batch, cfg.num_recycles,
-                                        trace=batch_trace))
+            result = self._run_executor(batch, batch_trace)
             coords = np.asarray(result.coords)
             confidence = np.asarray(result.confidence)
-        except Exception as exc:  # resolve, never kill the worker
+        except Exception as exc:  # resolve/retry, never kill the worker
+            if self._handle_batch_failure(bucket_len, entries, exc, t0):
+                return            # retried, bisected, or quarantined
             self.metrics.record_error(len(entries))
             for e in entries:
                 self._resolve_entry(e, FoldResponse(
                     request_id=e.request.request_id, status="error",
-                    bucket_len=bucket_len, error=repr(exc)))
+                    bucket_len=bucket_len, error=repr(exc),
+                    attempts=e.attempts))
             return
+        # output validation (retry-enabled only): non-finite coords/
+        # confidence never leave as "ok" — they count toward poison
+        # detection for this entry's key
+        finite_ok = None
+        if self.retry is not None:
+            finite_ok = [bool(np.isfinite(coords[i, :e.request.length])
+                              .all()
+                              and np.isfinite(
+                                  confidence[i, :e.request.length]).all())
+                         for i, e in enumerate(entries)]
+        if self._breaker is not None:
+            # a batch with non-finite rows is device-suspect the same
+            # way a transient failure is: a systemic NaN episode must
+            # OPEN the breaker, not keep resetting it batch by batch
+            (self._breaker.record_success
+             if finite_ok is None or all(finite_ok)
+             else self._breaker.record_failure)()
         now = time.monotonic()
         real_tokens = 0
         try:
             for i, e in enumerate(entries):
                 n = e.request.length
                 real_tokens += n
+                if finite_ok is not None and not finite_ok[i]:
+                    self._resolve_nonfinite(e, bucket_len)
+                    continue
                 latency = now - e.enqueued_at
                 self.metrics.record_served(bucket_len, latency)
                 self._resolve_entry(e, FoldResponse(
@@ -776,7 +1032,8 @@ class Scheduler:
                     # the caller's hands for the lifetime of the response
                     coords=coords[i, :n].copy(),
                     confidence=confidence[i, :n].copy(),
-                    bucket_len=bucket_len, latency_s=latency))
+                    bucket_len=bucket_len, latency_s=latency,
+                    attempts=e.attempts))
         except Exception as exc:
             # resolution machinery failed mid-batch (e.g. MemoryError on
             # a response copy): entries already left the queue, so
@@ -813,6 +1070,196 @@ class Scheduler:
             # observability must never take down serving)
             pass
 
+    # -- resilience: worker side -----------------------------------------
+
+    def _run_executor(self, batch: dict, batch_trace):
+        """executor.run with the optional per-batch watchdog deadline.
+        The trace kwarg is only passed when tracing, so alternate
+        executors (tests, the future mesh-sharded one) needn't know
+        about obs; `self.executor` is read inside the closure so a
+        rebuild between batches takes effect immediately."""
+        if batch_trace is NULL_TRACE:
+            call = lambda: self.executor.run(  # noqa: E731
+                batch, self.config.num_recycles)
+        else:
+            call = lambda: self.executor.run(  # noqa: E731
+                batch, self.config.num_recycles, trace=batch_trace)
+        watchdog_s = None if self.retry is None else self.retry.watchdog_s
+        if watchdog_s is None:
+            return call()
+        return run_with_watchdog(call, watchdog_s)
+
+    def _handle_batch_failure(self, bucket_len: int,
+                              entries: List[_Entry], exc: Exception,
+                              t_run: float) -> bool:
+        """Failure-domain triage for one failed batch execution. True =
+        handled (entries retried, bisected, or quarantined); False =
+        the caller error-resolves everyone, exactly the pre-resilience
+        path. Never called with entries still in the queue."""
+        retry = self.retry
+        if retry is None:
+            return False
+        now = time.monotonic()
+        fired = isinstance(exc, WatchdogTimeout)
+        if fired:
+            self._n_watchdog_fires += 1
+            self._c_watchdog.inc()
+            if self.tracer.enabled:
+                for e in entries:
+                    e.trace.add_span("watchdog", t_run, now,
+                                     timeout_s=retry.watchdog_s)
+                    e.trace.event("watchdog_fired")
+            self._rebuild_executor()
+        transient = retry.is_transient(exc)
+        if self._breaker is not None:
+            # a deterministic failure proves the device RAN the batch:
+            # only transient/watchdog failures indict the executor
+            (self._breaker.record_failure if transient
+             else self._breaker.record_success)()
+        with self._cond:
+            if not self._running:
+                return False     # stopping: every ticket resolves NOW
+        if transient:
+            survivors = [e for e in entries
+                         if e.attempts < retry.max_attempts]
+            exhausted = [e for e in entries
+                         if e.attempts >= retry.max_attempts]
+            if exhausted and retry.bisect and len(entries) > 1:
+                # a batch that keeps failing "transiently" is
+                # indistinguishable from poison — corner it, but KEEP
+                # the backoff: if the device really is struggling,
+                # bisection must not turn into a zero-delay hammer
+                delay = retry.delay_s(max(e.attempts for e in entries),
+                                      rng=self._retry_rng)
+                self._bisect(bucket_len, entries,
+                             not_before=now + delay)
+                return True
+            for e in exhausted:
+                self.metrics.record_error()
+                self._resolve_entry(e, FoldResponse(
+                    request_id=e.request.request_id, status="error",
+                    bucket_len=bucket_len, attempts=e.attempts,
+                    error=f"retry_exhausted after {e.attempts} "
+                          f"attempts: {exc!r}"))
+            if survivors:
+                delay = retry.delay_s(
+                    max(e.attempts for e in survivors),
+                    rng=self._retry_rng)
+                self._n_retries += len(survivors)
+                self._c_retries.inc(len(survivors))
+                self.metrics.record_retried(len(survivors))
+                for e in survivors:
+                    e.trace.event("retry_scheduled", delay_s=delay,
+                                  attempts=e.attempts, error=repr(exc))
+                self._requeue(survivors, bucket_len, now + delay)
+            return True
+        # deterministic failure: isolate the poison
+        if not retry.bisect:
+            return False
+        if len(entries) == 1:
+            e = entries[0]
+            key = self._entry_key(e)
+            if key is None:
+                return False     # unkeyable: plain terminal error
+            self._quarantine.add(key, reason="poison_input")
+            self.metrics.record_poisoned()
+            e.trace.event("poison_quarantined")
+            self._resolve_entry(e, FoldResponse(
+                request_id=e.request.request_id, status="poisoned",
+                bucket_len=bucket_len, attempts=e.attempts,
+                latency_s=now - e.enqueued_at,
+                error=f"poison_input: failed deterministically as a "
+                      f"batch of 1, key quarantined: {exc!r}"))
+            return True
+        self._bisect(bucket_len, entries)
+        return True
+
+    def _bisect(self, bucket_len: int, entries: List[_Entry],
+                not_before: Optional[float] = None):
+        """Split a failing batch into two isolation groups and re-run
+        each alone: the innocent half succeeds immediately, the poison
+        half keeps splitting — a single poison request is cornered and
+        quarantined in <= log2(batch) extra executions. Default no
+        backoff (a deterministic failure is not load); the transient-
+        exhausted path passes `not_before` to keep its backoff."""
+        self._n_bisections += 1
+        self._c_bisections.inc()
+        if not_before is None:
+            not_before = time.monotonic()
+        mid = len(entries) // 2
+        for half in (entries[:mid], entries[mid:]):
+            if not half:
+                continue
+            gid = next(self._group_counter)
+            for e in half:
+                e.group = gid
+                e.trace.event("bisect", group=gid, size=len(half))
+            self._requeue(half, bucket_len, not_before)
+
+    def _requeue(self, entries: List[_Entry], bucket_len: int,
+                 not_before: float):
+        """Put failed entries back in pending for another execution.
+        Deadlines and enqueued_at are NOT reset — the caller's clock
+        kept running through the failure, and an entry whose deadline
+        expires mid-backoff is shed like any other."""
+        tracing = self.tracer.enabled
+        for e in entries:
+            e.not_before = not_before
+            if tracing:
+                e.trace.begin("retry")
+        # _pending is worker-owned (we ARE the worker); only the depth
+        # accounting needs the lock
+        self._pending.setdefault(bucket_len, []).extend(entries)
+        with self._cond:
+            self._depth += len(entries)
+
+    def _rebuild_executor(self):
+        """Watchdog fired: swap the executor for a fresh one. The hung
+        call's thread still references the old instance, so its late
+        result (if the device ever answers) lands in garbage, never in
+        the serving path."""
+        try:
+            if self.executor_factory is not None:
+                self.executor = self.executor_factory()
+            elif hasattr(self.executor, "rebuild"):
+                self.executor = self.executor.rebuild()
+            else:
+                return           # nothing to rebuild with: keep serving
+        except Exception:
+            return               # a failed rebuild keeps the old one —
+        #                          better a suspect executor than none
+        self._n_rebuilds += 1
+        self._c_rebuilds.inc()
+
+    def _resolve_nonfinite(self, e: _Entry, bucket_len: int):
+        """A fold came back with non-finite coords/confidence: never
+        serve it as "ok". The entry's key takes a poison strike; at the
+        policy threshold it is quarantined (status "poisoned"),
+        otherwise it error-resolves with `nonfinite_output`."""
+        self._n_nonfinite += 1
+        self._c_nonfinite.inc()
+        e.trace.event("nonfinite_output")
+        key = self._entry_key(e)
+        quarantined = key is not None and self._quarantine.strike(
+            key, self.retry.nan_poison_threshold)
+        now = time.monotonic()
+        if quarantined:
+            self.metrics.record_poisoned()
+            self._resolve_entry(e, FoldResponse(
+                request_id=e.request.request_id, status="poisoned",
+                bucket_len=bucket_len, attempts=e.attempts,
+                latency_s=now - e.enqueued_at,
+                error="nonfinite_output: fold produced non-finite "
+                      "coords/confidence; key quarantined"))
+        else:
+            self.metrics.record_error()
+            self._resolve_entry(e, FoldResponse(
+                request_id=e.request.request_id, status="error",
+                bucket_len=bucket_len, attempts=e.attempts,
+                latency_s=now - e.enqueued_at,
+                error="nonfinite_output: fold produced non-finite "
+                      "coords/confidence"))
+
     def _drain_all_entries(self) -> List[_Entry]:
         with self._cond:
             leftovers = list(self._incoming)
@@ -830,7 +1277,7 @@ class Scheduler:
         for e in leftovers:
             self._resolve_entry(e, FoldResponse(
                 request_id=e.request.request_id, status="cancelled",
-                bucket_len=e.bucket_len,
+                bucket_len=e.bucket_len, attempts=e.attempts or 1,
                 error="scheduler stopped without draining"))
 
     def _fail_outstanding(self, error: str):
@@ -845,5 +1292,5 @@ class Scheduler:
         for e in leftovers:
             self._resolve_entry(e, FoldResponse(
                 request_id=e.request.request_id, status="error",
-                bucket_len=e.bucket_len,
+                bucket_len=e.bucket_len, attempts=e.attempts or 1,
                 error=f"scheduler worker crashed: {error}"))

@@ -1,0 +1,657 @@
+"""Failure-domain hardening tests (ISSUE 5): transient retry with
+backoff, poison isolation by batch bisection + keyed quarantine,
+non-finite output validation, the executor watchdog + rebuild, the
+degraded-mode circuit breaker, fault-plan determinism, peer markdown
+recovery, and the seeded chaos end-to-end acceptance run.
+
+All scheduler tests run against scripted stub executors (no JAX
+compile) so the failure SCHEDULING is what's under test; the real
+FoldExecutor's fault hooks are covered by the chaos phase of
+tools/serve_smoke.sh and its warmup/AOT paths by test_serve.py.
+"""
+
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from alphafold2_tpu.cache import FoldCache
+from alphafold2_tpu.obs.registry import MetricsRegistry
+from alphafold2_tpu.serve import (BucketPolicy, FaultInjected, FaultPlan,
+                                  FoldRequest, FoldTicket, RetryPolicy,
+                                  Scheduler, SchedulerConfig, ServeMetrics,
+                                  TransientExecutorError)
+from alphafold2_tpu.serve.resilience import (CircuitBreaker, Quarantine,
+                                             WatchdogTimeout,
+                                             run_with_watchdog)
+
+
+def seq_of(n=8, base=0):
+    return (np.arange(n, dtype=np.int32) + base) % 20
+
+
+class StubExecutor:
+    """Scripted executor: `behave(batch, call_index)` may raise, sleep,
+    or return "nan" to corrupt row 0; otherwise finite coords."""
+
+    def __init__(self, behave=None, faults=None):
+        self.calls = 0
+        self.behave = behave or (lambda batch, call: None)
+        self.faults = faults
+
+    def run(self, batch, num_recycles, trace=None):
+        self.calls += 1
+        if self.faults is not None:
+            self.faults.on_executor_run(batch)
+        out = self.behave(batch, self.calls)
+        b, n = batch["seq"].shape
+        coords = np.ones((b, n, 3), np.float32)
+        confidence = np.full((b, n), 0.5, np.float32)
+        if out == "nan":
+            coords[0] = np.nan
+        class R:                                   # noqa: E306
+            pass
+        R.coords, R.confidence = coords, confidence
+        return R()
+
+    def stats(self):
+        return {"calls": self.calls}
+
+
+def make_scheduler(executor, retry, max_batch=2, max_wait_ms=10.0,
+                   cache=None, **kw):
+    return Scheduler(
+        executor, BucketPolicy((16,)),
+        SchedulerConfig(max_batch_size=max_batch, max_wait_ms=max_wait_ms,
+                        msa_depth=0, poll_ms=2.0),
+        cache=cache, model_tag="resil", retry=retry,
+        registry=MetricsRegistry(), **kw)
+
+
+def row_matches(batch, seq):
+    """True when any real batch row equals `seq` (poison detection the
+    way a content-addressed failure would follow the request)."""
+    seqs, mask = np.asarray(batch["seq"]), np.asarray(batch["mask"])
+    for i in range(seqs.shape[0]):
+        n = int(mask[i].sum())
+        if n == len(seq) and np.array_equal(seqs[i, :n], seq):
+            return True
+    return False
+
+
+@pytest.mark.quick
+class TestRetryPolicyUnits:
+    def test_classification(self):
+        rp = RetryPolicy()
+        assert rp.is_transient(TransientExecutorError("x"))
+        assert rp.is_transient(WatchdogTimeout("x"))
+        assert rp.is_transient(RuntimeError("RESOURCE_EXHAUSTED: oom"))
+        assert not rp.is_transient(ValueError("bad shape"))
+        assert not rp.is_transient(FaultInjected("poison_input"))
+        rp2 = RetryPolicy(transient_types=(KeyError,))
+        assert rp2.is_transient(KeyError("k"))
+
+    def test_backoff_bounded_and_jittered(self):
+        rp = RetryPolicy(backoff_base_s=0.1, backoff_max_s=0.5,
+                         jitter=0.5, seed=3)
+        d1, d4 = rp.delay_s(1), rp.delay_s(4)
+        assert 0.1 <= d1 <= 0.15
+        assert 0.5 <= d4 <= 0.75              # capped then jittered
+        assert RetryPolicy(jitter=0.0).delay_s(1) == 0.05
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError):
+            RetryPolicy(nan_poison_threshold=0)
+
+    def test_quarantine_strike_threshold(self):
+        q = Quarantine(registry=MetricsRegistry())
+        assert not q.strike("k", threshold=2)
+        assert "k" not in q
+        assert q.strike("k", threshold=2)
+        assert "k" in q and len(q) == 1
+        assert q.strike("k", threshold=2)      # already in: stays True
+        assert not q.add("k")                  # no double count
+        assert q.add("j", reason="poison_input")
+        assert q.reason("j") == "poison_input"
+
+    def test_watchdog_helper(self):
+        assert run_with_watchdog(lambda: 42, 1.0) == 42
+        with pytest.raises(ValueError):
+            run_with_watchdog(
+                lambda: (_ for _ in ()).throw(ValueError("relay")), 1.0)
+        with pytest.raises(WatchdogTimeout):
+            run_with_watchdog(lambda: time.sleep(5.0), 0.05)
+
+
+@pytest.mark.quick
+class TestCircuitBreakerUnit:
+    def test_open_half_open_closed_cycle(self):
+        clock = [0.0]
+        cb = CircuitBreaker(failure_threshold=2, cooldown_s=1.0,
+                            clock=lambda: clock[0],
+                            registry=MetricsRegistry())
+        assert cb.state == "closed" and cb.allow_submit()
+        cb.record_failure()
+        assert cb.state == "closed"
+        cb.record_failure()
+        assert cb.state == "open"
+        assert not cb.allow_submit() and not cb.allow_execute()
+        clock[0] = 1.0                         # cooldown elapsed
+        assert cb.state == "half_open"
+        assert cb.allow_submit() and cb.allow_execute()
+        cb.begin_probe()
+        assert not cb.allow_execute()          # one probe at a time
+        cb.record_failure()                    # probe failed: re-open
+        assert cb.state == "open" and cb.opens == 2
+        clock[0] = 2.0
+        assert cb.allow_execute()              # half-open again
+        cb.begin_probe()
+        cb.record_success()
+        assert cb.state == "closed" and cb.closes == 1
+        assert cb.allow_execute() and cb.allow_submit()
+
+    def test_success_resets_failure_streak(self):
+        cb = CircuitBreaker(failure_threshold=2,
+                            registry=MetricsRegistry())
+        cb.record_failure()
+        cb.record_success()
+        cb.record_failure()
+        assert cb.state == "closed"            # streak broken, not 2/2
+
+
+class TestTransientRetry:
+    def test_transient_failure_retries_to_success(self):
+        # first execution raises transiently, later ones succeed
+        ex = StubExecutor(
+            lambda batch, call:
+            (_ for _ in ()).throw(TransientExecutorError("flaky"))
+            if call == 1 else None)
+        metrics = ServeMetrics(registry=MetricsRegistry())
+        sched = make_scheduler(
+            ex, RetryPolicy(max_attempts=3, backoff_base_s=0.01, seed=1),
+            metrics=metrics)
+        with sched:
+            t1 = sched.submit(FoldRequest(seq=seq_of()))
+            t2 = sched.submit(FoldRequest(seq=seq_of(base=1)))
+            r1, r2 = t1.result(timeout=30), t2.result(timeout=30)
+        assert r1.ok and r2.ok
+        assert r1.attempts == 2 and r2.attempts == 2
+        assert ex.calls == 2                   # one retry, whole batch
+        res = sched.serve_stats()["resilience"]
+        assert res["retries"] == 2 and res["bisections"] == 0
+        assert metrics.snapshot()["retried"] == 2
+        assert metrics.snapshot()["errors"] == 0
+
+    def test_retry_exhausted_resolves_error_not_poison(self):
+        ex = StubExecutor(lambda batch, call: (_ for _ in ()).throw(
+            TransientExecutorError("always down")))
+        sched = make_scheduler(
+            ex, RetryPolicy(max_attempts=2, backoff_base_s=0.01),
+            max_batch=1)
+        with sched:
+            r = sched.submit(FoldRequest(seq=seq_of())).result(timeout=30)
+        assert r.status == "error" and "retry_exhausted" in r.error
+        assert r.attempts == 2
+        # NOT quarantined: a later submit of the same content re-folds
+        assert sched.serve_stats()["resilience"]["quarantine"][
+            "quarantined"] == 0
+
+    def test_without_retry_policy_behavior_unchanged(self):
+        ex = StubExecutor(lambda batch, call: (_ for _ in ()).throw(
+            TransientExecutorError("flaky")))
+        sched = make_scheduler(ex, retry=None, max_batch=1)
+        with sched:
+            r = sched.submit(FoldRequest(seq=seq_of())).result(timeout=30)
+        assert r.status == "error" and ex.calls == 1
+        assert "resilience" not in sched.serve_stats()
+
+
+class TestPoisonBisection:
+    @pytest.mark.parametrize("batch_size", (4, 8))
+    def test_bisection_corners_single_poison(self, batch_size):
+        poison = seq_of(base=7)
+        ex = StubExecutor(
+            lambda batch, call:
+            (_ for _ in ()).throw(RuntimeError("deterministic boom"))
+            if row_matches(batch, poison) else None)
+        sched = make_scheduler(
+            ex, RetryPolicy(max_attempts=3, backoff_base_s=0.01),
+            max_batch=batch_size, max_wait_ms=100.0)
+        reqs = [FoldRequest(seq=poison)] + [
+            FoldRequest(seq=np.full(8, i + 1, np.int32))
+            for i in range(batch_size - 1)]
+        with sched:
+            tickets = [sched.submit(r) for r in reqs]
+            resps = [t.result(timeout=30) for t in tickets]
+        assert resps[0].status == "poisoned"
+        assert "poison_input" in resps[0].error
+        for r in resps[1:]:                    # zero collateral damage
+            assert r.ok, (r.status, r.error)
+        # the poison executed <= log2(batch)+1 times total
+        bound = int(math.log2(batch_size)) + 1
+        assert resps[0].attempts == bound
+        res = sched.serve_stats()["resilience"]
+        assert res["quarantine"]["quarantined"] == 1
+        assert res["bisections"] == bound - 1
+
+    def test_quarantined_duplicate_fails_fast(self):
+        poison = seq_of(base=7)
+        ex = StubExecutor(
+            lambda batch, call:
+            (_ for _ in ()).throw(RuntimeError("boom"))
+            if row_matches(batch, poison) else None)
+        sched = make_scheduler(
+            ex, RetryPolicy(backoff_base_s=0.01), max_batch=1)
+        with sched:
+            r1 = sched.submit(FoldRequest(seq=poison)).result(timeout=30)
+            calls = ex.calls
+            r2 = sched.submit(FoldRequest(seq=poison)).result(timeout=30)
+            r3 = sched.submit(
+                FoldRequest(seq=seq_of(base=3))).result(timeout=30)
+        assert r1.status == "poisoned"
+        assert r2.status == "poisoned" and "fail" in r2.error
+        assert ex.calls == calls + 1           # only the innocent folded
+        assert r3.ok
+
+    def test_poisoned_leader_fans_out_to_followers(self):
+        """Coalesced followers of a poison leader fail fast with the
+        leader's terminal state instead of hanging or re-folding."""
+        poison = seq_of(base=7)
+        gate = threading.Event()
+
+        def behave(batch, call):
+            gate.wait(10)                      # park the batch until the
+            if row_matches(batch, poison):     # follower has attached
+                raise RuntimeError("boom")
+            return None
+
+        ex = StubExecutor(behave)
+        cache = FoldCache(registry=MetricsRegistry())
+        sched = make_scheduler(
+            ex, RetryPolicy(backoff_base_s=0.01), max_batch=1,
+            cache=cache)
+        with sched:
+            t_lead = sched.submit(FoldRequest(seq=poison))
+            deadline = time.monotonic() + 5
+            while sched._inflight.inflight() == 0 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            t_follow = sched.submit(FoldRequest(seq=poison))
+            gate.set()
+            r_lead = t_lead.result(timeout=30)
+            r_follow = t_follow.result(timeout=30)
+        assert r_lead.status == "poisoned"
+        assert r_follow.status == "poisoned"
+        assert r_follow.source == "coalesced"
+
+
+class TestNonFiniteValidation:
+    def test_nan_output_quarantines_and_duplicate_fails_fast(self):
+        ex = StubExecutor(lambda batch, call: "nan")
+        sched = make_scheduler(
+            ex, RetryPolicy(backoff_base_s=0.01), max_batch=1)
+        with sched:
+            seq = seq_of()
+            r1 = sched.submit(FoldRequest(seq=seq)).result(timeout=30)
+            calls = ex.calls
+            r2 = sched.submit(FoldRequest(seq=seq)).result(timeout=30)
+        assert r1.status == "poisoned" and "nonfinite_output" in r1.error
+        assert r1.coords is None               # NaN never leaves as data
+        assert r2.status == "poisoned" and ex.calls == calls
+        res = sched.serve_stats()["resilience"]
+        assert res["nonfinite_outputs"] == 1
+        assert res["quarantine"]["quarantined"] == 1
+
+    def test_nan_threshold_two_errors_first(self):
+        ex = StubExecutor(lambda batch, call: "nan")
+        sched = make_scheduler(
+            ex, RetryPolicy(backoff_base_s=0.01, nan_poison_threshold=2),
+            max_batch=1)
+        with sched:
+            seq = seq_of()
+            r1 = sched.submit(FoldRequest(seq=seq)).result(timeout=30)
+            r2 = sched.submit(FoldRequest(seq=seq)).result(timeout=30)
+        assert r1.status == "error" and "nonfinite_output" in r1.error
+        assert r2.status == "poisoned"         # second strike quarantines
+
+    def test_innocent_rows_of_nan_batch_still_serve(self):
+        """Validation is per-entry: only the NaN row errors, its batch
+        mates resolve ok."""
+        ex = StubExecutor(lambda batch, call: "nan")   # row 0 only
+        sched = make_scheduler(
+            ex, RetryPolicy(backoff_base_s=0.01), max_batch=2,
+            max_wait_ms=100.0)
+        with sched:
+            t1 = sched.submit(FoldRequest(seq=seq_of(), priority=1))
+            t2 = sched.submit(FoldRequest(seq=seq_of(base=1)))
+            r1, r2 = t1.result(timeout=30), t2.result(timeout=30)
+        assert r1.status == "poisoned"         # priority 1 = row 0
+        assert r2.ok and np.isfinite(r2.coords).all()
+
+
+class TestWatchdog:
+    def test_watchdog_fires_rebuilds_and_recovers(self):
+        hang = StubExecutor(lambda batch, call: time.sleep(3.0))
+        built = []
+
+        def factory():
+            ex = StubExecutor()
+            built.append(ex)
+            return ex
+
+        sched = make_scheduler(
+            hang, RetryPolicy(max_attempts=2, backoff_base_s=0.01,
+                              watchdog_s=0.15),
+            max_batch=1, executor_factory=factory)
+        with sched:
+            r = sched.submit(FoldRequest(seq=seq_of())).result(timeout=30)
+        assert r.ok and r.attempts == 2
+        assert len(built) == 1 and built[0].calls == 1
+        res = sched.serve_stats()["resilience"]
+        assert res["watchdog_fires"] == 1
+        assert res["executor_rebuilds"] == 1
+
+    def test_watchdog_timeout_is_transient(self):
+        assert RetryPolicy().is_transient(WatchdogTimeout("t"))
+
+
+class TestCircuitBreakerScheduler:
+    def test_open_degrades_then_half_open_probe_closes(self):
+        broken = {"on": True}
+        ex = StubExecutor(
+            lambda batch, call:
+            (_ for _ in ()).throw(TransientExecutorError("sys down"))
+            if broken["on"] else None)
+        sched = make_scheduler(
+            ex, RetryPolicy(max_attempts=1, backoff_base_s=0.01,
+                            breaker_threshold=2,
+                            breaker_cooldown_s=0.3),
+            max_batch=1)
+        with sched:
+            for i in range(2):
+                r = sched.submit(
+                    FoldRequest(seq=seq_of(base=i))).result(timeout=30)
+                assert r.status == "error"
+            assert sched.serve_stats()["resilience"]["breaker"][
+                "state"] == "open"
+            r = sched.submit(
+                FoldRequest(seq=seq_of(base=9))).result(timeout=30)
+            assert r.status == "degraded" and "breaker" in r.error
+            broken["on"] = False
+            time.sleep(0.35)                   # cooldown -> half-open
+            r = sched.submit(
+                FoldRequest(seq=seq_of(base=10))).result(timeout=30)
+            assert r.ok                        # the probe batch
+            br = sched.serve_stats()["resilience"]["breaker"]
+            assert br["state"] == "closed"
+            assert br["opens"] == 1 and br["closes"] == 1
+        assert sched.metrics.snapshot()["degraded"] == 1
+
+    def test_degraded_mode_still_serves_cache_hits(self):
+        ex = StubExecutor()
+        cache = FoldCache(registry=MetricsRegistry())
+        sched = make_scheduler(
+            ex, RetryPolicy(max_attempts=1, backoff_base_s=0.01,
+                            breaker_threshold=1,
+                            breaker_cooldown_s=60.0),
+            max_batch=1, cache=cache)
+        warm = seq_of(base=4)
+        with sched:
+            assert sched.submit(FoldRequest(seq=warm)).result(
+                timeout=30).ok                 # populates the store
+            ex.behave = lambda batch, call: (_ for _ in ()).throw(
+                TransientExecutorError("down"))
+            r = sched.submit(
+                FoldRequest(seq=seq_of(base=5))).result(timeout=30)
+            assert r.status == "error"         # opened the breaker
+            r_hit = sched.submit(FoldRequest(seq=warm)).result(timeout=30)
+            r_novel = sched.submit(
+                FoldRequest(seq=seq_of(base=6))).result(timeout=30)
+        assert r_hit.ok and r_hit.source == "cache"
+        assert r_novel.status == "degraded"
+
+
+class TestLeaderRetryFollowerOrdering:
+    def test_transient_leader_failure_does_not_fan_out(self):
+        """Satellite regression: a retried leader's followers resolve
+        only on the leader's TERMINAL state — a transient failure must
+        not propagate."""
+        first_failed = threading.Event()
+        release = threading.Event()
+
+        def behave(batch, call):
+            if call == 1:
+                first_failed.set()
+                raise TransientExecutorError("flaky once")
+            release.wait(10)
+            return None
+
+        ex = StubExecutor(behave)
+        cache = FoldCache(registry=MetricsRegistry())
+        sched = make_scheduler(
+            ex, RetryPolicy(max_attempts=3, backoff_base_s=0.05),
+            max_batch=1, cache=cache)
+        seq = seq_of()
+        with sched:
+            t_lead = sched.submit(FoldRequest(seq=seq))
+            assert first_failed.wait(10)
+            t_follow = sched.submit(FoldRequest(seq=seq))
+            # the leader failed transiently already; the follower must
+            # still be parked, not error-resolved
+            time.sleep(0.1)
+            assert not t_follow.done(), \
+                "transient leader failure fanned out to follower"
+            assert not t_lead.done()
+            release.set()
+            r_lead = t_lead.result(timeout=30)
+            r_follow = t_follow.result(timeout=30)
+        assert r_lead.ok and r_lead.attempts >= 2
+        assert r_follow.ok and r_follow.source == "coalesced"
+        assert np.allclose(r_lead.coords, r_follow.coords)
+
+
+@pytest.mark.quick
+class TestTicketTimeout:
+    def test_result_timeout_raises_instead_of_blocking(self):
+        t = FoldTicket("req-hang")
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="req-hang"):
+            t.result(timeout=0.05)
+        assert time.monotonic() - t0 < 5.0
+
+
+class TestFaultPlan:
+    def test_seeded_determinism(self):
+        a = FaultPlan(seed=11, exec_error_rate=0.3,
+                      registry=MetricsRegistry()).arm()
+        b = FaultPlan(seed=11, exec_error_rate=0.3,
+                      registry=MetricsRegistry()).arm()
+        assert [a._hit("exec", 0.3) for _ in range(200)] == \
+            [b._hit("exec", 0.3) for _ in range(200)]
+
+    def test_disarmed_is_noop(self):
+        plan = FaultPlan(seed=1, exec_error_rate=1.0,
+                         registry=MetricsRegistry())
+        batch = {"seq": np.zeros((1, 8), np.int32),
+                 "mask": np.ones((1, 8), bool)}
+        plan.on_executor_run(batch)            # disarmed: no raise
+        plan.arm()
+        with pytest.raises(TransientExecutorError):
+            plan.on_executor_run(batch)
+
+    def test_poison_rows_content_addressed(self):
+        plan = FaultPlan(seed=1, registry=MetricsRegistry()).arm()
+        poison = seq_of(base=2)
+        plan.add_poison(poison, mode="raise")
+        batch = {"seq": np.zeros((2, 16), np.int32),
+                 "mask": np.zeros((2, 16), bool)}
+        batch["seq"][1, :8] = poison
+        batch["mask"][1, :8] = True
+        with pytest.raises(FaultInjected, match="poison_input"):
+            plan.on_executor_run(batch)
+        # warmup-style all-padding batches never match
+        clean = {"seq": np.zeros((2, 16), np.int32),
+                 "mask": np.zeros((2, 16), bool)}
+        plan.on_executor_run(clean)
+
+    def test_corrupt_cache_bytes_hits_quarantine_path(self, tmp_path):
+        plan = FaultPlan(seed=1, corrupt_rate=1.0,
+                         registry=MetricsRegistry()).arm()
+        cache = FoldCache(disk_dir=str(tmp_path), faults=plan,
+                          registry=MetricsRegistry())
+        cache.put("deadbeef", np.ones((4, 3), np.float32),
+                  np.ones(4, np.float32))
+        cache._mem_drop("deadbeef")            # force the disk tier
+        assert cache.get("deadbeef") is None   # corrupt -> miss
+        snap = cache.stats.snapshot()
+        assert snap["disk_errors"] == 1 and snap["misses"] == 1
+        quarantined = list(tmp_path.glob("*/*.quarantined"))
+        assert len(quarantined) == 1
+
+
+class TestPeerMarkdownRecovery:
+    def test_cooldown_probe_marks_peer_back_up(self):
+        from alphafold2_tpu import fleet
+
+        reg = fleet.ReplicaRegistry(model_tag="v1",
+                                    registry=MetricsRegistry())
+        owner_cache = FoldCache(registry=MetricsRegistry())
+        srv = fleet.PeerCacheServer(owner_cache, rollout=reg.rollout,
+                                    replica_id="r1",
+                                    metrics=MetricsRegistry()).start()
+        try:
+            reg.register("r0")
+            reg.register("r1", peer_addr=srv.address)
+            client = fleet.PeerCacheClient(
+                reg, "r0", rollout=reg.rollout,
+                recovery_cooldown_s=0.2, timeout_s=2.0,
+                metrics=MetricsRegistry())
+            k = next(f"key{i}" for i in range(1000)
+                     if client.router.owner_for(f"key{i}") == "r1")
+            # kill the owner; transport failures trip the markdown
+            srv.stop()
+            for _ in range(client.fail_threshold):
+                assert client.get(k) is None
+            assert not reg.is_healthy("r1")
+            # probe DURING cooldown: stays down
+            assert client.get(k) is None
+            assert not reg.is_healthy("r1")
+            # restart the replica on the same port; after the cooldown
+            # the half-open probe marks it back up
+            srv2 = fleet.PeerCacheServer(
+                owner_cache, rollout=reg.rollout, replica_id="r1",
+                host=srv.address[0], port=srv.address[1],
+                metrics=MetricsRegistry()).start()
+            try:
+                time.sleep(0.25)
+                client.get(k)      # triggers the probe (daemon thread)
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline \
+                        and not reg.is_healthy("r1"):
+                    time.sleep(0.01)
+                assert reg.is_healthy("r1")
+                assert client.recoveries == 1
+                # recovered peer serves again
+                v = np.ones((4, 3), np.float32)
+                owner_cache.put(k, v, np.ones(4, np.float32))
+                got = client.get(k)
+                assert got is not None and np.allclose(got.coords, v)
+            finally:
+                srv2.stop()
+        finally:
+            try:
+                srv.stop()
+            except Exception:
+                pass
+
+    def test_injected_peer_faults_feed_markdown(self):
+        from alphafold2_tpu import fleet
+
+        plan = FaultPlan(seed=1, peer_error_rate=1.0,
+                         registry=MetricsRegistry()).arm()
+        reg = fleet.ReplicaRegistry(model_tag="v1",
+                                    registry=MetricsRegistry())
+        owner_cache = FoldCache(registry=MetricsRegistry())
+        srv = fleet.PeerCacheServer(owner_cache, rollout=reg.rollout,
+                                    replica_id="r1",
+                                    metrics=MetricsRegistry()).start()
+        try:
+            reg.register("r0")
+            reg.register("r1", peer_addr=srv.address)
+            client = fleet.PeerCacheClient(
+                reg, "r0", rollout=reg.rollout, faults=plan,
+                metrics=MetricsRegistry())
+            k = next(f"key{i}" for i in range(1000)
+                     if client.router.owner_for(f"key{i}") == "r1")
+            for _ in range(client.fail_threshold):
+                assert client.get(k) is None   # injected, live server
+            assert not reg.is_healthy("r1")
+            assert plan.snapshot()["injected"]["peer_error"] >= \
+                client.fail_threshold
+        finally:
+            srv.stop()
+
+
+class TestChaosEndToEnd:
+    def test_seeded_chaos_32_requests_zero_hung_tickets(self):
+        """ISSUE 5 acceptance: 32 requests + 1 poison under seeded
+        transient faults — every ticket reaches a terminal state, every
+        innocent resolves ok, the poison is quarantined within the
+        bisection bound, nothing hangs."""
+        plan = FaultPlan(seed=5, exec_error_rate=0.2,
+                         registry=MetricsRegistry()).arm()
+        poison = seq_of(base=13)
+        plan.add_poison(poison, mode="raise")
+        ex = StubExecutor(faults=plan)
+        cache = FoldCache(registry=MetricsRegistry())
+        max_batch = 4
+        sched = make_scheduler(
+            ex, RetryPolicy(max_attempts=4, backoff_base_s=0.005,
+                            seed=5),
+            max_batch=max_batch, max_wait_ms=10.0, cache=cache)
+        reqs = [FoldRequest(seq=np.full(8, (i % 16) + 1, np.int32))
+                for i in range(32)]
+        poison_req = FoldRequest(seq=poison)
+        tickets = {}
+        lock = threading.Lock()
+
+        def submit_slice(i):
+            for r in reqs[i::4]:
+                t = sched.submit(r)
+                with lock:
+                    tickets[r.request_id] = (t, False)
+            if i == 2:
+                t = sched.submit(poison_req)
+                with lock:
+                    tickets[poison_req.request_id] = (t, True)
+
+        with sched:
+            threads = [threading.Thread(target=submit_slice, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            resolved = {}
+            for rid, (ticket, is_poison) in tickets.items():
+                # a hung ticket fails the run here, not the harness
+                resolved[rid] = (ticket.result(timeout=60), is_poison)
+        assert len(resolved) == 33
+        for rid, (resp, is_poison) in resolved.items():
+            if is_poison:
+                assert resp.status == "poisoned", (resp.status,
+                                                   resp.error)
+                assert resp.attempts <= int(math.log2(max_batch)) + 1
+            else:
+                assert resp.ok, (rid, resp.status, resp.error)
+                assert np.isfinite(resp.coords).all()
+        res = sched.serve_stats()["resilience"]
+        assert res["quarantine"]["quarantined"] == 1
+        assert plan.snapshot()["injected"]["exec_error"] > 0
+        snap = sched.metrics.snapshot()
+        assert snap["errors"] == 0 and snap["shed"] == 0
+        assert snap["poisoned"] == 1

@@ -41,6 +41,22 @@ rendered by `tools/obs_report.py`; `--prom-path F` dumps the process
 metrics registry as Prometheus text exposition on exit. Together they
 are the observability phase of tools/serve_smoke.sh.
 
+`--chaos` arms a seeded fault-injection plan (`serve.FaultPlan`) after
+warmup: each executor batch fails transiently with probability
+`--chaos-exec-rate`, `--chaos-poison` poison requests are mixed into
+the schedule (mode "raise" fails any batch containing one — the
+bisection path; mode "nan" corrupts its output rows — the validation
+path), and optional latency spikes / corrupt cache bytes / peer
+transport failures exercise the watchdog, quarantine, and markdown
+tiers. Chaos implies `--retry on` (a `serve.RetryPolicy` on the
+scheduler) unless `--retry off` explicitly measures the unhardened
+baseline. The report carries a "chaos" section (injections actually
+fired) plus poisoned/degraded/retried counts and per-poison attempt
+counts; with `--smoke` the run FAILS unless every ticket reaches a
+terminal state, every innocent request resolves ok, exactly the
+requested number of poison requests is quarantined, and each poison
+was cornered within the log2(max_batch)+1 bisection bound.
+
 `--smoke` (tools/serve_smoke.sh) exits 1 on ANY shed / timeout / error /
 rejected request at trivial load — the serving regression tripwire. With
 a duplicated workload (`--dup-rate` > 0, cache on) it additionally fails
@@ -121,7 +137,96 @@ def parse_args(argv=None):
                     choices=("cpu", "ambient"))
     ap.add_argument("--smoke", action="store_true",
                     help="exit 1 on any shed/timeout/error/rejection")
+    ap.add_argument("--retry", default="auto",
+                    choices=("auto", "on", "off"),
+                    help="scheduler RetryPolicy (failure-domain "
+                         "hardening); auto = on iff --chaos")
+    ap.add_argument("--retry-max-attempts", type=int, default=4)
+    ap.add_argument("--watchdog-s", type=float, default=0.0,
+                    help="per-batch executor watchdog deadline; 0 = off")
+    ap.add_argument("--breaker-threshold", type=int, default=0,
+                    help="consecutive batch failures that open the "
+                         "degraded-mode circuit breaker; 0 = off")
+    ap.add_argument("--chaos", action="store_true",
+                    help="arm seeded fault injection (serve.FaultPlan) "
+                         "after warmup")
+    ap.add_argument("--chaos-seed", type=int, default=7)
+    ap.add_argument("--chaos-exec-rate", type=float, default=0.10,
+                    help="P(injected transient executor failure) per "
+                         "batch execution")
+    ap.add_argument("--chaos-latency-rate", type=float, default=0.0)
+    ap.add_argument("--chaos-latency-s", type=float, default=0.05)
+    ap.add_argument("--chaos-poison", type=int, default=1,
+                    help="poison requests mixed into the schedule")
+    ap.add_argument("--chaos-poison-mode", default="raise",
+                    choices=("raise", "nan"))
+    ap.add_argument("--chaos-corrupt-rate", type=float, default=0.0,
+                    help="P(corrupted disk-cache bytes) per read")
+    ap.add_argument("--chaos-peer-rate", type=float, default=0.0,
+                    help="P(injected peer transport failure) per fetch "
+                         "(fleet mode)")
     return ap.parse_args(argv)
+
+
+def _build_resilience(args):
+    """(FaultPlan or None, RetryPolicy or None) from the chaos flags."""
+    from alphafold2_tpu import serve
+
+    plan = None
+    if args.chaos:
+        plan = serve.FaultPlan(
+            seed=args.chaos_seed,
+            exec_error_rate=args.chaos_exec_rate,
+            exec_latency_rate=args.chaos_latency_rate,
+            exec_latency_s=args.chaos_latency_s,
+            peer_error_rate=args.chaos_peer_rate,
+            corrupt_rate=args.chaos_corrupt_rate)
+    retry = None
+    if args.retry == "on" or (args.retry == "auto" and args.chaos):
+        retry = serve.RetryPolicy(
+            max_attempts=args.retry_max_attempts,
+            backoff_base_s=0.02, backoff_max_s=0.5,
+            seed=args.chaos_seed,
+            watchdog_s=args.watchdog_s or None,
+            breaker_threshold=args.breaker_threshold)
+    return plan, retry
+
+
+def _poison_pool(args, jax):
+    """Dedicated poison prototypes, disjoint from the normal pool by
+    construction (their own PRNG key)."""
+    from alphafold2_tpu.data.synthetic import synthetic_requests
+
+    if not (args.chaos and args.chaos_poison > 0):
+        return []
+    lengths = tuple(int(x) for x in args.lengths.split(",") if x)
+    return synthetic_requests(
+        jax.random.PRNGKey(999), num=args.chaos_poison,
+        lengths=lengths, msa_depth=args.msa_depth)
+
+
+def _schedule_poison(schedule, n_poison):
+    """Replace n_poison slots with sentinel indices -(p+1), spread
+    through the middle of the schedule so each poison meets a warm,
+    concurrent system. Slots are kept DISTINCT (clamping at the tail
+    walks down to the nearest free slot) so a short schedule never
+    silently drops a poison; when the schedule is shorter than
+    n_poison the leftover poisons are unplaceable and the chaos smoke
+    check reports the shortfall."""
+    if not n_poison or not schedule:
+        return schedule
+    schedule = list(schedule)
+    step = max(1, len(schedule) // (n_poison + 1))
+    used = set()
+    for p in range(n_poison):
+        slot = min((p + 1) * step, len(schedule) - 1)
+        while slot in used and slot > 0:
+            slot -= 1
+        if slot in used:
+            break                     # more poisons than slots
+        used.add(slot)
+        schedule[slot] = -(p + 1)
+    return schedule
 
 
 def _zipf_schedule(args, pool_len: int):
@@ -206,8 +311,10 @@ def main(argv=None) -> int:
 
     model, params = _build_tiny_model(args, jax, jnp, policy)
 
+    plan, retry = _build_resilience(args)
     executor = serve.FoldExecutor(model, params,
-                                  max_entries=policy.num_buckets)
+                                  max_entries=policy.num_buckets,
+                                  faults=plan)
     metrics = serve.ServeMetrics(args.metrics_path)
     config = serve.SchedulerConfig(
         max_batch_size=args.max_batch, max_wait_ms=args.max_wait_ms,
@@ -216,7 +323,8 @@ def main(argv=None) -> int:
                                       and args.dup_rate > 0)
     cache = None
     if cache_on:
-        cache = serve.FoldCache(disk_dir=args.cache_dir or None)
+        cache = serve.FoldCache(disk_dir=args.cache_dir or None,
+                                faults=plan)
     tracer = None
     if args.trace_path:
         from alphafold2_tpu import obs
@@ -224,7 +332,7 @@ def main(argv=None) -> int:
                             slow_k=args.trace_slow_k)
     scheduler = serve.Scheduler(executor, policy, config, metrics,
                                 cache=cache, model_tag="serve_loadtest",
-                                tracer=tracer)
+                                tracer=tracer, retry=retry)
 
     warmup_timer = StepTimer()
     with warmup_timer.measure():
@@ -232,6 +340,13 @@ def main(argv=None) -> int:
     scheduler.start()
 
     import numpy as np
+
+    poisons = _poison_pool(args, jax)
+    if plan is not None:
+        for p in poisons:
+            plan.add_poison(np.asarray(p.seq),
+                            mode=args.chaos_poison_mode)
+        plan.arm()        # warmup/compiles ran clean; the window starts
 
     deadline_s = args.deadline_s or None
     # duration-mode cache runs need unique headroom: a 64-prototype pool
@@ -245,9 +360,12 @@ def main(argv=None) -> int:
         jax.random.PRNGKey(1), num=pool_n,
         lengths=lengths, msa_depth=args.msa_depth, deadline_s=deadline_s)
 
-    schedule = _zipf_schedule(args, len(pool))
+    schedule = _schedule_poison(_zipf_schedule(args, len(pool)),
+                                len(poisons))
 
     failures = []
+    statuses = {}
+    poison_results = []
     lock = threading.Lock()
     counter = [0]
 
@@ -259,15 +377,32 @@ def main(argv=None) -> int:
                         (budget and i >= budget):
                     return
                 counter[0] = i + 1
-            req_proto = pool[schedule[i % len(schedule)]]
+            idx = schedule[i % len(schedule)]
+            is_poison = idx < 0
+            req_proto = poisons[-idx - 1] if is_poison else pool[idx]
             req = serve.FoldRequest(seq=req_proto.seq, msa=req_proto.msa,
                                     deadline_s=deadline_s)
             try:
+                # FoldTicket.result(timeout=) is the caller-side hang
+                # fence: a wedged ticket fails THIS run loudly instead
+                # of blocking the harness forever
                 resp = scheduler.submit(req).result(timeout=600)
             except Exception as exc:
                 with lock:
                     failures.append(repr(exc))
                 return  # a broken loop would spin; one strike ends it
+            with lock:
+                statuses[resp.status] = statuses.get(resp.status, 0) + 1
+            if is_poison:
+                # a poison request is EXPECTED to terminate "poisoned";
+                # the chaos smoke judges these separately
+                with lock:
+                    poison_results.append(
+                        {"request_id": resp.request_id,
+                         "poison": -idx - 1,
+                         "status": resp.status,
+                         "attempts": resp.attempts})
+                continue
             if not resp.ok:
                 with lock:
                     failures.append(f"{resp.status}: {resp.error}")
@@ -312,6 +447,10 @@ def main(argv=None) -> int:
         "shed": snap["shed"],
         "errors": snap["errors"],
         "rejected": snap["rejected"],
+        "degraded": snap["degraded"],
+        "poisoned": snap["poisoned"],
+        "retried": snap["retried"],
+        "statuses": statuses,
         "batches": snap["batches"],
         "cache_enabled": cache_on,
         "cache_hit_ratio": round(cache_snap["hit_ratio"], 4),
@@ -339,9 +478,18 @@ def main(argv=None) -> int:
             k: cache_snap["store"][k]
             for k in ("hits", "misses", "disk_hits", "disk_errors",
                       "evictions", "bytes_resident", "entries_resident")}
+    if retry is not None:
+        report["resilience"] = snap["resilience"]
+    if plan is not None:
+        report["chaos"] = dict(plan.snapshot(),
+                               poison_mode=args.chaos_poison_mode,
+                               poison_results=poison_results)
     metrics.close()
     print(json.dumps(report))
 
+    if args.smoke and args.chaos:
+        return _check_chaos_smoke(args, snap, failures, poison_results,
+                                  retry is not None)
     if args.smoke:
         bad = snap["shed"] + snap["errors"] + snap["rejected"] \
             + len(failures)
@@ -362,6 +510,72 @@ def main(argv=None) -> int:
                  if cache_on else "")
         print(f"SMOKE OK: {snap['served']} folds, 0 shed/errors{extra}",
               file=sys.stderr)
+    return 0
+
+
+def _check_chaos_smoke(args, snap, failures, poison_results,
+                       retry_on: bool) -> int:
+    """Chaos tripwire (serve_smoke.sh phase 5): under seeded faults the
+    hardened scheduler must leave ZERO collateral damage — every ticket
+    terminal, every innocent request ok, each poison request quarantined
+    within the bisection bound, and nothing hung."""
+    import math
+
+    problems = []
+    if failures:
+        # includes caller-side FoldTicket.result timeouts == hung
+        # tickets, and any innocent non-ok terminal state
+        problems.append(f"{len(failures)} innocent failures "
+                        f"(first: {failures[0]})")
+    innocent_bad = snap["shed"] + snap["errors"] + snap["rejected"]
+    if innocent_bad:
+        problems.append(f"{innocent_bad} shed/error/rejected outcomes "
+                        "among innocent requests")
+    if snap["served"] == 0:
+        problems.append("0 served")
+    if args.duration_s <= 0 and len(poison_results) != args.chaos_poison:
+        problems.append(f"{len(poison_results)} poison submissions, "
+                        f"expected {args.chaos_poison}")
+    if args.chaos_poison and not poison_results:
+        # duration mode can cycle the schedule without ever reaching a
+        # poison slot — that run proved nothing, fail it loudly
+        problems.append("no poison requests were submitted")
+    # the quarantine is KEYED: N submissions of one poison (duration
+    # mode cycles the schedule; duplicates fail fast) still hold
+    # exactly one key, so compare against distinct poisons submitted
+    distinct = len({pr["poison"] for pr in poison_results})
+    if retry_on:
+        quarantined = snap["resilience"]["quarantine"]["quarantined"]
+        if quarantined != distinct:
+            problems.append(f"{quarantined} quarantined keys, expected "
+                            f"exactly {distinct} (distinct poisons "
+                            "submitted)")
+        # the log2 bound models BISECTION executions only, which is
+        # exact for raise-mode poisons (their batches always fail
+        # deterministically before the transient draw); a nan-mode
+        # poison's batch can fail transiently and be re-enqueued any
+        # number of times before validation ever sees its output, so
+        # attempts legitimately exceeds the bisection bound there
+        bound = int(math.log2(max(args.max_batch, 1))) + 1
+        for pr in poison_results:
+            if pr["status"] != "poisoned":
+                problems.append(f"poison {pr['request_id']} resolved "
+                                f"{pr['status']!r}, not 'poisoned'")
+            elif args.chaos_poison_mode == "raise" \
+                    and pr["attempts"] > bound:
+                problems.append(
+                    f"poison {pr['request_id']} took {pr['attempts']} "
+                    f"batch executions > log2(max_batch)+1 = {bound}")
+    if problems:
+        print("SMOKE FAIL (chaos): " + "; ".join(problems),
+              file=sys.stderr)
+        return 1
+    inj = snap.get("resilience", {})
+    print(f"SMOKE OK (chaos): {snap['served']} folds under injected "
+          f"faults, {snap['retried']} retries, "
+          f"{inj.get('bisections', 0)} bisections, "
+          f"{snap['poisoned']} poisoned, 0 innocent casualties",
+          file=sys.stderr)
     return 0
 
 
@@ -389,6 +603,7 @@ def _run_fleet(args) -> int:
     fleet_on = args.fleet != "off"
     model_tag = "serve_loadtest@v1"
     deadline_s = args.deadline_s or None
+    plan, retry = _build_resilience(args)
     config = serve.SchedulerConfig(
         max_batch_size=args.max_batch, max_wait_ms=args.max_wait_ms,
         num_recycles=args.num_recycles, msa_depth=args.msa_depth)
@@ -401,16 +616,25 @@ def _run_fleet(args) -> int:
         cache_kwargs["disk_dir"] = args.cache_dir
     fl = fleet.InProcessFleet(
         lambda: serve.FoldExecutor(model, params,
-                                   max_entries=policy.num_buckets),
+                                   max_entries=policy.num_buckets,
+                                   faults=plan),
         policy, config, n_replicas=args.replicas, model_tag=model_tag,
         cache_kwargs=cache_kwargs, fleet=fleet_on, tracer=tracer,
         metrics_factory=lambda i: serve.ServeMetrics(
-            f"{args.metrics_path}.r{i}"))
+            f"{args.metrics_path}.r{i}"),
+        retry=retry, faults=plan)
 
     warmup_timer = StepTimer()
     with warmup_timer.measure():
         compiles = fl.warmup()
     fl.start()
+
+    poisons = _poison_pool(args, jax)
+    if plan is not None:
+        for p in poisons:
+            plan.add_poison(np.asarray(p.seq),
+                            mode=args.chaos_poison_mode)
+        plan.arm()
 
     pool_n = max(args.requests, 64)
     if args.duration_s > 0 and (args.cache == "on" or args.dup_rate > 0):
@@ -418,7 +642,8 @@ def _run_fleet(args) -> int:
     pool = synthetic_requests(
         jax.random.PRNGKey(1), num=pool_n, lengths=lengths,
         msa_depth=args.msa_depth, deadline_s=deadline_s)
-    schedule = _zipf_schedule(args, len(pool))
+    schedule = _schedule_poison(_zipf_schedule(args, len(pool)),
+                                len(poisons))
 
     # mid-run weight rollout: request index >= bump_at keys under the
     # new tag (count mode only; the shared counter makes exactly one
@@ -429,6 +654,7 @@ def _run_fleet(args) -> int:
     rolled_tag = model_tag + "+rolled"
 
     failures = []
+    poison_results = []
     lock = threading.Lock()
     counter = [0]
 
@@ -442,7 +668,9 @@ def _run_fleet(args) -> int:
                 counter[0] = i + 1
             if bump_at and i == bump_at:
                 fl.bump_model_tag(rolled_tag)
-            req_proto = pool[schedule[i % len(schedule)]]
+            idx = schedule[i % len(schedule)]
+            is_poison = idx < 0
+            req_proto = poisons[-idx - 1] if is_poison else pool[idx]
             req = serve.FoldRequest(seq=req_proto.seq, msa=req_proto.msa,
                                     deadline_s=deadline_s)
             try:
@@ -454,6 +682,13 @@ def _run_fleet(args) -> int:
                 with lock:
                     failures.append(repr(exc))
                 return
+            if is_poison:
+                with lock:
+                    poison_results.append(
+                        {"request_id": resp.request_id,
+                         "status": resp.status,
+                         "attempts": resp.attempts})
+                continue
             if not resp.ok:
                 with lock:
                     failures.append(f"{resp.status}: {resp.error}")
@@ -518,6 +753,11 @@ def _run_fleet(args) -> int:
         for r in fl.replicas
         if r.cache is not None and getattr(r.cache, "peer", None)
         is not None and hasattr(r.cache.peer, "stale_tag_hits"))
+    peer_recoveries = sum(
+        r.cache.peer.recoveries
+        for r in fl.replicas
+        if r.cache is not None and getattr(r.cache, "peer", None)
+        is not None and hasattr(r.cache.peer, "recoveries"))
     forwards = 0
     fwd_metric = obs.get_registry().snapshot().get("fleet_forwards_total")
     if fwd_metric:
@@ -542,6 +782,7 @@ def _run_fleet(args) -> int:
         "peer_hits": agg["peer_hits"],
         "forwards": forwards,
         "leader_promotions": agg["leader_promotions"],
+        "peer_recoveries": peer_recoveries,
         "bad_outcomes": bad,
         "serving_wall_s": round(serving_wall, 3),
         "warmup_s": round(warmup_timer.mean * warmup_timer.count, 3),
@@ -554,10 +795,16 @@ def _run_fleet(args) -> int:
             "stale_probe": stale_probe}),
         "per_replica": {
             rid: {k: snap[k] for k in ("served", "batches", "shed",
-                                       "errors", "rejected")}
+                                       "errors", "rejected",
+                                       "degraded", "poisoned",
+                                       "retried")}
             for rid, snap in st["replicas"].items()},
         "failures": failures[:8],
     }
+    if plan is not None:
+        report["chaos"] = dict(plan.snapshot(),
+                               poison_mode=args.chaos_poison_mode,
+                               poison_results=poison_results)
     if tracer is not None:
         tracer.close()
         report["trace_path"] = args.trace_path
@@ -572,6 +819,12 @@ def _run_fleet(args) -> int:
             print(f"SMOKE FAIL (fleet): {bad} bad outcomes, "
                   f"{len(failures)} failures, {agg['served']} served",
                   file=sys.stderr)
+            return 1
+        bad_poison = [p for p in poison_results
+                      if p["status"] != "poisoned"]
+        if bad_poison:
+            print(f"SMOKE FAIL (fleet): poison requests not "
+                  f"quarantined: {bad_poison}", file=sys.stderr)
             return 1
         if args.dup_rate > 0 and \
                 agg["cache_hits"] + agg["coalesced"] == 0:

@@ -24,6 +24,18 @@
 #      follows the epoch bump, or tools/obs_report.py --check finds
 #      orphan routing spans in the fleet run's traces — the
 #      fleet-subsystem tripwire.
+#   5. chaos: the phase-2 workload re-run under seeded fault injection
+#      (--chaos: 10% injected transient executor failures + one poison
+#      request) with the failure-domain hardening on (RetryPolicy).
+#      serve_loadtest.py --smoke --chaos FAILS unless every ticket
+#      reaches a terminal state (zero hung tickets), every innocent
+#      request resolves ok (shed/errors/rejected == 0 — i.e. the
+#      innocent ok-rate matches the no-chaos phase-1 baseline), exactly
+#      ONE request is quarantined (status "poisoned"), and the poison
+#      was cornered within log2(max_batch)+1 batch executions; then
+#      tools/obs_report.py --check over the chaos traces proves no
+#      orphan retry/watchdog spans — recovery cost is fully accounted
+#      in the waterfall. The resilience-subsystem tripwire.
 #
 # Invoked standalone from the test-tier docs (README "Tests");
 # tests/test_serve.py + tests/test_cache.py + tests/test_obs.py cover
@@ -125,7 +137,7 @@ timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
 # the fleet must measurably beat independent replicas on the same
 # duplicated traffic, and the epoch bump must have produced zero
 # stale-tag hits
-exec env -u PYTHONPATH python - <<'EOF'
+env -u PYTHONPATH python - <<'EOF'
 import json, sys
 base = json.load(open("/tmp/serve_smoke_fleet_base.json"))
 fleet = json.load(open("/tmp/serve_smoke_fleet.json"))
@@ -153,3 +165,35 @@ print(f"FLEET SMOKE OK: hit_ratio {fleet['hit_ratio']} > "
       f"{fleet['peer_hits']} peer hits, 0 stale-tag hits",
       file=sys.stderr)
 EOF
+
+# phase 5: the phase-2 workload under seeded chaos — 10% transient
+# executor faults + one poison request; the hardened scheduler must
+# leave zero collateral damage (serve_loadtest --smoke --chaos enforces
+# terminal tickets / innocent ok-rate / exactly-one quarantine / the
+# log2(max_batch)+1 bisection bound in-process), and the recovery must
+# be fully accounted in the traces (no orphan retry/watchdog spans)
+rm -f /tmp/serve_smoke_chaos_traces.jsonl
+
+timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/serve_loadtest.py \
+    --smoke \
+    --chaos \
+    --chaos-exec-rate 0.10 \
+    --chaos-poison 1 \
+    --requests 48 \
+    --dup-rate 0.5 \
+    --cache on \
+    --lengths 24,48 \
+    --buckets 32,64 \
+    --msa-depth 3 \
+    --max-batch 2 \
+    --concurrency 2 \
+    --deadline-s 120 \
+    --num-recycles 0 \
+    --metrics-path /tmp/serve_smoke_chaos.jsonl \
+    --trace-path /tmp/serve_smoke_chaos_traces.jsonl \
+    --prom-path /tmp/serve_smoke_chaos.prom
+
+exec timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/obs_report.py /tmp/serve_smoke_chaos_traces.jsonl \
+    --check --prom /tmp/serve_smoke_chaos.prom
