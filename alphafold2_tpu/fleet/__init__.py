@@ -19,15 +19,25 @@ and one cached home. Pieces, each usable alone:
 - object_store: ObjectStoreBackend/FilesystemObjectStore/
                 ObjectStorePeer — the same peer tier over a shared
                 volume instead of HTTP
+- rpc:          LocalTransport/HttpTransport — the forwarding
+                transport seam (FoldTicket semantics over a process
+                boundary; failover marker, remote cancel)
+- frontdoor:    FrontDoorServer — per-replica HTTP front door
+                (submit/long-poll result/cancel/healthz/admin), the
+                surface HttpTransport speaks
 - local:        InProcessFleet — N fully-wired replicas in one process
                 (the loadtest/smoke/test harness and the deployment's
                 executable spec)
+- procfleet:    ProcFleet/FleetClient — N REAL replica processes with
+                crash/partition/drain chaos (serve_loadtest --procs,
+                serve_smoke.sh phase 6)
 
 Everything is OFF by default: a Scheduler without `router=` and a
 FoldCache without `peer=` behave exactly as before (README "Fleet
-serving", MIGRATING "Fleet").
+serving" / "Deployment", MIGRATING "Fleet").
 """
 
+from alphafold2_tpu.fleet.frontdoor import FrontDoorServer  # noqa: F401
 from alphafold2_tpu.fleet.local import FleetReplica, InProcessFleet  # noqa: F401
 from alphafold2_tpu.fleet.object_store import (FilesystemObjectStore,  # noqa: F401
                                                ObjectStoreBackend,
@@ -37,3 +47,5 @@ from alphafold2_tpu.fleet.registry import (ReplicaInfo, ReplicaRegistry,  # noqa
                                            RolloutState)
 from alphafold2_tpu.fleet.router import (ConsistentHashRouter,  # noqa: F401
                                          RouteDecision)
+from alphafold2_tpu.fleet.rpc import (HttpTransport, LocalTransport,  # noqa: F401
+                                      RPC_TRANSPORT_MARKER)

@@ -1,0 +1,428 @@
+"""Per-replica HTTP front door: full FoldTicket semantics over the wire.
+
+One `FrontDoorServer` fronts one `Scheduler` (stdlib
+ThreadingHTTPServer — the same zero-dependency trust model as the peer
+cache tier in `fleet/peer.py`). The protocol is deliberately tiny:
+
+    POST /v1/submit              npz body (seq[, msa]) + QoS headers
+                                 -> 200 {"ticket": id}
+                                 -> 409 model-tag mismatch
+                                 -> 429 queue full     (retry elsewhere)
+                                 -> 503 draining/stopped/partitioned
+    GET  /v1/result/<id>?wait_s= long-poll; 200 npz + X-Status/X-Source/
+                                 X-Attempts/X-Error when terminal
+                                 (single pickup: the slot is freed),
+                                 204 still in flight, 404 unknown
+    POST /v1/cancel/<id>         best-effort: drop the parked slot
+    GET  /healthz                the fleet's ONE health payload:
+                                 replica, tag, epoch, breaker, queue
+                                 depth, draining — the same shape the
+                                 peer cache server serves, so the
+                                 router's health walk and the recovery
+                                 probe share one truth
+    POST /admin/rollout          {"tag": t} -> bump RolloutState
+    GET  /admin/stats            serve_stats() as JSON
+    POST /admin/partition        {"duration_s": f} -> data-plane 503s
+                                 for f seconds (chaos: an induced
+                                 network partition as every caller
+                                 experiences it; admin stays reachable)
+
+Every terminal status travels verbatim — ok / shed / error / cancelled
+/ degraded / poisoned, plus source cache/coalesced/forwarded — so a
+remote caller sees exactly what an in-process caller would. Deadlines
+and priorities propagate in headers and are re-anchored at the
+receiving scheduler (the deadline clock restarts at the owner's
+submit, matching the one-hop forwarding contract).
+
+Parked results are TTL-bounded (`ticket_ttl_s`): a client that dies
+between submit and pickup costs one slot for the TTL, never forever;
+`/v1/cancel` (sent by `HttpTransport` when a forwarded ticket's
+`result(timeout=)` expires) frees it early.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib import parse as urlparse
+
+from alphafold2_tpu.fleet.rpc import (decode_request, encode_response,
+                                      _HDR_TAG)
+from alphafold2_tpu.obs.registry import MetricsRegistry, get_registry
+
+
+class _TicketSlot:
+    """One submitted request's parked result."""
+
+    __slots__ = ("ticket", "event", "response", "resolved_at",
+                 "cancelled")
+
+    def __init__(self, ticket):
+        self.ticket = ticket
+        self.event = threading.Event()
+        self.response = None
+        self.resolved_at = None      # set when the result parks
+        self.cancelled = False
+
+
+class FrontDoorServer:
+    """Serve one Scheduler's submit/result surface over localhost HTTP.
+
+    scheduler: the replica's `serve.Scheduler` (already started by the
+        owner; this server never starts/stops it — except via `drain`
+        wiring owned by the process, not the protocol).
+    rollout: optional `fleet.RolloutState`; when set, submits carrying
+        a different `X-Model-Tag` are refused 409 (the same rule the
+        peer cache protocol enforces) and `/admin/rollout` bumps it.
+    partition: optional `threading.Event`; while set, every data-plane
+        request is refused 503 — the chaos harness's induced network
+        partition. `/admin/partition` arms it on a timer. The same
+        event can be shared with the replica's `PeerCacheServer` so a
+        partition severs both planes at once.
+    """
+
+    def __init__(self, scheduler, rollout=None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 replica_id: str = "", ticket_ttl_s: float = 300.0,
+                 max_wait_s: float = 30.0,
+                 partition: Optional[threading.Event] = None,
+                 metrics: Optional[MetricsRegistry] = None):
+        self.scheduler = scheduler
+        self.rollout = rollout
+        self.replica_id = replica_id
+        self.ticket_ttl_s = float(ticket_ttl_s)
+        self.max_wait_s = float(max_wait_s)
+        self.partition = partition if partition is not None \
+            else threading.Event()
+        self._lock = threading.Lock()
+        self._slots: dict = {}
+        self._ticket_counter = [0]
+        # boot nonce in every ticket id: a restarted replica reuses its
+        # port, and without the nonce its counter would reissue the
+        # dead process's ids — a pre-crash caller's stale poll could
+        # then fetch (and mislabel) a NEW request's fold, and its
+        # timed-out ticket's late cancel could drop one. With the
+        # nonce both get a clean 404 -> transport-marker failover.
+        self._boot_nonce = uuid.uuid4().hex[:8]
+        self._partition_timer: Optional[threading.Timer] = None
+        # optional zero-arg callable merged into /admin/stats under
+        # "extra" — the owning process adds what the scheduler cannot
+        # see (peer-client counters, front-door snapshot)
+        self.extra_stats = None
+        reg = metrics or get_registry()
+        # distinct name from the client-side fleet_rpc_requests_total:
+        # a procfleet replica both serves a front door and forwards via
+        # HttpTransports on the same registry, and the registry dedups
+        # by metric name — one shared name would silently sum sent and
+        # served RPCs into a single series
+        self._m_rpc = reg.counter(
+            "fleet_rpc_served_total",
+            "front-door RPCs served by this process, by route/outcome",
+            ("route", "outcome"))
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code: int, body: bytes = b"",
+                       headers: Optional[dict] = None,
+                       content_type: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    if k != "Content-Type":
+                        self.send_header(k, v)
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def _json(self, code: int, payload: dict):
+                self._reply(code, json.dumps(payload).encode("utf-8"))
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", "0") or 0)
+                return self.rfile.read(n) if n else b""
+
+            def do_GET(self):
+                try:
+                    server._handle(self, "GET")
+                except Exception as exc:
+                    try:
+                        self._json(500, {"error": repr(exc)})
+                    except Exception:
+                        pass
+
+            def do_POST(self):
+                try:
+                    server._handle(self, "POST")
+                except Exception as exc:
+                    try:
+                        self._json(500, {"error": repr(exc)})
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        host, port = self._httpd.server_address[:2]
+        return str(host), int(port)
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FrontDoorServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True,
+                name=f"frontdoor-{self.replica_id or self.address[1]}")
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        with self._lock:
+            if self._partition_timer is not None:
+                self._partition_timer.cancel()
+                self._partition_timer = None
+
+    def __enter__(self) -> "FrontDoorServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- routing ---------------------------------------------------------
+
+    def _handle(self, h, method: str):
+        parsed = urlparse.urlsplit(h.path)
+        path = parsed.path
+        if path == "/healthz" and method == "GET":
+            return self._healthz(h)
+        if path.startswith("/admin/"):
+            return self._admin(h, method, path)
+        if self.partition.is_set():
+            # induced partition: the data plane is unreachable exactly
+            # the way a firewalled host is — callers time out or error,
+            # mark this replica down, and fail over
+            self._m_rpc.inc(route="data", outcome="partitioned")
+            return h._json(503, {"error": "partitioned"})
+        if path == "/v1/submit" and method == "POST":
+            return self._submit(h)
+        if path.startswith("/v1/result/") and method == "GET":
+            return self._result(h, path[len("/v1/result/"):], parsed)
+        if path.startswith("/v1/cancel/") and method == "POST":
+            return self._cancel(h, path[len("/v1/cancel/"):])
+        h._json(404, {"error": "not found"})
+
+    # -- endpoints -------------------------------------------------------
+
+    def _healthz(self, h):
+        payload = {"replica": self.replica_id,
+                   "tag": self.rollout.tag if self.rollout else "",
+                   "epoch": self.rollout.epoch if self.rollout else 0,
+                   "partitioned": self.partition.is_set()}
+        health = getattr(self.scheduler, "health", None)
+        if callable(health):
+            try:
+                payload.update(health())
+            except Exception:
+                pass
+        if self.partition.is_set():
+            # a partitioned replica is unreachable, health included —
+            # the recovery probe must keep it marked down
+            self._m_rpc.inc(route="healthz", outcome="partitioned")
+            return h._reply(503, json.dumps(payload).encode("utf-8"))
+        self._m_rpc.inc(route="healthz", outcome="ok")
+        h._json(200, payload)
+
+    def _submit(self, h):
+        from alphafold2_tpu.serve.scheduler import (DrainingError,
+                                                    QueueFullError)
+
+        tag = h.headers.get(_HDR_TAG, "")
+        if self.rollout is not None and tag \
+                and tag != self.rollout.tag:
+            self._m_rpc.inc(route="submit", outcome="stale_tag")
+            return h._json(409, {"error": "model tag mismatch",
+                                 "tag": self.rollout.tag})
+        try:
+            request = decode_request(h._body(), h.headers)
+        except ValueError as exc:
+            self._m_rpc.inc(route="submit", outcome="bad_request")
+            return h._json(400, {"error": str(exc)})
+        try:
+            ticket = self.scheduler.submit(request)
+        except DrainingError:
+            self._m_rpc.inc(route="submit", outcome="draining")
+            return h._json(503, {"error": "draining"})
+        except QueueFullError:
+            self._m_rpc.inc(route="submit", outcome="queue_full")
+            return h._json(429, {"error": "queue full"})
+        except ValueError as exc:
+            # deterministic input problem (e.g. length exceeds the
+            # largest bucket): the CLIENT's error, 400 — never 500,
+            # which failover layers would misread as a server fault
+            # and retry across the whole fleet
+            self._m_rpc.inc(route="submit", outcome="bad_request")
+            return h._json(400, {"error": str(exc)})
+        except RuntimeError as exc:
+            # stopped scheduler: same caller story as draining —
+            # this replica cannot take the work, go elsewhere
+            self._m_rpc.inc(route="submit", outcome="unavailable")
+            return h._json(503, {"error": str(exc)})
+        slot = _TicketSlot(ticket)
+        with self._lock:
+            self._ticket_counter[0] += 1
+            ticket_id = f"{self.replica_id or 'fd'}-" \
+                        f"{self._boot_nonce}-" \
+                        f"{self._ticket_counter[0]}"
+            self._gc_locked()
+            self._slots[ticket_id] = slot
+
+        def _on_done(response):
+            slot.response = response
+            slot.resolved_at = time.monotonic()
+            slot.event.set()
+            if slot.cancelled:
+                with self._lock:
+                    self._slots.pop(ticket_id, None)
+
+        ticket.add_done_callback(_on_done)
+        self._m_rpc.inc(route="submit", outcome="ok")
+        h._json(200, {"ticket": ticket_id,
+                      "request_id": request.request_id})
+
+    def _result(self, h, ticket_id: str, parsed):
+        ticket_id = urlparse.unquote(ticket_id)
+        with self._lock:
+            slot = self._slots.get(ticket_id)
+        if slot is None:
+            self._m_rpc.inc(route="result", outcome="unknown")
+            return h._json(404, {"error": "unknown ticket"})
+        try:
+            wait_s = float(urlparse.parse_qs(parsed.query).get(
+                "wait_s", ["0"])[0])
+        except ValueError:
+            wait_s = 0.0
+        wait_s = max(0.0, min(wait_s, self.max_wait_s))
+        if not slot.event.wait(wait_s):
+            self._m_rpc.inc(route="result", outcome="pending")
+            return h._reply(204, b"")
+        body, headers = encode_response(slot.response)
+        with self._lock:
+            self._slots.pop(ticket_id, None)   # single pickup
+        self._m_rpc.inc(route="result", outcome="ok")
+        h._reply(200, body, headers=headers,
+                 content_type="application/octet-stream")
+
+    def _cancel(self, h, ticket_id: str):
+        ticket_id = urlparse.unquote(ticket_id)
+        with self._lock:
+            slot = self._slots.pop(ticket_id, None)
+        if slot is not None:
+            # the fold itself may already be batched — best-effort
+            # means the RESULT slot is dropped (and a late resolution
+            # self-cleans via the done callback), not that the
+            # accelerator work is yanked back
+            slot.cancelled = True
+        self._m_rpc.inc(route="cancel",
+                        outcome="ok" if slot is not None else "unknown")
+        h._json(200, {"cancelled": slot is not None})
+
+    def _admin(self, h, method: str, path: str):
+        if path == "/admin/rollout" and method == "POST":
+            if self.rollout is None:
+                return h._json(400, {"error": "no rollout state"})
+            try:
+                payload = json.loads(h._body().decode("utf-8"))
+                tag = payload["tag"]
+            except Exception as exc:
+                return h._json(400, {"error": f"bad payload: {exc!r}"})
+            epoch = self.rollout.bump(str(tag))
+            self._m_rpc.inc(route="admin_rollout", outcome="ok")
+            return h._json(200, {"tag": self.rollout.tag,
+                                 "epoch": epoch})
+        if path == "/admin/stats" and method == "GET":
+            try:
+                stats = self.scheduler.serve_stats()
+                if self.extra_stats is not None:
+                    stats["extra"] = self.extra_stats()
+                body = json.dumps(stats, default=float).encode("utf-8")
+            except Exception as exc:
+                return h._json(500, {"error": repr(exc)})
+            self._m_rpc.inc(route="admin_stats", outcome="ok")
+            return h._reply(200, body)
+        if path == "/admin/partition" and method == "POST":
+            try:
+                payload = json.loads(h._body().decode("utf-8") or "{}")
+                duration = float(payload.get("duration_s", 0.0))
+            except Exception as exc:
+                return h._json(400, {"error": f"bad payload: {exc!r}"})
+            self.set_partition(duration)
+            self._m_rpc.inc(route="admin_partition", outcome="ok")
+            return h._json(200, {"partitioned": duration > 0,
+                                 "duration_s": duration})
+        h._json(404, {"error": "not found"})
+
+    # -- partition / gc --------------------------------------------------
+
+    def set_partition(self, duration_s: float):
+        """Arm (duration_s > 0) or clear (<= 0) the induced partition;
+        a positive duration auto-heals on a timer."""
+        with self._lock:
+            if self._partition_timer is not None:
+                self._partition_timer.cancel()
+                self._partition_timer = None
+            if duration_s > 0:
+                self.partition.set()
+                self._partition_timer = threading.Timer(
+                    duration_s, self.partition.clear)
+                self._partition_timer.daemon = True
+                self._partition_timer.start()
+            else:
+                self.partition.clear()
+
+    def _gc_locked(self):
+        """Drop RESOLVED slots unpicked for longer than the TTL (caller
+        holds _lock). Unresolved slots are never evicted: they are
+        in-flight scheduler work whose client may legitimately
+        long-poll past any TTL (HttpTransport's poll budget exceeds
+        it by design), and the scheduler owes every ticket a terminal
+        state, so an unresolved slot always becomes collectable.
+        Runs on the submit path, so an idle server holds stale slots
+        until the next submit — fine: the TTL bounds memory, not
+        correctness."""
+        if not self._slots:
+            return
+        cutoff = time.monotonic() - self.ticket_ttl_s
+        dead = [tid for tid, slot in self._slots.items()
+                if slot.resolved_at is not None
+                and slot.resolved_at < cutoff]
+        for tid in dead:
+            self._slots.pop(tid, None)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"replica": self.replica_id,
+                    "address": list(self.address),
+                    "parked_tickets": len(self._slots),
+                    "partitioned": self.partition.is_set()}

@@ -5,10 +5,13 @@ tools/serve_smoke.sh phase 4, and tests/test_fleet.py all run it. Each
 replica is a full serving stack (FoldExecutor + FoldCache +
 PeerCacheServer on 127.0.0.1 + ConsistentHashRouter + Scheduler),
 sharing only the ReplicaRegistry and its RolloutState; forwarding uses
-each peer Scheduler's bound `submit` as the transport, peer cache
-fetches go over real localhost HTTP. A networked deployment replaces
-exactly two things — the submit transport and how the registry is fed —
-and nothing in serve/, cache/, or fleet/ routing changes.
+a `fleet.rpc.LocalTransport` over each peer Scheduler's bound `submit`
+(same thread, same ticket — the pre-transport behavior behind the new
+seam), peer cache fetches go over real localhost HTTP. A networked
+deployment replaces exactly two things — the transport (`HttpTransport`
+against each replica's `FrontDoorServer`; `fleet/procfleet.py` is the
+executable spec) and how the registry is fed — and nothing in serve/,
+cache/, or fleet/ routing changes.
 
 Rollout: `bump_model_tag(tag)` flips the fleet's RolloutState, whose
 subscriber re-tags every scheduler before bump() returns — subsequent
@@ -31,6 +34,7 @@ from alphafold2_tpu.cache import FoldCache
 from alphafold2_tpu.fleet.peer import PeerCacheClient, PeerCacheServer
 from alphafold2_tpu.fleet.registry import ReplicaRegistry
 from alphafold2_tpu.fleet.router import ConsistentHashRouter
+from alphafold2_tpu.fleet.rpc import LocalTransport
 from alphafold2_tpu.obs.registry import MetricsRegistry
 from alphafold2_tpu.serve.bucketing import BucketPolicy
 from alphafold2_tpu.serve.metrics import ServeMetrics
@@ -131,11 +135,16 @@ class InProcessFleet:
                 metrics=(metrics_factory(i) if metrics_factory else None),
                 cache=cache, model_tag=model_tag, tracer=tracer,
                 registry=registry, router=router, retry=rep_retry)
-            # the forwarding transport IS the peer scheduler's submit;
-            # registered after construction so the registry row is
-            # complete before any router can pick this owner
+            # the forwarding transport wraps the peer scheduler's
+            # submit (LocalTransport — in-process, zero-copy); set
+            # after construction so the registry row is complete
+            # before any router can pick this owner
             info = self.registry.get(rid)
-            info.submit = scheduler.submit
+            info.transport = LocalTransport(scheduler.submit)
+            if peer_server is not None:
+                # unified health: the peer probe payload carries the
+                # same breaker/queue/drain truth the front door serves
+                peer_server.health_source = scheduler.health
             self.replicas.append(
                 FleetReplica(rid, scheduler, cache, peer_server, router))
 

@@ -73,10 +73,24 @@ class PeerCacheServer:
     def __init__(self, cache, rollout: Optional[RolloutState] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  replica_id: str = "",
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 health_source=None,
+                 partition: Optional[threading.Event] = None):
         self.cache = cache
         self.rollout = rollout
         self.replica_id = replica_id
+        # health_source: zero-arg callable merged into the /healthz
+        # payload (Scheduler.health — breaker state, queue depth, drain
+        # flag), so the recovery probe and the router's health walk
+        # read the SAME truth the front door serves. Assignable after
+        # construction (the in-process harness builds servers before
+        # schedulers).
+        self.health_source = health_source
+        # partition: while set, every request (healthz included) is
+        # refused 503 — the chaos harness's induced partition, shared
+        # with the replica's FrontDoorServer so one event severs both
+        # planes
+        self.partition = partition
         m_served = (metrics or get_registry()).counter(
             "fleet_peer_served_total",
             "peer-protocol fetches served by this process, by outcome",
@@ -107,12 +121,27 @@ class PeerCacheServer:
             def do_GET(self):
                 try:
                     parsed = urlparse.urlsplit(self.path)
+                    if server.partition is not None \
+                            and server.partition.is_set():
+                        # induced partition: unreachable on every
+                        # route, health included — probes must keep
+                        # this replica marked down until it heals
+                        self._reply(503, b"partitioned", "text/plain")
+                        return
                     if parsed.path == "/healthz":
                         snap = {"replica": server.replica_id,
                                 "tag": (server.rollout.tag
                                         if server.rollout else ""),
                                 "epoch": (server.rollout.epoch
                                           if server.rollout else 0)}
+                        if server.health_source is not None:
+                            # one truth: the same Scheduler.health dict
+                            # the front door serves (breaker state,
+                            # queue depth, draining)
+                            try:
+                                snap.update(server.health_source())
+                            except Exception:
+                                pass
                         self._reply(200, json.dumps(snap).encode(),
                                     "application/json")
                         return
@@ -288,6 +317,8 @@ class PeerCacheClient:
             with urlrequest.urlopen(f"http://{host}:{port}/healthz",
                                     timeout=self.timeout_s) as resp:
                 ok = resp.status == 200
+                if ok:
+                    ok = self._probe_payload_healthy(resp.read())
         except Exception:
             ok = False                  # still down; cooldown restarts
         if ok:
@@ -296,6 +327,24 @@ class PeerCacheClient:
                 self.recoveries += 1
             self.registry.mark(peer_id, up=True)
             self._m_recoveries.inc()
+
+    @staticmethod
+    def _probe_payload_healthy(body: bytes) -> bool:
+        """A 200 alone does not prove a replica serves: the unified
+        health payload (Scheduler.health via the server's
+        health_source) may say the breaker is OPEN — the process
+        answers HTTP but fast-sheds every novel fold — or that it is
+        draining/stopped. Both count as still-down; pre-unification
+        payloads (no such fields) keep the old 200-is-up behavior."""
+        try:
+            snap = json.loads(body.decode("utf-8"))
+        except Exception:
+            return True           # not JSON: legacy probe, 200 wins
+        if snap.get("breaker") == "open":
+            return False
+        if snap.get("draining") or snap.get("running") is False:
+            return False
+        return True
 
     def get(self, key: str, trace=NULL_TRACE) -> Optional[CachedFold]:
         self._maybe_probe_down_peers()

@@ -1,0 +1,561 @@
+"""Multi-process fleet harness: N REAL replica processes, one front
+door each, and the failure modes a single process cannot have.
+
+`fleet.InProcessFleet` is the fleet's executable spec, but everything
+in it shares one Python process — a replica there can never crash,
+hang, or partition away from its peers. This module runs the SAME
+stack (FoldExecutor + FoldCache + PeerCacheServer + router + Scheduler)
+as separate OS processes wired by `fleet.rpc.HttpTransport` against
+each replica's `fleet.frontdoor.FrontDoorServer`, so the chaos the
+ROADMAP's north star is defined by becomes inducible:
+
+- kill -9 one replica mid-run: its in-flight forwarded tickets
+  error-resolve with the transport marker and FAIL OVER to local folds
+  on the replicas that forwarded them; driver-side submits to the dead
+  front door retry on the next replica (`FleetClient`, backed by the
+  same `serve.RetryPolicy` classification/backoff the scheduler uses);
+- partition one replica (`POST /admin/partition`): both its planes
+  (front door AND peer cache, one shared event) refuse with 503 for a
+  window — callers mark it down and route around it; the recovery
+  probe heals it when the window closes, and `breaker=open` or
+  `draining` in the unified health payload keeps a sick-but-listening
+  replica marked down;
+- rolling drain-restart: SIGTERM wires to `Scheduler.drain()` — stop
+  admitting (503 to callers, who go elsewhere), let outstanding
+  forwards resolve, fold everything queued, let parked results be
+  picked up, exit 0. On restart the replica rejoins at the PERSISTED
+  rollout epoch (`<state>/rollout.json`) with its PERSISTED poison
+  quarantine (`<state>/quarantine.jsonl`) — no stale-tag serving, no
+  re-bisecting known poisons.
+
+Driven by `tools/serve_loadtest.py --procs N` and serve_smoke.sh
+phase 6; tests/test_frontdoor.py's `slow`-marked tier asserts the same
+invariants in miniature. The replica child is this module's `__main__`
+(`python -m alphafold2_tpu.fleet.procfleet --config <json-file>`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+from urllib import request as urlrequest
+
+from alphafold2_tpu.fleet.rpc import RPC_TRANSPORT_MARKER, HttpTransport
+from alphafold2_tpu.obs.trace import NULL_TRACE
+
+_REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def _free_port() -> int:
+    """An ephemeral port the OS just considered free. Classic
+    check-then-use race, acceptable for a localhost harness: the
+    window is microseconds and a collision fails loudly at bind."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _scrubbed_env() -> dict:
+    """Child env mirroring tests/conftest.py's hardening: CPU platform,
+    no ambient PJRT plugin injection (a replica that dials a wedged
+    TPU tunnel at import hangs the whole harness)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTHONPATH", None)
+    return env
+
+
+# -- parent: the process fleet -------------------------------------------
+
+class ReplicaHandle:
+    """One spawned replica process + its addresses and state dirs."""
+
+    def __init__(self, index: int, config: dict, config_path: str):
+        self.index = index
+        self.config = config
+        self.config_path = config_path
+        self.proc: Optional[subprocess.Popen] = None
+        self.log_path = os.path.join(
+            os.path.dirname(config_path), "replica.log")
+
+    @property
+    def replica_id(self) -> str:
+        return self.config["replica_id"]
+
+    @property
+    def frontdoor_url(self) -> str:
+        return (f"http://{self.config['host']}:"
+                f"{self.config['frontdoor_port']}")
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class ProcFleet:
+    """Spawn, address, and torment N replica processes.
+
+    run_dir: every replica gets `<run_dir>/<rid>/` holding its config,
+        log, state (rollout.json / quarantine.jsonl), cache dir, and
+        trace JSONL — kill -9 loses the process, never the state.
+    model: dict of tiny-model knobs the child builds its executor from
+        (dim, depth, msa_depth — the loadtest's synthetic serving
+        model, small enough that N replicas compile in seconds on CPU).
+    """
+
+    def __init__(self, n_replicas: int, run_dir: str,
+                 model_tag: str = "procfleet@v1",
+                 buckets: tuple = (32, 64),
+                 max_batch: int = 2, max_wait_ms: float = 25.0,
+                 num_recycles: int = 0,
+                 model: Optional[dict] = None,
+                 retry: bool = True,
+                 host: str = "127.0.0.1"):
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.run_dir = os.path.abspath(run_dir)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.replicas: List[ReplicaHandle] = []
+        ports = [(_free_port(), _free_port()) for _ in range(n_replicas)]
+        peer_rows = [{"replica_id": f"r{i}", "host": host,
+                      "frontdoor_port": fd, "peer_port": pp}
+                     for i, (fd, pp) in enumerate(ports)]
+        for i, row in enumerate(peer_rows):
+            rdir = os.path.join(self.run_dir, row["replica_id"])
+            os.makedirs(rdir, exist_ok=True)
+            config = dict(
+                row,
+                model_tag=model_tag,
+                state_dir=os.path.join(rdir, "state"),
+                cache_dir=os.path.join(rdir, "cache"),
+                trace_path=os.path.join(rdir, "traces.jsonl"),
+                buckets=list(buckets),
+                max_batch=int(max_batch),
+                max_wait_ms=float(max_wait_ms),
+                num_recycles=int(num_recycles),
+                model=dict(model or {"dim": 32, "depth": 1,
+                                     "msa_depth": 3}),
+                retry=bool(retry),
+                peers=[p for p in peer_rows
+                       if p["replica_id"] != row["replica_id"]])
+            config_path = os.path.join(rdir, "config.json")
+            with open(config_path, "w") as fh:
+                json.dump(config, fh, indent=1)
+            self.replicas.append(ReplicaHandle(i, config, config_path))
+
+    # -- lifecycle -------------------------------------------------------
+
+    def spawn(self, index: int) -> ReplicaHandle:
+        h = self.replicas[index]
+        if h.alive():
+            return h
+        log = open(h.log_path, "a")
+        h.proc = subprocess.Popen(
+            [sys.executable, "-m", "alphafold2_tpu.fleet.procfleet",
+             "--config", h.config_path],
+            cwd=_REPO, env=_scrubbed_env(),
+            stdout=log, stderr=subprocess.STDOUT)
+        log.close()          # the child holds the fd
+        return h
+
+    def start(self, timeout_s: float = 180.0) -> "ProcFleet":
+        for i in range(len(self.replicas)):
+            self.spawn(i)
+        self.wait_ready(timeout_s=timeout_s)
+        return self
+
+    def wait_ready(self, indices: Optional[List[int]] = None,
+                   timeout_s: float = 180.0):
+        """Block until each replica's /healthz answers 200 with
+        running=True (warm executor, both servers up)."""
+        deadline = time.monotonic() + timeout_s
+        for i in (indices if indices is not None
+                  else range(len(self.replicas))):
+            h = self.replicas[i]
+            while True:
+                if not h.alive():
+                    raise RuntimeError(
+                        f"{h.replica_id} exited rc={h.proc.poll()} "
+                        f"before ready (log: {h.log_path})")
+                snap = self.healthz(i)
+                if snap is not None and snap.get("running"):
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"{h.replica_id} not ready in {timeout_s}s "
+                        f"(log: {h.log_path})")
+                time.sleep(0.2)
+
+    def stop(self, timeout_s: float = 60.0):
+        """SIGTERM every live replica (graceful drain) and reap;
+        escalate to SIGKILL past the timeout."""
+        for h in self.replicas:
+            if h.alive():
+                h.proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + timeout_s
+        for h in self.replicas:
+            if h.proc is None:
+                continue
+            try:
+                h.proc.wait(max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                h.proc.kill()
+                h.proc.wait(10)
+
+    def __enter__(self) -> "ProcFleet":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- chaos verbs -----------------------------------------------------
+
+    def kill(self, index: int) -> int:
+        """kill -9: the crash no handler sees. Returns the (negative)
+        returncode."""
+        h = self.replicas[index]
+        h.proc.kill()
+        return h.proc.wait(30)
+
+    def sigterm(self, index: int, timeout_s: float = 60.0) -> int:
+        """Graceful drain via SIGTERM; returns the exit code (the
+        drain contract is exit 0)."""
+        h = self.replicas[index]
+        h.proc.send_signal(signal.SIGTERM)
+        return h.proc.wait(timeout_s)
+
+    def restart(self, index: int, timeout_s: float = 180.0):
+        """Respawn a dead replica on its ORIGINAL ports/state (crash
+        recovery: persisted rollout epoch + quarantine load at boot)."""
+        self.spawn(index)
+        self.wait_ready([index], timeout_s=timeout_s)
+
+    def partition(self, index: int, duration_s: float) -> bool:
+        """Induce a network partition: both the replica's planes refuse
+        for `duration_s`, then auto-heal."""
+        return self._admin_post(
+            index, "/admin/partition",
+            {"duration_s": float(duration_s)}) is not None
+
+    def rollout(self, new_tag: str) -> Dict[str, Optional[int]]:
+        """Bump the model tag on every LIVE replica (the deployment's
+        rollout driver). Dead/partitioned replicas are skipped — they
+        rejoin at the right tag from their persisted epoch or are
+        409-fenced until an operator rolls them."""
+        out = {}
+        for i, h in enumerate(self.replicas):
+            resp = self._admin_post(i, "/admin/rollout",
+                                    {"tag": new_tag})
+            out[h.replica_id] = (None if resp is None
+                                 else resp.get("epoch"))
+        return out
+
+    # -- views -----------------------------------------------------------
+
+    def healthz(self, index: int) -> Optional[dict]:
+        return self._get_json(index, "/healthz")
+
+    def stats(self, index: int) -> Optional[dict]:
+        return self._get_json(index, "/admin/stats")
+
+    def _get_json(self, index: int, path: str,
+                  timeout_s: float = 5.0) -> Optional[dict]:
+        url = self.replicas[index].frontdoor_url + path
+        try:
+            with urlrequest.urlopen(url, timeout=timeout_s) as resp:
+                if resp.status != 200:
+                    return None
+                return json.loads(resp.read().decode("utf-8"))
+        except Exception:
+            return None
+
+    def _admin_post(self, index: int, path: str, payload: dict,
+                    timeout_s: float = 5.0) -> Optional[dict]:
+        url = self.replicas[index].frontdoor_url + path
+        req = urlrequest.Request(
+            url, data=json.dumps(payload).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        try:
+            with urlrequest.urlopen(req, timeout=timeout_s) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except Exception:
+            return None
+
+    def merge_traces(self, out_path: str, extra_paths: tuple = ()):
+        """Concatenate every replica's trace JSONL (plus extra files,
+        e.g. the driver's own) into one file for obs_report. A replica
+        killed -9 mid-write can leave a torn tail line — skipped here
+        (a torn line is the crash's signature, not an obs bug)."""
+        paths = [h.config["trace_path"] for h in self.replicas]
+        paths += list(extra_paths)
+        with open(out_path, "w") as out:
+            for p in paths:
+                try:
+                    with open(p) as fh:
+                        for line in fh:
+                            line = line.strip()
+                            if not line:
+                                continue
+                            try:
+                                json.loads(line)
+                            except ValueError:
+                                continue      # torn tail from kill -9
+                            out.write(line + "\n")
+                except OSError:
+                    continue
+
+
+class FleetClient:
+    """The driver's front-door load balancer with failover.
+
+    One `HttpTransport` per replica; `fold()` submits round-robin from
+    a caller-chosen seat and retries on the NEXT replica whenever the
+    chosen one cannot take or finish the work: refused/draining/queue-
+    full submit, transport-marker error resolution (owner died or
+    partitioned mid-fold), or a result timeout (which also fires the
+    remote cancel). Classification and backoff come from the same
+    `serve.RetryPolicy` the scheduler uses — the fleet has ONE notion
+    of what is transient. A request only errors out when every replica
+    in turn failed it `max_rounds` times — with one induced failure at
+    a time and N >= 2 that never happens, which is exactly the
+    zero-lost-requests property phase 6 asserts."""
+
+    def __init__(self, urls: List[str], retry=None,
+                 result_timeout_s: float = 120.0, max_rounds: int = 3,
+                 metrics=None):
+        from alphafold2_tpu.serve.resilience import RetryPolicy
+
+        if not urls:
+            raise ValueError("FleetClient needs at least one URL")
+        self.transports = [HttpTransport(u, metrics=metrics)
+                           for u in urls]
+        self.retry = retry or RetryPolicy(
+            max_attempts=4, backoff_base_s=0.1, backoff_max_s=1.0)
+        self.result_timeout_s = float(result_timeout_s)
+        self.max_rounds = int(max_rounds)
+        self._lock = threading.Lock()
+        self.submit_retries = 0       # submit refused, went elsewhere
+        self.failovers = 0            # terminal transport-marker errors
+        self.timeouts = 0             # result timeouts (remote-cancelled)
+
+    def _count(self, field: str):
+        with self._lock:
+            setattr(self, field, getattr(self, field) + 1)
+
+    def fold(self, request, hint: int = 0, trace=NULL_TRACE):
+        """Submit `request` and block for its terminal FoldResponse,
+        failing over across replicas. Raises RuntimeError only when
+        every replica failed it repeatedly."""
+        from urllib.error import HTTPError
+
+        n = len(self.transports)
+        last = None
+        for attempt in range(self.max_rounds * n):
+            transport = self.transports[(hint + attempt) % n]
+            try:
+                ticket = transport.submit(request, trace=trace)
+            except HTTPError as exc:
+                if exc.code < 500 and exc.code != 429:
+                    # deterministic client error (400 bad request,
+                    # 409 tag fence): every replica will refuse it the
+                    # same way — surface it, don't burn a failover
+                    # round per replica
+                    raise
+                last = exc
+                self._count("submit_retries")
+                time.sleep(self.retry.delay_s(attempt + 1))
+                continue
+            except Exception as exc:
+                # dead / draining / partitioned / full front door:
+                # nothing was accepted, the next replica takes it
+                last = exc
+                self._count("submit_retries")
+                time.sleep(self.retry.delay_s(attempt + 1))
+                continue
+            try:
+                resp = ticket.result(timeout=self.result_timeout_s)
+            except TimeoutError as exc:
+                # result(timeout=) already sent the remote cancel
+                last = exc
+                self._count("timeouts")
+                continue
+            if resp.status == "error" and resp.error \
+                    and RPC_TRANSPORT_MARKER in resp.error:
+                # owner died mid-fold: at-least-once beats lost
+                last = RuntimeError(resp.error)
+                self._count("failovers")
+                time.sleep(self.retry.delay_s(attempt + 1))
+                continue
+            return resp
+        raise RuntimeError(
+            f"all {n} replicas failed {request.request_id} "
+            f"({self.max_rounds} rounds; last: {last!r})")
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"submit_retries": self.submit_retries,
+                    "failovers": self.failovers,
+                    "timeouts": self.timeouts}
+
+
+# -- child: one replica process ------------------------------------------
+
+def replica_main(config: dict) -> int:
+    """Build and serve one full replica from a ProcFleet config dict;
+    blocks until SIGTERM (graceful drain, exit 0)."""
+    # conftest-grade hardening, in-process too (belt over the parent's
+    # env scrub: a bare operator invocation must not dial the tunnel)
+    sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import __graft_entry__
+    __graft_entry__.force_cpu_fallback()
+    # N replicas compile the same tiny executables: the persistent,
+    # platform-namespaced compile cache makes replicas 2..N (and every
+    # restart) near-instant to warm
+    __graft_entry__._enable_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from alphafold2_tpu import Alphafold2, obs, serve
+    from alphafold2_tpu.fleet.frontdoor import FrontDoorServer
+    from alphafold2_tpu.fleet.peer import (PeerCacheClient,
+                                           PeerCacheServer)
+    from alphafold2_tpu.fleet.registry import ReplicaRegistry
+    from alphafold2_tpu.fleet.router import ConsistentHashRouter
+
+    rid = config["replica_id"]
+    host = config["host"]
+    state_dir = config["state_dir"]
+    os.makedirs(state_dir, exist_ok=True)
+
+    # membership: fed from the deployment config (the control plane of
+    # this harness); rollout state is DURABLE so a crashed/drained
+    # replica rejoins at the tag the fleet rolled to, not its boot tag
+    registry = ReplicaRegistry(
+        model_tag=config["model_tag"],
+        rollout_persist_path=os.path.join(state_dir, "rollout.json"))
+    rollout = registry.rollout
+
+    policy = serve.BucketPolicy(config["buckets"])
+    mcfg = config["model"]
+    model = Alphafold2(dim=mcfg["dim"], depth=mcfg["depth"], heads=2,
+                       dim_head=16, predict_coords=True,
+                       structure_module_depth=1)
+    n0 = policy.edges[0]
+    msa_depth = int(mcfg["msa_depth"])
+    init_kwargs = dict(mask=jnp.ones((1, n0), bool))
+    if msa_depth > 0:
+        init_kwargs["msa"] = jnp.zeros((1, msa_depth, n0), jnp.int32)
+        init_kwargs["msa_mask"] = jnp.ones((1, msa_depth, n0), bool)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, n0), jnp.int32), **init_kwargs)
+    executor = serve.FoldExecutor(model, params,
+                                  max_entries=policy.num_buckets)
+
+    from alphafold2_tpu.cache import FoldCache
+    cache = FoldCache(disk_dir=config["cache_dir"])
+    router = ConsistentHashRouter(registry, rid)
+    client = PeerCacheClient(registry, rid, router=router,
+                             rollout=rollout)
+    cache.peer = client
+
+    registry.register(rid)
+    for peer in config["peers"]:
+        registry.register(
+            peer["replica_id"],
+            peer_addr=(peer["host"], int(peer["peer_port"])),
+            transport=HttpTransport(
+                f"http://{peer['host']}:{peer['frontdoor_port']}",
+                rollout=rollout))
+
+    tracer = obs.Tracer(jsonl_path=config["trace_path"])
+    retry = None
+    if config.get("retry", True):
+        retry = serve.RetryPolicy(max_attempts=4, backoff_base_s=0.02,
+                                  backoff_max_s=0.5)
+    scheduler = serve.Scheduler(
+        executor, policy,
+        serve.SchedulerConfig(
+            max_batch_size=int(config["max_batch"]),
+            max_wait_ms=float(config["max_wait_ms"]),
+            num_recycles=int(config["num_recycles"]),
+            msa_depth=msa_depth),
+        cache=cache, model_tag=rollout.tag, tracer=tracer,
+        router=router, retry=retry,
+        quarantine_path=os.path.join(state_dir, "quarantine.jsonl"))
+    rollout.subscribe(
+        lambda tag, epoch: setattr(scheduler, "model_tag", tag))
+
+    partition = threading.Event()
+    frontdoor = FrontDoorServer(scheduler, rollout=rollout,
+                                host=host,
+                                port=int(config["frontdoor_port"]),
+                                replica_id=rid, partition=partition)
+    peer_server = PeerCacheServer(cache, rollout=rollout, host=host,
+                                  port=int(config["peer_port"]),
+                                  replica_id=rid,
+                                  health_source=scheduler.health,
+                                  partition=partition)
+    frontdoor.extra_stats = lambda: {
+        "peer": {"stale_tag_hits": client.stale_tag_hits,
+                 "recoveries": client.recoveries},
+        "frontdoor": frontdoor.snapshot(),
+        "rollout": {"tag": rollout.tag, "epoch": rollout.epoch}}
+
+    scheduler.warmup()
+    scheduler.start()
+    peer_server.start()
+    frontdoor.start()
+
+    stop_event = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop_event.set())
+    signal.signal(signal.SIGINT, lambda *a: stop_event.set())
+    print(json.dumps({"ready": rid,
+                      "frontdoor": list(frontdoor.address),
+                      "peer": list(peer_server.address),
+                      "tag": rollout.tag,
+                      "epoch": rollout.epoch}), flush=True)
+
+    stop_event.wait()
+
+    # graceful drain: refuse new work, finish what we owe, let parked
+    # results be picked up, then exit 0 — the SIGTERM contract a
+    # rolling restart relies on
+    complete = scheduler.drain()
+    grace_deadline = time.monotonic() + 10.0
+    while (frontdoor.snapshot()["parked_tickets"] > 0
+           and time.monotonic() < grace_deadline):
+        time.sleep(0.05)
+    frontdoor.stop()
+    peer_server.stop()
+    tracer.close()
+    print(json.dumps({"drained": rid, "complete": complete}),
+          flush=True)
+    return 0
+
+
+def _main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="one procfleet replica process")
+    ap.add_argument("--config", required=True,
+                    help="path to the replica's config.json")
+    args = ap.parse_args(argv)
+    with open(args.config) as fh:
+        config = json.load(fh)
+    return replica_main(config)
+
+
+if __name__ == "__main__":
+    sys.exit(_main())

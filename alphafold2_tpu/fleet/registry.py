@@ -30,6 +30,8 @@ networked deployment exercise identical code paths.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -47,20 +49,53 @@ class RolloutState:
     so by the time a rollout driver sees `bump` return, no component
     will serve or fetch a stale-tag fold. Subscribers run under the
     state lock: keep them O(1) attribute writes (the in-process harness
-    uses them to swap each Scheduler.model_tag)."""
+    uses them to swap each Scheduler.model_tag).
+
+    `persist_path` makes (tag, epoch) durable: every bump atomically
+    rewrites the file (tmp + os.replace) and construction loads it —
+    a replica that crashed or was drain-restarted REJOINS at the tag
+    the fleet had rolled to, instead of coming back up serving (and
+    peer-refusing) under its boot-time default. The persisted epoch
+    wins over the constructor's `model_tag` whenever the file exists;
+    file trouble degrades to the in-memory default."""
 
     def __init__(self, model_tag: str = "",
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 persist_path: Optional[str] = None):
         self._lock = threading.Lock()
         self._tag = model_tag
         self._epoch = 0
+        self._persist_path = persist_path
         self._subscribers: List[Callable[[str, int], None]] = []
+        if persist_path:
+            try:
+                with open(persist_path) as fh:
+                    rec = json.load(fh)
+                self._tag = str(rec["tag"])
+                self._epoch = int(rec["epoch"])
+            except Exception:
+                pass           # first boot / unreadable: boot default
         reg = registry or get_registry()
         self._m_epoch = reg.gauge(
             "fleet_model_epoch", "current weight-rollout epoch")
         self._m_rollouts = reg.counter(
             "fleet_rollouts_total", "model_tag epoch bumps")
-        self._m_epoch.set(0)
+        self._m_epoch.set(self._epoch)
+
+    def _persist_locked(self):
+        """Caller holds self._lock. Atomic rewrite: a crash mid-rollout
+        leaves either the old or the new epoch, never a torn file."""
+        if not self._persist_path:
+            return
+        try:
+            d = os.path.dirname(os.path.abspath(self._persist_path))
+            os.makedirs(d, exist_ok=True)
+            tmp = f"{self._persist_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump({"tag": self._tag, "epoch": self._epoch}, fh)
+            os.replace(tmp, self._persist_path)
+        except OSError:
+            pass               # durability is best-effort, serving wins
 
     @property
     def tag(self) -> str:
@@ -89,6 +124,8 @@ class RolloutState:
             self._tag = new_tag
             self._epoch += 1
             epoch = self._epoch
+            self._persist_locked()      # durable BEFORE subscribers: a
+            #                             crash mid-bump rejoins rolled
             subs = list(self._subscribers)
             for fn in subs:
                 try:
@@ -106,15 +143,22 @@ class ReplicaInfo:
 
     peer_addr: (host, port) of its PeerCacheServer, None when the
         replica exposes no peer cache tier.
-    submit: transport for request forwarding — a callable taking a
-        FoldRequest and returning a FoldTicket (in-process: the peer
-        Scheduler.submit bound method; a networked deployment plugs an
-        RPC stub with the same signature). None = not forwardable.
+    transport: forwarding transport — an object with
+        `submit(request, trace=) -> FoldTicket` (fleet.rpc: a
+        `LocalTransport` for in-process wiring, an `HttpTransport`
+        speaking the FrontDoorServer protocol for a networked
+        deployment). None + submit=None = not forwardable.
+    submit: LEGACY transport — a bare callable taking a FoldRequest and
+        returning a FoldTicket. Kept so pre-transport callers (and
+        tests that stub `info.submit`) work unchanged; the router
+        wraps it in a LocalTransport at forward time. `transport` wins
+        when both are set.
     """
 
     replica_id: str
     peer_addr: Optional[Tuple[str, int]] = None
     submit: Optional[Callable[[Any], Any]] = None
+    transport: Optional[Any] = None
     marked_up: bool = True
     last_heartbeat_s: float = field(default=0.0)
 
@@ -132,14 +176,16 @@ class ReplicaRegistry:
     def __init__(self, heartbeat_timeout_s: Optional[float] = None,
                  model_tag: str = "",
                  clock: Callable[[], float] = time.monotonic,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 rollout_persist_path: Optional[str] = None):
         self._lock = threading.Lock()
         self._clock = clock
         self._members: Dict[str, ReplicaInfo] = {}
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.epoch = 0                 # membership epoch, lock-guarded
         reg = registry or get_registry()
-        self.rollout = RolloutState(model_tag, registry=reg)
+        self.rollout = RolloutState(model_tag, registry=reg,
+                                    persist_path=rollout_persist_path)
         self._m_healthy = reg.gauge(
             "fleet_replicas_healthy", "replicas currently routable")
         self._m_members = reg.gauge(
@@ -149,10 +195,11 @@ class ReplicaRegistry:
 
     def register(self, replica_id: str,
                  peer_addr: Optional[Tuple[str, int]] = None,
-                 submit: Optional[Callable] = None) -> ReplicaInfo:
+                 submit: Optional[Callable] = None,
+                 transport: Optional[Any] = None) -> ReplicaInfo:
         """Add (or re-announce) a member; bumps the membership epoch.
         A re-announce UPDATES the existing row: fields not provided
-        (peer_addr/submit left None) are preserved, as is an
+        (peer_addr/submit/transport left None) are preserved, as is an
         administrative down-mark — a periodic control-plane re-announce
         must neither strip a live member's forwarding transport nor
         resurrect a replica an operator pulled out."""
@@ -160,7 +207,7 @@ class ReplicaRegistry:
             info = self._members.get(replica_id)
             if info is None:
                 info = ReplicaInfo(replica_id, peer_addr=peer_addr,
-                                   submit=submit,
+                                   submit=submit, transport=transport,
                                    last_heartbeat_s=self._clock())
                 self._members[replica_id] = info
             else:
@@ -168,6 +215,8 @@ class ReplicaRegistry:
                     info.peer_addr = peer_addr
                 if submit is not None:
                     info.submit = submit
+                if transport is not None:
+                    info.transport = transport
                 info.last_heartbeat_s = self._clock()
             self.epoch += 1
         self._report_gauges()
@@ -246,7 +295,10 @@ class ReplicaRegistry:
                       "marked_up": info.marked_up,
                       "peer_addr": (list(info.peer_addr)
                                     if info.peer_addr else None),
-                      "forwardable": info.submit is not None}
+                      "forwardable": (info.transport is not None
+                                      or info.submit is not None),
+                      "transport": (None if info.transport is None
+                                    else type(info.transport).__name__)}
                 for rid, info in sorted(self._members.items())}
             return {"epoch": self.epoch,
                     "model_tag": tag,

@@ -37,7 +37,9 @@ from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
 from alphafold2_tpu.fleet.registry import ReplicaRegistry
+from alphafold2_tpu.fleet.rpc import transport_of
 from alphafold2_tpu.obs.registry import MetricsRegistry, get_registry
+from alphafold2_tpu.obs.trace import NULL_TRACE
 
 
 def _point(s: str) -> int:
@@ -140,7 +142,7 @@ class ConsistentHashRouter:
             decision = RouteDecision(owner, True, "local_owner")
         else:
             info = self.registry.get(owner)
-            if info is None or info.submit is None:
+            if transport_of(info) is None:
                 # owner routable for peer-cache purposes but exposes no
                 # forwarding transport: fold locally, its cache tier is
                 # still reachable through the peer client
@@ -151,14 +153,17 @@ class ConsistentHashRouter:
                            else "forward")
         return decision
 
-    def forward(self, owner_id: str, request):
-        """Hand `request` to its owner's scheduler; returns the remote
-        FoldTicket. Raises when the owner vanished or has no transport —
-        the caller (Scheduler) then falls back to folding locally."""
-        info = self.registry.get(owner_id)
-        if info is None or info.submit is None:
+    def forward(self, owner_id: str, request, trace=NULL_TRACE):
+        """Hand `request` to its owner through its transport
+        (fleet.rpc: LocalTransport in-process, HttpTransport across
+        machines); returns a FoldTicket resolving to the remote result.
+        Raises when the owner vanished, has no transport, or the
+        transport refuses at submit time — the caller (Scheduler) then
+        falls back to folding locally."""
+        transport = transport_of(self.registry.get(owner_id))
+        if transport is None:
             raise RuntimeError(f"replica {owner_id!r} not forwardable")
-        ticket = info.submit(request)
+        ticket = transport.submit(request, trace=trace)
         self._m_forwards.inc(peer=owner_id)
         return ticket
 

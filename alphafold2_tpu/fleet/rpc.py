@@ -1,0 +1,374 @@
+"""Forwarding transport: FoldTicket semantics over a process boundary.
+
+Until now request forwarding rode an in-process callable
+(`ReplicaInfo.submit` — the peer Scheduler's bound method), which means
+a replica could never actually crash, hang, or partition away from its
+peers. This module makes the transport an explicit seam:
+
+- `LocalTransport` wraps a bound `Scheduler.submit` and IS the old
+  behavior — same thread, same ticket object, zero copies. The
+  in-process harness (`fleet.InProcessFleet`) and every existing test
+  run through it unchanged.
+- `HttpTransport` speaks the `fleet.frontdoor.FrontDoorServer` protocol
+  (stdlib urllib, same trust model as the peer cache tier): submit is
+  one POST carrying the request as npz bytes plus QoS headers
+  (priority, deadline, forwarded, model tag); the result is long-polled
+  on a daemon thread and resolves the LOCAL FoldTicket, so callers
+  cannot tell a remote fold from a local one. Every transport-level
+  failure after a successful submit resolves the ticket as
+  `status="error"` with the `rpc_transport` marker — the scheduler's
+  forwarding path recognizes that marker and FAILS OVER to folding
+  locally (`fleet_failovers_total`) instead of surfacing a dead owner
+  to the caller. A submit-time failure raises instead, which the
+  scheduler already treats as "fold locally".
+
+Cancellation: `FoldTicket.result(timeout=)` on a forwarded ticket arms
+a timeout hook; on expiry the transport sends a best-effort
+POST /v1/cancel to the owner (counted in `fleet_remote_cancels_total`)
+so the remote side can drop the parked result instead of holding it
+until TTL.
+
+Wire format (the request/response analog of `cache.encode_fold`):
+one npz payload per direction, self-identifying, validated on decode —
+a corrupt or truncated body is a transport error, never a wrong fold.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import time
+from typing import Optional
+from urllib import error as urlerror
+from urllib import parse as urlparse
+from urllib import request as urlrequest
+
+import numpy as np
+
+from alphafold2_tpu.obs.registry import MetricsRegistry, get_registry
+from alphafold2_tpu.obs.trace import NULL_TRACE
+from alphafold2_tpu.serve.request import (FoldRequest, FoldResponse,
+                                          FoldTicket)
+
+# error marker the scheduler's failover path keys off: any forwarded
+# response whose error carries it means "the TRANSPORT died, not the
+# fold" — retry locally, the work is still viable
+RPC_TRANSPORT_MARKER = "rpc_transport"
+
+_HDR_REQUEST_ID = "X-Request-Id"
+_HDR_PRIORITY = "X-Priority"
+_HDR_DEADLINE = "X-Deadline-S"
+_HDR_FORWARDED = "X-Forwarded"
+_HDR_TAG = "X-Model-Tag"
+_HDR_STATUS = "X-Status"
+_HDR_SOURCE = "X-Source"
+_HDR_ATTEMPTS = "X-Attempts"
+_HDR_BUCKET = "X-Bucket-Len"
+_HDR_ERROR = "X-Error"
+
+
+# -- wire format ---------------------------------------------------------
+
+def encode_request(request: FoldRequest) -> bytes:
+    """One FoldRequest as npz bytes (seq + optional msa); QoS travels
+    in headers, content in the body — the body alone is content-
+    addressable the same way fold_key sees it."""
+    buf = io.BytesIO()
+    arrays = {"seq": np.asarray(request.seq, np.int32)}
+    if request.msa is not None:
+        arrays["msa"] = np.asarray(request.msa, np.int32)
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def request_headers(request: FoldRequest, tag: str = "") -> dict:
+    h = {_HDR_REQUEST_ID: request.request_id,
+         _HDR_PRIORITY: str(int(request.priority)),
+         _HDR_FORWARDED: "1" if request.forwarded else "0",
+         "Content-Type": "application/octet-stream"}
+    if request.deadline_s is not None:
+        h[_HDR_DEADLINE] = repr(float(request.deadline_s))
+    if tag:
+        h[_HDR_TAG] = tag
+    return h
+
+
+def decode_request(body: bytes, headers) -> FoldRequest:
+    """Parse + validate a submit body/headers into a FoldRequest.
+    Raises ValueError on anything wrong; the server turns that into a
+    400, never a fold of garbage."""
+    try:
+        with np.load(io.BytesIO(body)) as z:
+            seq = np.asarray(z["seq"], np.int32)
+            msa = (np.asarray(z["msa"], np.int32)
+                   if "msa" in z.files else None)
+    except Exception as exc:
+        raise ValueError(f"unreadable request body: {exc!r}")
+    deadline = headers.get(_HDR_DEADLINE)
+    kwargs = {}
+    rid = headers.get(_HDR_REQUEST_ID)
+    if rid:
+        kwargs["request_id"] = rid
+    return FoldRequest(
+        seq=seq, msa=msa,
+        priority=int(headers.get(_HDR_PRIORITY, "0") or 0),
+        deadline_s=None if deadline is None else float(deadline),
+        forwarded=headers.get(_HDR_FORWARDED, "0") == "1",
+        **kwargs)
+
+
+def encode_response(response: FoldResponse) -> tuple:
+    """(body_bytes, headers) for one terminal FoldResponse. Arrays in
+    the npz body, everything else in headers — a non-ok response is an
+    empty npz plus headers."""
+    buf = io.BytesIO()
+    arrays = {}
+    if response.coords is not None:
+        arrays["coords"] = np.asarray(response.coords, np.float32)
+    if response.confidence is not None:
+        arrays["confidence"] = np.asarray(response.confidence, np.float32)
+    np.savez(buf, **arrays) if arrays else np.savez(
+        buf, empty=np.zeros(0, np.float32))
+    headers = {_HDR_REQUEST_ID: response.request_id,
+               _HDR_STATUS: response.status,
+               _HDR_SOURCE: response.source,
+               _HDR_ATTEMPTS: str(int(response.attempts)),
+               "Content-Type": "application/octet-stream"}
+    if response.bucket_len is not None:
+        headers[_HDR_BUCKET] = str(int(response.bucket_len))
+    if response.error:
+        # headers must be latin-1-safe single-line; errors are ours
+        headers[_HDR_ERROR] = str(response.error)[:512].replace(
+            "\n", " ").encode("ascii", "replace").decode("ascii")
+    return buf.getvalue(), headers
+
+
+def decode_response(body: bytes, headers) -> FoldResponse:
+    """Parse a result body/headers back into a FoldResponse. Raises
+    ValueError on malformed payloads (a transport error, not a result)."""
+    status = headers.get(_HDR_STATUS)
+    if not status:
+        raise ValueError("result missing X-Status header")
+    coords = confidence = None
+    try:
+        with np.load(io.BytesIO(body)) as z:
+            if "coords" in z.files:
+                coords = np.asarray(z["coords"], np.float32)
+            if "confidence" in z.files:
+                confidence = np.asarray(z["confidence"], np.float32)
+    except Exception as exc:
+        raise ValueError(f"unreadable result body: {exc!r}")
+    if status == "ok" and (coords is None or confidence is None
+                           or coords.ndim != 2 or coords.shape[1] != 3
+                           or confidence.shape != (coords.shape[0],)):
+        raise ValueError("ok result fails shape validation")
+    bucket = headers.get(_HDR_BUCKET)
+    return FoldResponse(
+        request_id=headers.get(_HDR_REQUEST_ID, "?"),
+        status=status, coords=coords, confidence=confidence,
+        bucket_len=None if bucket is None else int(bucket),
+        error=headers.get(_HDR_ERROR) or None,
+        source=headers.get(_HDR_SOURCE, "fold"),
+        attempts=int(headers.get(_HDR_ATTEMPTS, "1") or 1))
+
+
+# -- transports ----------------------------------------------------------
+
+class LocalTransport:
+    """The in-process transport: today's behavior behind the new seam.
+
+    Wraps a bound `Scheduler.submit` (or any callable with that
+    signature); `submit()` returns the peer scheduler's OWN ticket, so
+    coalescing, tracing, and settlement semantics are byte-for-byte
+    what `ReplicaInfo.submit` gave the router before transports
+    existed."""
+
+    def __init__(self, submit):
+        self._submit = submit
+
+    def submit(self, request: FoldRequest, trace=NULL_TRACE) -> FoldTicket:
+        return self._submit(request)
+
+    def healthz(self) -> Optional[dict]:
+        return None              # in-process: the registry IS the truth
+
+
+class HttpTransport:
+    """Forwarding client for one replica's `FrontDoorServer`.
+
+    submit() POSTs the request and returns a LOCAL FoldTicket that a
+    daemon poll thread resolves from the owner's long-poll result
+    endpoint. Failure contract:
+
+    - submit-time transport trouble RAISES (the scheduler's existing
+      forward-error fallback folds locally — nothing was accepted);
+    - post-submit transport trouble (owner died mid-fold, partition,
+      poll exhausted) resolves the ticket `status="error"` with the
+      `rpc_transport` marker — the scheduler's failover path re-folds
+      locally and counts `fleet_failovers_total`;
+    - a terminal result resolves the ticket verbatim (status, source,
+      attempts, error all travel).
+
+    poll_wait_s is the server-side long-poll window per request;
+    poll_budget_s bounds the total wait before the transport gives up
+    and error-resolves with the transport marker (a hung owner must
+    not hold forwarded tickets forever — the owner's own watchdog and
+    deadline machinery should terminate folds long before this fires).
+
+    One daemon poll thread (and one connection per poll round — the
+    server speaks HTTP/1.0) per forwarded request is deliberate, the
+    same call the peer cache tier makes: folds are seconds-granular
+    and in-flight forwards are bounded by the sender's queue_limit, so
+    thread/connect cost is noise next to one fold — and a shared
+    multiplexing poller would be wedged by exactly the hung-peer case
+    this transport exists to survive. Revisit only if forwarding ever
+    carries sub-100ms work.
+    """
+
+    def __init__(self, base_url: str, timeout_s: float = 5.0,
+                 poll_wait_s: float = 10.0, poll_budget_s: float = 600.0,
+                 rollout=None,
+                 metrics: Optional[MetricsRegistry] = None):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+        self.poll_wait_s = float(poll_wait_s)
+        self.poll_budget_s = float(poll_budget_s)
+        self.rollout = rollout       # optional RolloutState: stamps tag
+        reg = metrics or get_registry()
+        self._m_rpc = reg.counter(
+            "fleet_rpc_requests_total",
+            "front-door RPCs by route and outcome (client side)",
+            ("route", "outcome"))
+        self._m_cancels = reg.counter(
+            "fleet_remote_cancels_total",
+            "best-effort cancels sent for timed-out forwarded tickets")
+        self.cancels = 0
+
+    # -- plumbing --------------------------------------------------------
+
+    def _tag(self) -> str:
+        return self.rollout.tag if self.rollout is not None else ""
+
+    def _post(self, path: str, body: bytes, headers: dict,
+              timeout: Optional[float] = None):
+        req = urlrequest.Request(self.base_url + path, data=body,
+                                 headers=headers, method="POST")
+        return urlrequest.urlopen(req, timeout=timeout or self.timeout_s)
+
+    # -- protocol --------------------------------------------------------
+
+    def submit(self, request: FoldRequest, trace=NULL_TRACE) -> FoldTicket:
+        """One forwarding hop. Raises on submit-time transport failure
+        (caller folds locally); otherwise returns a ticket the poll
+        thread resolves."""
+        body = encode_request(request)
+        headers = request_headers(request, tag=self._tag())
+        try:
+            with trace.span("rpc", peer=self.base_url, route="submit"):
+                with self._post("/v1/submit", body, headers) as resp:
+                    payload = json.loads(resp.read().decode("utf-8"))
+            remote_ticket = payload["ticket"]
+        except Exception:
+            self._m_rpc.inc(route="submit", outcome="error")
+            raise
+        self._m_rpc.inc(route="submit", outcome="ok")
+        ticket = FoldTicket(request.request_id)
+        # result(timeout=) expiry on the caller's side sends the owner a
+        # best-effort cancel so the parked result is dropped, not leaked
+        ticket._timeout_callback = lambda: self.cancel(remote_ticket)
+        threading.Thread(
+            target=self._poll, args=(remote_ticket, request, ticket),
+            name=f"rpc-poll-{request.request_id}", daemon=True).start()
+        return ticket
+
+    def _transport_error(self, request: FoldRequest, detail: str
+                         ) -> FoldResponse:
+        return FoldResponse(
+            request_id=request.request_id, status="error",
+            error=f"{RPC_TRANSPORT_MARKER}: {detail}")
+
+    def _poll(self, remote_ticket: str, request: FoldRequest,
+              ticket: FoldTicket):
+        """Long-poll the owner until terminal; resolve the local ticket
+        exactly once, with the transport marker on any failure."""
+        deadline = time.monotonic() + self.poll_budget_s
+        misses = 0
+        while time.monotonic() < deadline:
+            if ticket.done():
+                return               # cancelled locally meanwhile
+            url = (f"{self.base_url}/v1/result/"
+                   f"{urlparse.quote(remote_ticket, safe='')}"
+                   f"?wait_s={self.poll_wait_s}")
+            try:
+                with urlrequest.urlopen(
+                        url,
+                        timeout=self.poll_wait_s + self.timeout_s) as resp:
+                    if resp.status == 204:
+                        misses += 1
+                        continue     # still folding; poll again
+                    body = resp.read()
+                    response = decode_response(body, resp.headers)
+            except urlerror.HTTPError as exc:
+                outcome = ("unknown_ticket" if exc.code == 404
+                           else "error")
+                self._m_rpc.inc(route="result", outcome=outcome)
+                # 404 = the owner restarted and forgot the ticket; both
+                # cases mean the transport lost the fold, not the fold
+                # failed — failover-eligible
+                ticket._resolve(self._transport_error(
+                    request, f"result fetch failed: HTTP {exc.code}"))
+                return
+            except Exception as exc:
+                self._m_rpc.inc(route="result", outcome="error")
+                ticket._resolve(self._transport_error(
+                    request, f"result fetch failed: {exc!r}"))
+                return
+            self._m_rpc.inc(route="result", outcome="ok")
+            ticket._resolve(response)
+            return
+        self._m_rpc.inc(route="result", outcome="poll_exhausted")
+        self.cancel(remote_ticket)
+        ticket._resolve(self._transport_error(
+            request, f"poll budget {self.poll_budget_s}s exhausted "
+                     f"after {misses} empty polls"))
+
+    def cancel(self, remote_ticket: str) -> bool:
+        """Best-effort: tell the owner to drop the parked result."""
+        try:
+            path = ("/v1/cancel/"
+                    + urlparse.quote(remote_ticket, safe=""))
+            with self._post(path, b"", {}) as resp:
+                ok = resp.status == 200
+        except Exception:
+            ok = False
+        self.cancels += 1
+        self._m_cancels.inc()
+        self._m_rpc.inc(route="cancel", outcome="ok" if ok else "error")
+        return ok
+
+    def healthz(self) -> Optional[dict]:
+        """The owner's /healthz payload, or None when unreachable."""
+        try:
+            with urlrequest.urlopen(self.base_url + "/healthz",
+                                    timeout=self.timeout_s) as resp:
+                if resp.status != 200:
+                    return None
+                return json.loads(resp.read().decode("utf-8"))
+        except Exception:
+            return None
+
+
+def transport_of(info) -> Optional[object]:
+    """The forwarding transport for one `ReplicaInfo`: the explicit
+    `transport` when set, else the legacy `submit` callable wrapped in
+    a LocalTransport (so pre-transport callers and tests that assign
+    `info.submit` keep exactly their old semantics), else None."""
+    if info is None:
+        return None
+    tr = getattr(info, "transport", None)
+    if tr is not None:
+        return tr
+    if info.submit is not None:
+        return LocalTransport(info.submit)
+    return None

@@ -53,5 +53,6 @@ from alphafold2_tpu.serve.resilience import (CircuitBreaker,  # noqa: F401
                                              Quarantine, RetryPolicy,
                                              TransientExecutorError,
                                              WatchdogTimeout)
-from alphafold2_tpu.serve.scheduler import (QueueFullError, Scheduler,  # noqa: F401
+from alphafold2_tpu.serve.scheduler import (DrainingError,  # noqa: F401
+                                            QueueFullError, Scheduler,
                                             SchedulerConfig)

@@ -115,6 +115,12 @@ class FoldTicket:
         self._response: Optional[FoldResponse] = None
         self._lock = threading.Lock()
         self._callbacks: list = []
+        # optional hook fired (best-effort, once per expiry) when
+        # result(timeout=) gives up on this ticket — fleet transports
+        # use it to send the remote owner a cancel so a caller that
+        # stopped waiting does not leave a parked result behind
+        # (fleet.rpc.HttpTransport; counted fleet_remote_cancels_total)
+        self._timeout_callback = None
 
     def _resolve(self, response: FoldResponse):
         self._response = response
@@ -151,6 +157,12 @@ class FoldTicket:
 
     def result(self, timeout: Optional[float] = None) -> FoldResponse:
         if not self._event.wait(timeout):
+            cb = self._timeout_callback
+            if cb is not None:
+                try:
+                    cb()
+                except Exception:
+                    pass    # cancel is advisory; the timeout still raises
             raise TimeoutError(
                 f"FoldTicket.result timed out for {self.request_id}")
         assert self._response is not None

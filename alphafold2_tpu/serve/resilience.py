@@ -38,6 +38,8 @@ settlement). All of it is OFF by default — a `Scheduler` built without
 
 from __future__ import annotations
 
+import json
+import os
 import random
 import threading
 import time
@@ -186,15 +188,60 @@ class Quarantine:
     accumulating path (non-finite outputs count toward poisoning);
     `add()` quarantines unconditionally (a deterministic batch-of-one
     failure IS the proof).
+
+    `path` makes the set durable: every quarantined key appends one
+    JSONL line ({"key", "reason"}) and construction replays the file,
+    so a RESTARTED replica fails known poison fast instead of re-paying
+    the isolation executions — the bisection proof survives the
+    process. Append-only by design (quarantine has no remove path);
+    strikes are deliberately NOT persisted — a sub-threshold NaN count
+    is suspicion, not proof, and suspicion resets with the process.
+    File trouble of any kind degrades to an in-memory-only set: the
+    failure domain must never take down serving over a disk error.
     """
 
-    def __init__(self, registry: Optional[MetricsRegistry] = None):
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 path: Optional[str] = None):
         self._lock = threading.Lock()
         self._keys: dict = {}            # key -> reason
         self._strikes: dict = {}
+        self._path = path
         self._m_quarantined = (registry or get_registry()).counter(
             "serve_poison_quarantined_total",
             "fold keys quarantined as poison inputs")
+        self.loaded = 0                  # keys replayed from disk
+        if path:
+            try:
+                with open(path) as fh:
+                    for line in fh:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                            self._keys[rec["key"]] = rec.get(
+                                "reason", "poison_input")
+                        except Exception:
+                            continue     # torn tail line: skip, keep rest
+                self.loaded = len(self._keys)
+            except OSError:
+                pass                     # no file yet / unreadable: empty
+
+    def _persist(self, key: str, reason: str):
+        """Caller does NOT hold the lock (file I/O off the hot section);
+        append-only JSONL, one fsync-free line per quarantined key —
+        a torn tail line is skipped at load, losing at most the last
+        quarantine, which the next failure re-proves."""
+        if not self._path:
+            return
+        try:
+            d = os.path.dirname(os.path.abspath(self._path))
+            os.makedirs(d, exist_ok=True)
+            with open(self._path, "a") as fh:
+                fh.write(json.dumps({"key": key, "reason": reason})
+                         + "\n")
+        except OSError:
+            pass
 
     def add(self, key: str, reason: str = "poison_input") -> bool:
         """Quarantine `key`; True when newly added."""
@@ -204,6 +251,7 @@ class Quarantine:
             self._keys[key] = reason
             self._strikes.pop(key, None)
         self._m_quarantined.inc()
+        self._persist(key, reason)
         return True
 
     def strike(self, key: str, threshold: int,
@@ -220,6 +268,7 @@ class Quarantine:
             self._keys[key] = reason
             self._strikes.pop(key, None)
         self._m_quarantined.inc()
+        self._persist(key, reason)
         return True
 
     def reason(self, key: str) -> Optional[str]:
@@ -237,7 +286,9 @@ class Quarantine:
     def snapshot(self) -> dict:
         with self._lock:
             return {"quarantined": len(self._keys),
-                    "striked": len(self._strikes)}
+                    "striked": len(self._strikes),
+                    "loaded_from_disk": self.loaded,
+                    "persisted": self._path is not None}
 
 
 class CircuitBreaker:

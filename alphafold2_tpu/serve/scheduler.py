@@ -111,6 +111,14 @@ class QueueFullError(RuntimeError):
     """submit() refused: queue at queue_limit and full_policy='reject'."""
 
 
+class DrainingError(QueueFullError):
+    """submit() refused: the scheduler is draining (graceful shutdown —
+    in-flight work finishes, new work must go to another replica).
+    Subclasses QueueFullError so callers that already handle the
+    rejected-at-the-door case treat a draining replica the same way:
+    retry elsewhere, nothing was lost."""
+
+
 @dataclass
 class SchedulerConfig:
     max_batch_size: int = 4
@@ -212,6 +220,12 @@ class Scheduler:
         after a watchdog fire; None falls back to `executor.rebuild()`
         when the executor provides it (FoldExecutor does), else the
         hung executor is kept (better a slow server than none).
+    quarantine_path: optional JSONL file persisting the poison
+        quarantine across restarts (only meaningful with `retry=`):
+        keys quarantined in a previous process fail fast as
+        "poisoned" from the first submit — a restarted replica never
+        re-pays the bisection executions for a known poison. Put it
+        next to the cache dir; the keys are the same content digests.
     """
 
     def __init__(self, executor: FoldExecutor, buckets: BucketPolicy,
@@ -223,7 +237,8 @@ class Scheduler:
                  registry: Optional[MetricsRegistry] = None,
                  router=None,
                  retry: Optional[RetryPolicy] = None,
-                 executor_factory: Optional[Callable[[], object]] = None):
+                 executor_factory: Optional[Callable[[], object]] = None,
+                 quarantine_path: Optional[str] = None):
         self.executor = executor
         self.buckets = buckets
         self.config = config or SchedulerConfig()
@@ -247,8 +262,11 @@ class Scheduler:
         self._n_watchdog_fires = 0
         self._n_rebuilds = 0
         self._n_nonfinite = 0
+        self._n_failovers = 0
+        self._n_drains = 0
         if retry is not None:
-            self._quarantine = Quarantine(registry=registry)
+            self._quarantine = Quarantine(registry=registry,
+                                          path=quarantine_path)
             # worker-owned jitter stream: a RetryPolicy shared across
             # schedulers must not race N workers on one RNG. Callers
             # that fan one policy out across replicas give each copy
@@ -277,6 +295,12 @@ class Scheduler:
             self._c_nonfinite = reg.counter(
                 "serve_nonfinite_outputs_total",
                 "fold outputs rejected by non-finite validation")
+        self._c_drains = reg.counter(
+            "serve_drains_total", "graceful drains started")
+        self._c_failovers = reg.counter(
+            "fleet_failovers_total",
+            "forwarded tickets whose owner's transport died, "
+            "re-folded locally")
         self._inflight = InflightRegistry(registry=registry)
         self._cond = threading.Condition()
         self._incoming: deque = deque()
@@ -284,6 +308,8 @@ class Scheduler:
         self._depth = 0            # incoming + pending, guarded by _cond
         self._running = False
         self._drain = True
+        self._draining = False     # graceful drain: admitting stopped
+        self._outstanding_forwards = 0   # guarded by _cond
         self._worker: Optional[threading.Thread] = None
 
     # -- lifecycle -------------------------------------------------------
@@ -294,6 +320,7 @@ class Scheduler:
                 return self
             self._running = True
             self._drain = True
+            self._draining = False
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="serve-scheduler")
         self._worker.start()
@@ -310,6 +337,66 @@ class Scheduler:
         if self._worker is not None:
             self._worker.join()
             self._worker = None
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful drain — THE process-level shutdown path (wire it to
+        SIGTERM): stop admitting (new submits raise DrainingError — a
+        fleet front door maps that to 503 so callers retry elsewhere),
+        wait for outstanding FORWARDED tickets to resolve or fail over
+        (bounded by timeout_s; the transport's own poll budget
+        guarantees they terminate), then fold everything queued
+        (expired deadlines still shed) and fan terminal states out to
+        parked followers via the normal settlement machinery. Every
+        entry pending at drain start carries a `drain` span from drain
+        start to its terminal state, so the waterfall prices what a
+        rolling restart costs requests. Returns True when the drain
+        fully completed (False = the forwarded-ticket wait timed out;
+        local work still resolved). Idempotent; safe from a signal-
+        handler-fed thread."""
+        with self._cond:
+            if not self._running and not self._draining:
+                return True            # never started / already stopped
+            first = not self._draining
+            self._draining = True
+            if first:
+                for e in itertools.chain(self._incoming,
+                                         *self._pending.values()):
+                    e.trace.begin("drain")
+                # wake submitters blocked on a full queue NOW: they
+                # must raise DrainingError immediately, not wait out
+                # the forwarded-ticket grace below
+                self._cond.notify_all()
+        if first:
+            self._n_drains += 1
+            self._c_drains.inc()
+        complete = True
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while self._outstanding_forwards > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    complete = False
+                    break
+                self._cond.wait(timeout=remaining)
+        self.stop(drain=True)
+        return complete
+
+    def health(self) -> dict:
+        """The one health payload every probe shares (the front door's
+        /healthz, the peer cache server's, the router's health walk):
+        liveness, drain state, queue depth, breaker state. A replica
+        with `breaker == "open"` is up but NOT serving novel folds —
+        recovery probes must treat it as still-down."""
+        with self._cond:
+            depth = self._depth
+            running = self._running
+            draining = self._draining
+        return {"running": running,
+                "draining": draining,
+                "queue_depth": depth,
+                "breaker": (None if self._breaker is None
+                            else self._breaker.state),
+                "model_tag": self.model_tag}
 
     def __enter__(self) -> "Scheduler":
         return self.start()
@@ -347,6 +434,13 @@ class Scheduler:
         entry = _Entry(request, bucket_len)
         entry.trace = self.tracer.start_trace(request.request_id)
         entry.trace.begin("submit")
+        # draining beats everything, cache hits included: a replica
+        # being rolled must shrink to empty, and its caller must take
+        # the work to a peer that will still be alive to serve it
+        if self._draining:
+            entry.trace.finish("rejected", error="draining")
+            raise DrainingError(
+                "Scheduler draining: not admitting new requests")
         # quarantined poison fails fast BEFORE cache/coalesce/forward:
         # a known-bad key must not re-fold, park followers, or burn a
         # forwarding hop
@@ -394,6 +488,10 @@ class Scheduler:
                     if not self._running:
                         raise RuntimeError("Scheduler stopped while "
                                            "blocked on a full queue")
+                    if self._draining:
+                        raise DrainingError(
+                            "Scheduler started draining while blocked "
+                            "on a full queue")
                 entry.mark_enqueued()
                 entry.trace.end("submit")
                 entry.trace.begin("queue")
@@ -599,7 +697,8 @@ class Scheduler:
         entry.trace.begin("forward")
         try:
             remote = self.router.forward(
-                owner, dataclasses.replace(entry.request, forwarded=True))
+                owner, dataclasses.replace(entry.request, forwarded=True),
+                trace=entry.trace)
         except Exception:
             # owner vanished / transport error / remote backpressure:
             # local fallback (the fold is still correct, just not
@@ -608,46 +707,98 @@ class Scheduler:
             entry.trace.end("forward", failed=True)
             return False
         entry.trace.end("submit")
+        with self._cond:
+            # drain() waits on this: a forwarded ticket is in-flight
+            # work this replica still owes its caller a terminal for
+            self._outstanding_forwards += 1
 
         def _on_remote(resp: FoldResponse):
-            now = time.monotonic()
-            entry.trace.end("forward", owner=owner)
             try:
-                local = FoldResponse(
-                    request_id=entry.request.request_id,
-                    status=resp.status,
-                    coords=(None if resp.coords is None
-                            else np.array(resp.coords, np.float32,
-                                          copy=True)),
-                    confidence=(None if resp.confidence is None
-                                else np.array(resp.confidence, np.float32,
-                                              copy=True)),
-                    bucket_len=(resp.bucket_len
-                                if resp.bucket_len is not None
-                                else entry.bucket_len),
-                    latency_s=now - entry.enqueued_at,
-                    # "forwarded", not the remote's source: THIS replica
-                    # did not fold it, and the trace checker's
-                    # fold-span-required rule keys off source == "fold"
-                    error=resp.error, source="forwarded",
-                    # the owner's retry/bisection cost travels with the
-                    # result (getattr: a pre-resilience peer's response
-                    # has no attempts field)
-                    attempts=getattr(resp, "attempts", 1))
-            except Exception as exc:   # e.g. MemoryError on the copies
-                local = FoldResponse(
-                    request_id=entry.request.request_id, status="error",
-                    bucket_len=entry.bucket_len,
-                    error=f"forwarded response adaptation failed: "
-                          f"{exc!r}")
-            try:
-                # populates the local store (repeat traffic for this key
-                # becomes a local hit) and settles local followers
-                self._resolve_entry(entry, local)
-            except Exception:
-                entry.resolve(local)   # never orphan the caller's ticket
+                self._handle_remote(entry, owner, resp)
+            finally:
+                with self._cond:
+                    self._outstanding_forwards -= 1
+                    self._cond.notify_all()
 
         remote.add_done_callback(_on_remote)
+        return True
+
+    def _handle_remote(self, entry: _Entry, owner: str,
+                       resp: FoldResponse):
+        """Terminal handling for one forwarded ticket: adapt the remote
+        response onto the local entry — or, when the response carries
+        the transport-failure marker (the owner died, partitioned, or
+        restarted mid-fold; fleet.rpc.HttpTransport stamps it), FAIL
+        OVER to folding locally: the work is still viable, only the
+        owner is gone, and the caller must never pay for fleet
+        topology with an error."""
+        now = time.monotonic()
+        entry.trace.end("forward", owner=owner)
+        # the marker string is fleet.rpc.RPC_TRANSPORT_MARKER; spelled
+        # literally here because serve must not import fleet (fleet
+        # already imports serve)
+        if (resp is not None and resp.status == "error" and resp.error
+                and "rpc_transport" in resp.error
+                and self._failover_local(entry, owner)):
+            return
+        try:
+            local = FoldResponse(
+                request_id=entry.request.request_id,
+                status=resp.status,
+                coords=(None if resp.coords is None
+                        else np.array(resp.coords, np.float32,
+                                      copy=True)),
+                confidence=(None if resp.confidence is None
+                            else np.array(resp.confidence, np.float32,
+                                          copy=True)),
+                bucket_len=(resp.bucket_len
+                            if resp.bucket_len is not None
+                            else entry.bucket_len),
+                latency_s=now - entry.enqueued_at,
+                # "forwarded", not the remote's source: THIS replica
+                # did not fold it, and the trace checker's
+                # fold-span-required rule keys off source == "fold"
+                error=resp.error, source="forwarded",
+                # the owner's retry/bisection cost travels with the
+                # result (getattr: a pre-resilience peer's response
+                # has no attempts field)
+                attempts=getattr(resp, "attempts", 1))
+        except Exception as exc:   # e.g. MemoryError on the copies
+            local = FoldResponse(
+                request_id=entry.request.request_id, status="error",
+                bucket_len=entry.bucket_len,
+                error=f"forwarded response adaptation failed: "
+                      f"{exc!r}")
+        try:
+            # populates the local store (repeat traffic for this key
+            # becomes a local hit) and settles local followers
+            self._resolve_entry(entry, local)
+        except Exception:
+            entry.resolve(local)   # never orphan the caller's ticket
+
+    def _failover_local(self, entry: _Entry, owner: str) -> bool:
+        """Re-enqueue a transport-failed forwarded entry for a LOCAL
+        fold. False when the scheduler can no longer fold (stopped) —
+        the caller then resolves the transport error as terminal. The
+        entry skips the submit fast paths (cache/route already ran) and
+        keeps its original deadline clock: the time lost to the dead
+        owner counts against the request, exactly like a retry."""
+        with self._cond:
+            if not self._running:
+                return False
+            entry.trace.event("failover_local", owner=owner)
+            entry.trace.begin("queue")
+            self._incoming.append(entry)
+            self._depth += 1
+            depth = self._depth
+            self._cond.notify_all()
+        self._n_failovers += 1
+        self._c_failovers.inc()
+        try:
+            self.router.note_fallback("remote_failover")
+        except Exception:
+            pass
+        self.metrics.record_enqueued(depth)
         return True
 
     def _promote_follower(self, entry: _Entry) -> bool:
@@ -778,6 +929,10 @@ class Scheduler:
             }
         with self._cond:
             stats["running"] = self._running
+            stats["draining"] = self._draining
+            stats["outstanding_forwards"] = self._outstanding_forwards
+        stats["failovers"] = self._n_failovers
+        stats["drains"] = self._n_drains
         return stats
 
     # -- worker ----------------------------------------------------------

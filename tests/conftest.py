@@ -65,6 +65,12 @@ def pytest_configure(config):
         "markers",
         "quick: fast smoke tier (one representative test per subsystem, "
         "~4-5 min on 1 CPU core): python -m pytest -m quick")
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight tier excluded from tier-1 (-m 'not slow'): "
+        "multi-process fleets, real kill/partition chaos "
+        "(tests/test_frontdoor.py's procfleet class, serve_smoke.sh "
+        "phase 6 in miniature)")
 
 
 @pytest.fixture(autouse=True, scope="module")

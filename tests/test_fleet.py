@@ -466,10 +466,11 @@ class TestTwoReplicaFleet:
             req = next(r for r in (_request(seed=s) for s in range(50))
                        if router.owner_for(_key_for(fl, r)) == "r1")
 
-            def _broken(request):
-                raise ConnectionError("transport down")
+            class _Broken:
+                def submit(self, request, trace=None):
+                    raise ConnectionError("transport down")
 
-            fl.registry.get("r1").submit = _broken
+            fl.registry.get("r1").transport = _Broken()
             resp = fl.submit(req, replica=0).result(timeout=120)
             assert resp.ok and resp.source == "fold"
 

@@ -1,0 +1,694 @@
+"""Networked fleet front door tests (ISSUE 6): the rpc wire format,
+LocalTransport/HttpTransport semantics, the FrontDoorServer protocol
+(submit -> long-poll -> terminal, 409/429/503, cancel), graceful drain,
+crash-recovery persistence (quarantine JSONL + rollout epoch), the
+unified health payload + breaker-aware recovery probe, scheduler-level
+failover on transport death, and — `slow`-marked, excluded from
+tier-1 — a real multi-process fleet surviving kill -9 and drain.
+
+The fast tier is stub-executor + localhost HTTP, no model; only the
+procfleet class spawns real replica processes (each imports jax and
+compiles, seconds-to-minutes scale — serve_smoke.sh phase 6 is the
+full version of that story).
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from alphafold2_tpu import fleet
+from alphafold2_tpu.cache import FoldCache, fold_key
+from alphafold2_tpu.fleet.frontdoor import FrontDoorServer
+from alphafold2_tpu.fleet.rpc import (HttpTransport, LocalTransport,
+                                      RPC_TRANSPORT_MARKER,
+                                      decode_request, decode_response,
+                                      encode_request, encode_response,
+                                      request_headers)
+from alphafold2_tpu.obs.registry import MetricsRegistry
+from alphafold2_tpu.serve import (BucketPolicy, DrainingError,
+                                  FoldRequest, FoldResponse, FoldTicket,
+                                  RetryPolicy, Scheduler,
+                                  SchedulerConfig)
+from alphafold2_tpu.serve.resilience import Quarantine
+
+MSA_DEPTH = 3
+
+
+class _OkExecutor:
+    """Stub executor: deterministic coords, optional pre-run delay."""
+
+    def __init__(self, delay_s=0.0):
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def run(self, batch, num_recycles, trace=None):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        self.calls += 1
+        b, n = batch["seq"].shape
+
+        class R:
+            coords = np.zeros((b, n, 3), np.float32)
+            confidence = np.full((b, n), 0.5, np.float32)
+
+        return R()
+
+    def stats(self):
+        return {"calls": self.calls}
+
+
+class _PoisonExecutor(_OkExecutor):
+    """Deterministic failure on every run — the bisection/quarantine
+    path without a model."""
+
+    def run(self, batch, num_recycles, trace=None):
+        self.calls += 1
+        raise ValueError("degenerate input wrecks the structure module")
+
+
+def _request(seed=0, n=12, **kwargs):
+    rng = np.random.default_rng(seed)
+    return FoldRequest(
+        seq=rng.integers(0, 20, size=n).astype(np.int32),
+        msa=rng.integers(0, 20, size=(MSA_DEPTH, n)).astype(np.int32),
+        **kwargs)
+
+
+def _scheduler(executor=None, msa_depth=MSA_DEPTH, model_tag="fd",
+               **kwargs):
+    policy = BucketPolicy((16,))
+    config = SchedulerConfig(max_batch_size=2, max_wait_ms=10.0,
+                             poll_ms=2.0, msa_depth=msa_depth)
+    return Scheduler(executor or _OkExecutor(), policy, config,
+                     model_tag=model_tag,
+                     registry=MetricsRegistry(), **kwargs)
+
+
+# -- wire format ---------------------------------------------------------
+
+@pytest.mark.quick
+class TestWireFormat:
+    def test_request_roundtrip(self):
+        req = _request(seed=3, priority=2, deadline_s=1.5,
+                       forwarded=True)
+        body = encode_request(req)
+        got = decode_request(body, request_headers(req, tag="v1"))
+        assert np.array_equal(got.seq, req.seq)
+        assert np.array_equal(got.msa, req.msa)
+        assert got.priority == 2 and got.deadline_s == 1.5
+        assert got.forwarded and got.request_id == req.request_id
+
+    def test_request_without_msa_or_deadline(self):
+        req = FoldRequest(seq=np.arange(8, dtype=np.int32))
+        got = decode_request(encode_request(req), request_headers(req))
+        assert got.msa is None and got.deadline_s is None
+        assert not got.forwarded
+
+    def test_garbage_request_raises(self):
+        with pytest.raises(ValueError):
+            decode_request(b"not an npz", {})
+
+    def test_response_roundtrip_ok_and_error(self):
+        ok = FoldResponse(request_id="r1", status="ok",
+                          coords=np.ones((5, 3), np.float32),
+                          confidence=np.full((5,), 0.5, np.float32),
+                          bucket_len=16, source="cache", attempts=3)
+        body, headers = encode_response(ok)
+        got = decode_response(body, headers)
+        assert got.ok and got.source == "cache" and got.attempts == 3
+        assert got.bucket_len == 16
+        assert np.allclose(got.coords, ok.coords)
+
+        err = FoldResponse(request_id="r2", status="poisoned",
+                           error="bad\nnews")
+        body, headers = encode_response(err)
+        got = decode_response(body, headers)
+        assert got.status == "poisoned" and "bad news" in got.error
+        assert got.coords is None
+
+    def test_ok_response_without_arrays_fails_validation(self):
+        body, headers = encode_response(
+            FoldResponse(request_id="r", status="error", error="x"))
+        headers["X-Status"] = "ok"       # forged: ok needs arrays
+        with pytest.raises(ValueError):
+            decode_response(body, headers)
+
+
+# -- persistence: quarantine + rollout -----------------------------------
+
+@pytest.mark.quick
+class TestQuarantinePersistence:
+    def test_jsonl_roundtrip_and_strike(self, tmp_path):
+        path = str(tmp_path / "q.jsonl")
+        q1 = Quarantine(registry=MetricsRegistry(), path=path)
+        assert q1.add("k1", reason="poison_input")
+        assert not q1.strike("k2", threshold=2)   # sub-threshold
+        assert q1.strike("k2", threshold=2)       # quarantined now
+        q2 = Quarantine(registry=MetricsRegistry(), path=path)
+        assert "k1" in q2 and "k2" in q2
+        assert q2.loaded == 2
+        assert q2.reason("k1") == "poison_input"
+        # strikes are NOT persisted: suspicion resets with the process
+        q3 = Quarantine(registry=MetricsRegistry(), path=path)
+        assert not q3.strike("k3", threshold=2)
+
+    def test_restarted_scheduler_fails_poison_fast(self, tmp_path):
+        """THE crash-recovery regression: quarantine -> restart ->
+        duplicate fails fast as "poisoned" with zero executor calls."""
+        path = str(tmp_path / "quarantine.jsonl")
+        req = _request(seed=7)
+        retry = RetryPolicy(max_attempts=2, backoff_base_s=0.001,
+                            backoff_max_s=0.01)
+        sched1 = _scheduler(_PoisonExecutor(), model_tag="qtest",
+                            retry=retry, quarantine_path=path)
+        with sched1:
+            resp = sched1.submit(req).result(timeout=30)
+        assert resp.status == "poisoned"
+        assert os.path.exists(path)
+
+        # "restart": a fresh scheduler process state, same disk
+        counting = _OkExecutor()
+        sched2 = _scheduler(counting, model_tag="qtest", retry=retry,
+                            quarantine_path=path)
+        assert sched2._quarantine.loaded == 1
+        with sched2:
+            dup = FoldRequest(seq=req.seq, msa=req.msa)
+            resp2 = sched2.submit(dup).result(timeout=30)
+        assert resp2.status == "poisoned"
+        assert counting.calls == 0       # never re-folded, never re-bisected
+
+    def test_unreadable_path_degrades_to_memory_only(self, tmp_path):
+        q = Quarantine(registry=MetricsRegistry(),
+                       path=str(tmp_path / "absent" / "q.jsonl"))
+        assert q.loaded == 0
+        assert q.add("k")                # persists by creating the dir
+        q2 = Quarantine(registry=MetricsRegistry(),
+                        path=str(tmp_path / "absent" / "q.jsonl"))
+        assert "k" in q2
+
+
+@pytest.mark.quick
+class TestRolloutPersistence:
+    def test_bump_persists_and_reloads(self, tmp_path):
+        path = str(tmp_path / "rollout.json")
+        st = fleet.RolloutState("v1", registry=MetricsRegistry(),
+                                persist_path=path)
+        st.bump("v2")
+        st.bump("v3")
+        with open(path) as fh:
+            assert json.load(fh) == {"tag": "v3", "epoch": 2}
+        # restart: the persisted epoch wins over the boot default
+        st2 = fleet.RolloutState("v1", registry=MetricsRegistry(),
+                                 persist_path=path)
+        assert st2.current() == ("v3", 2)
+
+    def test_registry_wires_persist_path(self, tmp_path):
+        path = str(tmp_path / "rollout.json")
+        reg = fleet.ReplicaRegistry(model_tag="boot",
+                                    registry=MetricsRegistry(),
+                                    rollout_persist_path=path)
+        reg.rollout.bump("rolled")
+        reg2 = fleet.ReplicaRegistry(model_tag="boot",
+                                     registry=MetricsRegistry(),
+                                     rollout_persist_path=path)
+        assert reg2.rollout.tag == "rolled"
+
+
+# -- unified health ------------------------------------------------------
+
+class TestUnifiedHealthz:
+    def test_peer_healthz_carries_scheduler_truth(self):
+        reg = fleet.ReplicaRegistry(model_tag="v1",
+                                    registry=MetricsRegistry())
+        cache = FoldCache(registry=MetricsRegistry())
+        health = {"running": True, "draining": False, "queue_depth": 4,
+                  "breaker": "closed", "model_tag": "v1"}
+        srv = fleet.PeerCacheServer(cache, rollout=reg.rollout,
+                                    replica_id="r1",
+                                    metrics=MetricsRegistry(),
+                                    health_source=lambda: dict(health))
+        with srv:
+            host, port = srv.address
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/healthz", timeout=5) as resp:
+                snap = json.loads(resp.read())
+        assert snap["breaker"] == "closed"
+        assert snap["queue_depth"] == 4
+        assert snap["tag"] == "v1" and snap["replica"] == "r1"
+
+    def test_recovery_probe_treats_open_breaker_as_down(self):
+        reg = fleet.ReplicaRegistry(model_tag="v1",
+                                    registry=MetricsRegistry())
+        cache = FoldCache(registry=MetricsRegistry())
+        health = {"breaker": "open", "running": True,
+                  "draining": False}
+        srv = fleet.PeerCacheServer(cache, rollout=reg.rollout,
+                                    replica_id="r1",
+                                    metrics=MetricsRegistry(),
+                                    health_source=lambda: dict(health))
+        with srv:
+            reg.register("r0")
+            reg.register("r1", peer_addr=srv.address)
+            reg.mark("r1", up=False)
+            client = fleet.PeerCacheClient(reg, "r0",
+                                           rollout=reg.rollout,
+                                           recovery_cooldown_s=0.01,
+                                           metrics=MetricsRegistry())
+            client._down["r1"] = 0.0
+            client._probe_peer("r1")     # 200, but breaker=open
+            assert not reg.is_healthy("r1")
+            assert client.recoveries == 0
+            assert "r1" in client._down  # still tracked for reprobe
+            health["breaker"] = "closed"
+            client._down["r1"] = 0.0
+            client._probe_peer("r1")     # healthy payload now
+            assert reg.is_healthy("r1")
+            assert client.recoveries == 1
+
+    def test_draining_payload_counts_as_down(self):
+        assert not fleet.PeerCacheClient._probe_payload_healthy(
+            json.dumps({"breaker": "closed", "draining": True,
+                        "running": True}).encode())
+        assert not fleet.PeerCacheClient._probe_payload_healthy(
+            json.dumps({"running": False}).encode())
+        assert fleet.PeerCacheClient._probe_payload_healthy(
+            json.dumps({"replica": "legacy", "tag": ""}).encode())
+        assert fleet.PeerCacheClient._probe_payload_healthy(
+            b"not json at all")
+
+
+# -- front door protocol over real HTTP ----------------------------------
+
+class _Door:
+    """One scheduler + front door on an ephemeral port."""
+
+    def __init__(self, executor=None, rollout=None, retry=None,
+                 model_tag="fd"):
+        self.scheduler = _scheduler(executor, model_tag=model_tag,
+                                    retry=retry)
+        self.server = FrontDoorServer(self.scheduler, rollout=rollout,
+                                      replica_id="fd0",
+                                      metrics=MetricsRegistry())
+
+    def __enter__(self):
+        self.scheduler.start()
+        self.server.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.server.stop()
+        self.scheduler.stop()
+
+
+class TestFrontDoorHttp:
+    def test_submit_poll_roundtrip(self):
+        with _Door() as d:
+            tr = HttpTransport(d.server.url,
+                               metrics=MetricsRegistry())
+            ticket = tr.submit(_request(seed=1))
+            resp = ticket.result(timeout=30)
+            assert resp.ok and resp.coords.shape == (12, 3)
+            assert resp.attempts == 1
+
+    def test_every_terminal_status_travels(self):
+        # poisoned via a deterministic failure + retry policy
+        retry = RetryPolicy(max_attempts=2, backoff_base_s=0.001)
+        with _Door(executor=_PoisonExecutor(), retry=retry) as d:
+            tr = HttpTransport(d.server.url,
+                               metrics=MetricsRegistry())
+            resp = tr.submit(_request(seed=2)).result(timeout=30)
+            assert resp.status == "poisoned"
+            assert "quarantined" in resp.error
+
+    def test_tag_mismatch_409(self):
+        rollout = fleet.RolloutState("v2", registry=MetricsRegistry())
+        with _Door(rollout=rollout) as d:
+            req = _request(seed=3)
+            body = encode_request(req)
+            headers = request_headers(req, tag="v1")   # straggler
+            http_req = urllib.request.Request(
+                d.server.url + "/v1/submit", data=body,
+                headers=headers, method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(http_req, timeout=5)
+            assert ei.value.code == 409
+            # untagged externals skip the check (the fence is for
+            # fleet-internal forwards, which always stamp)
+            tr = HttpTransport(d.server.url,
+                               metrics=MetricsRegistry())
+            assert tr.submit(req).result(timeout=30).ok
+
+    def test_unknown_ticket_404_and_single_pickup(self):
+        with _Door() as d:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    d.server.url + "/v1/result/nope", timeout=5)
+            assert ei.value.code == 404
+
+    def test_draining_replica_503s_and_exits_clean(self):
+        with _Door() as d:
+            tr = HttpTransport(d.server.url,
+                               metrics=MetricsRegistry())
+            assert tr.submit(_request(seed=4)).result(timeout=30).ok
+            assert d.scheduler.drain(timeout_s=5.0)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                tr.submit(_request(seed=5))
+            assert ei.value.code == 503
+            # direct (in-process) callers get the typed error
+            with pytest.raises(DrainingError):
+                d.scheduler.submit(_request(seed=6))
+            assert d.scheduler.serve_stats()["drains"] == 1
+
+    def test_drain_folds_pending_and_spans_mark_it(self):
+        from alphafold2_tpu.obs import Tracer
+
+        tracer = Tracer(jsonl_path=None, slow_k=8)
+        sched = _scheduler(_OkExecutor(delay_s=0.05), tracer=tracer)
+        server = FrontDoorServer(sched, replica_id="fd0",
+                                 metrics=MetricsRegistry())
+        sched.start()
+        server.start()
+        try:
+            tr = HttpTransport(server.url, metrics=MetricsRegistry())
+            tickets = [tr.submit(_request(seed=s)) for s in range(4)]
+            assert sched.drain(timeout_s=30.0)
+            # drain finishes in-flight work: every ticket terminal ok
+            resps = [t.result(timeout=30) for t in tickets]
+            assert all(r.ok for r in resps)
+            drained = [rec for rec in tracer.slowest()
+                       if any(s["name"] == "drain"
+                              for s in rec["spans"])]
+            assert drained, "no drain spans on requests caught mid-drain"
+        finally:
+            server.stop()
+            sched.stop()
+
+    def test_oversized_request_is_400_not_500(self):
+        # a seq beyond the largest bucket is the CLIENT's error: 400,
+        # so failover layers don't retry a deterministic refusal
+        # across the whole fleet
+        with _Door() as d:
+            req = FoldRequest(seq=np.arange(64, dtype=np.int32))
+            body = encode_request(req)
+            http_req = urllib.request.Request(
+                d.server.url + "/v1/submit", data=body,
+                headers=request_headers(req), method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(http_req, timeout=5)
+            assert ei.value.code == 400
+
+    def test_fleet_client_surfaces_client_errors_without_failover(self):
+        from alphafold2_tpu.fleet.procfleet import FleetClient
+
+        with _Door() as d:
+            client = FleetClient([d.server.url, d.server.url],
+                                 result_timeout_s=10.0)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                client.fold(FoldRequest(seq=np.arange(64,
+                                                      dtype=np.int32)))
+            assert ei.value.code == 400
+            assert client.snapshot()["submit_retries"] == 0
+
+    def test_partition_503s_data_plane_then_heals(self):
+        with _Door() as d:
+            d.server.set_partition(0.3)
+            tr = HttpTransport(d.server.url,
+                               metrics=MetricsRegistry())
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                tr.submit(_request(seed=7))
+            assert ei.value.code == 503
+            # healthz refuses too: probes must keep it marked down
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(d.server.url + "/healthz",
+                                       timeout=5)
+            time.sleep(0.4)              # auto-heal
+            assert tr.submit(_request(seed=7)).result(timeout=30).ok
+
+    def test_admin_rollout_and_stats(self):
+        rollout = fleet.RolloutState("v1", registry=MetricsRegistry())
+        with _Door(rollout=rollout) as d:
+            payload = json.dumps({"tag": "v2"}).encode()
+            req = urllib.request.Request(
+                d.server.url + "/admin/rollout", data=payload,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                out = json.loads(resp.read())
+            assert out == {"tag": "v2", "epoch": 1}
+            assert rollout.tag == "v2"
+            with urllib.request.urlopen(d.server.url + "/admin/stats",
+                                        timeout=5) as resp:
+                stats = json.loads(resp.read())
+            assert stats["running"] is True
+            assert "failovers" in stats and "drains" in stats
+
+
+class TestHttpTransportFailure:
+    def test_submit_time_refusal_raises(self):
+        tr = HttpTransport("http://127.0.0.1:9",  # discard port: dead
+                           timeout_s=0.5, metrics=MetricsRegistry())
+        with pytest.raises(Exception):
+            tr.submit(_request(seed=1))
+
+    def test_owner_death_midfold_resolves_transport_marker(self):
+        d = _Door(executor=_OkExecutor(delay_s=1.0))
+        d.scheduler.start()
+        d.server.start()
+        tr = HttpTransport(d.server.url, timeout_s=1.0,
+                           poll_wait_s=0.1,
+                           metrics=MetricsRegistry())
+        ticket = tr.submit(_request(seed=8))
+        d.server.stop()                  # the owner "dies" mid-fold
+        resp = ticket.result(timeout=30)
+        assert resp.status == "error"
+        assert RPC_TRANSPORT_MARKER in resp.error
+        d.scheduler.stop()
+
+    def test_result_timeout_sends_remote_cancel(self):
+        reg = MetricsRegistry()
+        with _Door(executor=_OkExecutor(delay_s=0.8)) as d:
+            tr = HttpTransport(d.server.url, poll_wait_s=0.05,
+                               metrics=reg)
+            ticket = tr.submit(_request(seed=9))
+            with pytest.raises(TimeoutError):
+                ticket.result(timeout=0.1)
+            assert tr.cancels == 1
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if d.server.snapshot()["parked_tickets"] == 0:
+                    break
+                time.sleep(0.05)
+            # cancelled slot freed (either at cancel or when the late
+            # result hit the cancelled slot's done callback)
+            assert d.server.snapshot()["parked_tickets"] == 0
+        snap = reg.snapshot()
+        assert snap["fleet_remote_cancels_total"]["samples"][0][
+            "value"] == 1
+
+
+# -- scheduler-level failover --------------------------------------------
+
+class _DyingTransport:
+    """Accepts the forward, then reports the owner died mid-fold."""
+
+    def __init__(self):
+        self.submits = 0
+
+    def submit(self, request, trace=None):
+        self.submits += 1
+        ticket = FoldTicket(request.request_id)
+
+        def _die():
+            ticket._resolve(FoldResponse(
+                request_id=request.request_id, status="error",
+                error=f"{RPC_TRANSPORT_MARKER}: owner killed"))
+
+        threading.Timer(0.05, _die).start()
+        return ticket
+
+
+class TestSchedulerFailover:
+    def _routed_pair(self, transport):
+        reg = fleet.ReplicaRegistry(model_tag="v1",
+                                    registry=MetricsRegistry())
+        reg.register("r0")
+        reg.register("r1", transport=transport)
+        router = fleet.ConsistentHashRouter(reg, "r0",
+                                            metrics=MetricsRegistry())
+        cache = FoldCache(registry=MetricsRegistry())
+        sched = _scheduler(cache=cache, model_tag="v1", router=router)
+        return reg, router, sched
+
+    def _owned_by(self, sched, router, owner):
+        for s in range(200):
+            req = _request(seed=s)
+            key = fold_key(req.seq, req.msa,
+                           msa_depth=sched.config.msa_depth,
+                           num_recycles=sched.config.num_recycles,
+                           model_tag="v1")
+            if router.owner_for(key) == owner:
+                return req
+        raise AssertionError("no key owned by " + owner)
+
+    def test_dead_owner_fails_over_to_local_fold(self):
+        dying = _DyingTransport()
+        reg, router, sched = self._routed_pair(dying)
+        with sched:
+            req = self._owned_by(sched, router, "r1")
+            resp = sched.submit(req).result(timeout=30)
+        assert resp.ok and resp.source == "fold"
+        assert dying.submits == 1
+        assert sched.serve_stats()["failovers"] == 1
+
+    def test_failover_settles_parked_followers(self):
+        dying = _DyingTransport()
+        reg, router, sched = self._routed_pair(dying)
+        with sched:
+            req = self._owned_by(sched, router, "r1")
+            t0 = sched.submit(req)
+            t1 = sched.submit(FoldRequest(seq=req.seq, msa=req.msa))
+            a, b = t0.result(timeout=30), t1.result(timeout=30)
+        assert a.ok and b.ok
+        assert {a.source, b.source} == {"fold", "coalesced"}
+
+    def test_non_transport_remote_error_stays_terminal(self):
+        class _ErrTransport:
+            def submit(self, request, trace=None):
+                t = FoldTicket(request.request_id)
+                t._resolve(FoldResponse(
+                    request_id=request.request_id, status="error",
+                    error="remote executor exploded"))
+                return t
+
+        reg, router, sched = self._routed_pair(_ErrTransport())
+        with sched:
+            req = self._owned_by(sched, router, "r1")
+            resp = sched.submit(req).result(timeout=30)
+        assert resp.status == "error"
+        assert resp.source == "forwarded"
+        assert sched.serve_stats()["failovers"] == 0
+
+    def test_drain_waits_for_outstanding_forwards(self):
+        dying = _DyingTransport()
+        reg, router, sched = self._routed_pair(dying)
+        sched.start()
+        req = self._owned_by(sched, router, "r1")
+        ticket = sched.submit(req)
+        assert sched.drain(timeout_s=30.0)
+        resp = ticket.result(timeout=5)
+        assert resp.ok                   # failover folded during drain
+        sched.stop()
+
+
+# -- LocalTransport equivalence ------------------------------------------
+
+def _scrub_timing(obj):
+    """Deterministic view of serve_stats: drop wall-clock-derived
+    fields (every *_s latency/TTL number and the slow-trace ring) so
+    two identical runs compare byte-identical; counters, batch counts,
+    padding waste, cache/router structure all stay."""
+    if isinstance(obj, dict):
+        return {k: _scrub_timing(v) for k, v in sorted(obj.items())
+                if k != "traces" and not k.endswith("_s")}
+    if isinstance(obj, list):
+        return [_scrub_timing(v) for v in obj]
+    return obj
+
+
+@pytest.mark.quick
+class TestLocalTransportEquivalence:
+    def _run_workload(self, use_explicit_transport: bool) -> dict:
+        """Two schedulers wired as a fleet; forwarding via an explicit
+        LocalTransport vs the legacy bare-callable `submit` field must
+        produce byte-identical deterministic serve_stats."""
+        reg = fleet.ReplicaRegistry(model_tag="v1",
+                                    registry=MetricsRegistry())
+        reg.register("r0")
+        reg.register("r1")
+        scheds = {}
+        for rid in ("r0", "r1"):
+            router = fleet.ConsistentHashRouter(
+                reg, rid, metrics=MetricsRegistry())
+            scheds[rid] = _scheduler(
+                cache=FoldCache(registry=MetricsRegistry()),
+                model_tag="v1", router=router)
+        for rid, s in scheds.items():
+            if use_explicit_transport:
+                reg.get(rid).transport = LocalTransport(s.submit)
+            else:
+                reg.get(rid).submit = s.submit
+        for s in scheds.values():
+            s.start()
+        # serial closed loop: batch composition (and so every counter)
+        # is deterministic, which is what lets the two wirings compare
+        # byte-identical rather than merely statistically alike
+        for i in range(16):              # 50% duplicates, alternating door
+            req = _request(seed=i % 8)
+            resp = scheds["r0" if i % 2 == 0 else "r1"].submit(
+                req).result(timeout=30)
+            assert resp.ok
+        stats = {rid: _scrub_timing(s.serve_stats())
+                 for rid, s in scheds.items()}
+        for s in scheds.values():
+            s.stop()
+        return stats
+
+    def test_transport_path_is_byte_identical_to_legacy(self):
+        explicit = self._run_workload(use_explicit_transport=True)
+        legacy = self._run_workload(use_explicit_transport=False)
+        assert json.dumps(explicit, sort_keys=True) \
+            == json.dumps(legacy, sort_keys=True)
+
+
+# -- multi-process fleet (slow tier) -------------------------------------
+
+@pytest.mark.slow
+class TestProcFleet:
+    """Real replica processes: serve_smoke.sh phase 6 in miniature.
+    Each replica imports jax and compiles a tiny model — minutes-scale,
+    excluded from tier-1 by the `slow` marker."""
+
+    def test_kill_partition_drain_survival(self, tmp_path):
+        from alphafold2_tpu.fleet.procfleet import (FleetClient,
+                                                    ProcFleet)
+
+        fl = ProcFleet(2, str(tmp_path / "run"),
+                       model_tag="t@v1",
+                       model={"dim": 16, "depth": 1, "msa_depth": 0})
+        with fl:
+            client = FleetClient(
+                [h.frontdoor_url for h in fl.replicas],
+                result_timeout_s=120.0)
+
+            def req(seed):
+                rng = np.random.default_rng(seed)
+                return FoldRequest(seq=rng.integers(
+                    0, 20, size=24).astype(np.int32))
+
+            for s in range(4):
+                assert client.fold(req(s), hint=s % 2).ok
+            # hard kill r1: traffic fails over, restart rejoins
+            fl.kill(1)
+            for s in range(4, 8):
+                assert client.fold(req(s), hint=s % 2).ok
+            fl.restart(1)
+            # rollout, then drain-restart r0: it must rejoin ROLLED
+            fl.rollout("t@v2")
+            assert fl.sigterm(0) == 0
+            fl.restart(0)
+            hz = fl.healthz(0)
+            assert hz["model_tag"] == "t@v2"
+            for s in range(8, 12):
+                assert client.fold(req(s), hint=s % 2).ok
+            # partition r1 and keep serving through r0
+            fl.partition(1, 1.0)
+            for s in range(12, 16):
+                assert client.fold(req(s), hint=0).ok
+        assert client.snapshot()["failovers"] + \
+            client.snapshot()["submit_retries"] >= 1

@@ -43,12 +43,15 @@ from alphafold2_tpu.utils.profiling import percentile  # noqa: E402
 # canonical stage order for the waterfall; unknown span names append.
 # forward (fleet routing hop) and peer_fetch (peer cache tier) arrived
 # with ISSUE 4; retry (backoff wait before a re-executed batch) and
-# watchdog (the killed window of a hung execution) with ISSUE 5 —
+# watchdog (the killed window of a hung execution) with ISSUE 5;
+# rpc (one front-door HTTP hop, client-measured: submit POST or the
+# whole forwarded exchange) and drain (time a request rode a graceful
+# drain, from drain start to its terminal state) with ISSUE 6 —
 # --check's orphan-span rules apply to all of them unchanged, which is
-# how the chaos smoke proves recovery cost is fully accounted.
-STAGE_ORDER = ("submit", "forward", "queue", "parked", "retry",
-               "batch_form", "compile", "fold", "watchdog", "writeback",
-               "peer_fetch", "cache_lookup", "write")
+# how the chaos smokes prove recovery cost is fully accounted.
+STAGE_ORDER = ("submit", "forward", "rpc", "queue", "parked", "retry",
+               "drain", "batch_form", "compile", "fold", "watchdog",
+               "writeback", "peer_fetch", "cache_lookup", "write")
 
 # span/trace boundary slack: start_s, dur_s, and duration_s are each
 # INDEPENDENTLY rounded to 1e-6 when emitted, so a span auto-closed at

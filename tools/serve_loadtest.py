@@ -111,6 +111,26 @@ def parse_args(argv=None):
     ap.add_argument("--replicas", type=int, default=1,
                     help="in-process serving replicas; > 1 runs the "
                          "fleet harness with round-robin traffic split")
+    ap.add_argument("--procs", type=int, default=0,
+                    help="MULTI-PROCESS fleet: spawn this many real "
+                         "replica processes (fleet.procfleet) and "
+                         "drive them over HTTP with driver-side "
+                         "failover; enables --proc-* chaos verbs")
+    ap.add_argument("--proc-run-dir", default="",
+                    help="procfleet run dir (state/cache/logs/traces "
+                         "per replica); default: a fresh /tmp dir")
+    ap.add_argument("--proc-kill-at", type=float, default=0.0,
+                    help="kill -9 one replica after this fraction of "
+                         "the request budget, then restart it "
+                         "(0 = never)")
+    ap.add_argument("--proc-partition-at", type=float, default=0.0,
+                    help="partition one replica (both planes 503) "
+                         "after this fraction of the budget")
+    ap.add_argument("--proc-partition-s", type=float, default=2.0,
+                    help="induced partition duration")
+    ap.add_argument("--proc-drain-at", type=float, default=0.0,
+                    help="rolling drain-restart (SIGTERM -> exit 0 -> "
+                         "respawn) one replica after this fraction")
     ap.add_argument("--fleet", default="auto",
                     choices=("auto", "on", "off"),
                     help="wire replicas into one fleet (consistent-hash "
@@ -291,6 +311,8 @@ def main(argv=None) -> int:
     import __graft_entry__
     if args.platform == "cpu":
         __graft_entry__.force_cpu_fallback()
+    if args.procs > 0:
+        return _run_procs(args)
     if args.replicas > 1:
         return _run_fleet(args)
 
@@ -844,6 +866,376 @@ def _run_fleet(args) -> int:
               f"{args.replicas} replicas, hit_ratio {hit_ratio:.3f}, "
               f"{forwards} forwards, 0 stale-tag hits",
               file=sys.stderr)
+    return 0
+
+
+def _run_procs(args) -> int:
+    """--procs N: drive a REAL multi-process fleet (fleet.procfleet)
+    over HTTP with driver-side failover, inducing the --proc-* chaos
+    schedule mid-run: one kill -9 + restart, one network partition,
+    one rolling drain-restart, plus an optional fleet-wide rollout.
+    One JSON line, `"metric": "serve_loadtest_procs"`. With --smoke:
+    FAILS unless every request (chaos notwithstanding) reached an ok
+    terminal state, zero requests were lost, the drained replica
+    exited 0, restarted replicas rejoined at the rolled tag, zero
+    stale-tag hits, and the merged traces carry rpc (and, when a drain
+    ran, drain) spans for obs_report."""
+    import tempfile
+
+    from alphafold2_tpu import serve
+    from alphafold2_tpu.fleet.procfleet import ProcFleet
+
+    n = args.procs
+    lengths = tuple(int(x) for x in args.lengths.split(",") if x)
+    if args.buckets:
+        buckets = tuple(int(x) for x in args.buckets.split(",") if x)
+    else:
+        buckets = tuple(serve.BucketPolicy.powers_of_two(
+            min(lengths), max(max(lengths), min(lengths))).edges)
+    run_dir = args.proc_run_dir or tempfile.mkdtemp(
+        prefix="procfleet_")
+    model_tag = "procfleet@v1"
+    rolled_tag = model_tag + "+rolled"
+
+    fleet = ProcFleet(
+        n, run_dir, model_tag=model_tag, buckets=buckets,
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        num_recycles=args.num_recycles,
+        model={"dim": args.dim, "depth": args.depth,
+               "msa_depth": args.msa_depth})
+    print(f"procfleet: starting {n} replica processes under {run_dir}",
+          file=sys.stderr)
+    try:
+        return _drive_procs(args, fleet, run_dir, model_tag,
+                            rolled_tag)
+    finally:
+        # children only exit on SIGTERM: any driver exception (or a
+        # partial start) must not orphan N warm replica processes
+        fleet.stop()
+
+
+def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
+    import jax
+    import numpy as np
+
+    from alphafold2_tpu import obs, serve
+    from alphafold2_tpu.data.synthetic import synthetic_requests
+    from alphafold2_tpu.fleet.procfleet import FleetClient
+    from alphafold2_tpu.obs.trace import NULL_TRACE
+
+    n = args.procs
+    lengths = tuple(int(x) for x in args.lengths.split(",") if x)
+    deadline_s = args.deadline_s or None
+    fleet.start()
+
+    tracer = None
+    driver_trace_path = ""
+    if args.trace_path:
+        driver_trace_path = args.trace_path + ".driver"
+        # fresh file: the merge at the end rewrites args.trace_path
+        try:
+            os.remove(driver_trace_path)
+        except OSError:
+            pass
+        tracer = obs.Tracer(jsonl_path=driver_trace_path,
+                            slow_k=args.trace_slow_k)
+    client = FleetClient(
+        [h.frontdoor_url for h in fleet.replicas],
+        result_timeout_s=180.0)
+
+    pool = synthetic_requests(
+        jax.random.PRNGKey(1), num=max(args.requests, 64),
+        lengths=lengths, msa_depth=args.msa_depth,
+        deadline_s=deadline_s)
+    schedule = _zipf_schedule(args, len(pool))
+    budget = args.requests
+
+    # one-shot chaos triggers, pinned to request indices; victims are
+    # distinct replicas so the three faults never stack on one process
+    # (requires n >= 3 to exercise all three; with fewer they share).
+    # max(1, ...): a small budget must still fire a requested fault —
+    # int() truncating to 0 would silently mean "never"
+    def _trigger(fraction):
+        return max(1, int(budget * fraction)) if fraction else 0
+
+    kill_at = _trigger(args.proc_kill_at)
+    part_at = _trigger(args.proc_partition_at)
+    drain_at = _trigger(args.proc_drain_at)
+    bump_at = _trigger(args.rollout_at)
+    kill_victim = n - 1
+    part_victim = 1 % n
+    drain_victim = 0
+    events = []
+    events_lock = threading.Lock()
+    fired = set()
+    failures = []
+    statuses = {}
+    lock = threading.Lock()
+    counter = [0]
+    burst_box = {"tickets": [], "transport": None}
+    drain_rc = [None]
+    rolled = {"tag": None}    # set once the fleet-wide rollout fired
+
+    def _note(event, **kw):
+        with events_lock:
+            events.append(dict({"event": event}, **kw))
+
+    def _fire(name, i, fn):
+        with events_lock:
+            if name in fired:
+                return
+            fired.add(name)
+        fn(i)
+
+    def _reannounce(index):
+        """Control-plane duty on rejoin: a replica that was down when
+        the rollout fired never heard the bump — re-announce the
+        current tag (idempotent for replicas that already rolled or
+        rejoined from a post-bump persisted epoch)."""
+        if rolled["tag"]:
+            resp = fleet._admin_post(index, "/admin/rollout",
+                                     {"tag": rolled["tag"]})
+            _note("reannounced", replica=index, resp=resp)
+
+    restart_threads = []
+
+    def _do_kill(i):
+        _note("kill", at_request=i, replica=kill_victim)
+        rc = fleet.kill(kill_victim)
+        _note("killed", rc=rc)
+
+        def _restart():
+            fleet.restart(kill_victim)
+            _reannounce(kill_victim)
+            _note("restarted", replica=kill_victim,
+                  healthz=fleet.healthz(kill_victim))
+
+        t = threading.Thread(target=_restart, daemon=True)
+        restart_threads.append(t)
+        t.start()
+
+    def _do_partition(i):
+        _note("partition", at_request=i, replica=part_victim,
+              duration_s=args.proc_partition_s)
+        fleet.partition(part_victim, args.proc_partition_s)
+
+    def _do_drain(i):
+        # burst a few submits straight at the victim so the drain has
+        # in-flight work to finish — their traces carry the drain span
+        transport = client.transports[drain_victim]
+        reqs = synthetic_requests(
+            jax.random.PRNGKey(4242), num=2 * args.max_batch,
+            lengths=lengths, msa_depth=args.msa_depth)
+        tickets = []
+        for r in reqs:
+            req = serve.FoldRequest(seq=r.seq, msa=r.msa,
+                                    deadline_s=deadline_s)
+            try:
+                tickets.append((req, transport.submit(req)))
+            except Exception:
+                tickets.append((req, None))   # raced the drain: refold
+        burst_box["tickets"] = tickets
+        burst_box["transport"] = transport
+        _note("drain", at_request=i, replica=drain_victim,
+              burst=len(tickets))
+        drain_rc[0] = fleet.sigterm(drain_victim)
+        _note("drained", rc=drain_rc[0])
+        fleet.restart(drain_victim)
+        _reannounce(drain_victim)
+        _note("drain_restarted", replica=drain_victim,
+              healthz=fleet.healthz(drain_victim))
+
+    def run_submitter():
+        while True:
+            with lock:
+                i = counter[0]
+                if i >= budget:
+                    return
+                counter[0] = i + 1
+            if kill_at and i == kill_at:
+                _fire("kill", i, _do_kill)
+            if part_at and i == part_at:
+                _fire("partition", i, _do_partition)
+            if bump_at and i == bump_at:
+                rolled["tag"] = rolled_tag
+                _note("rollout", at_request=i,
+                      epochs=fleet.rollout(rolled_tag))
+            if drain_at and i == drain_at:
+                _fire("drain", i, _do_drain)
+            proto = pool[schedule[i % len(schedule)]]
+            req = serve.FoldRequest(seq=proto.seq, msa=proto.msa,
+                                    deadline_s=deadline_s)
+            trace = (tracer.start_trace(req.request_id) if tracer
+                     else NULL_TRACE)
+            try:
+                resp = client.fold(req, hint=i % n, trace=trace)
+            except Exception as exc:
+                trace.finish("error", error=repr(exc))
+                with lock:
+                    failures.append(repr(exc))
+                continue
+            # the driver never folds: its traces are forwarded-sourced
+            # so obs_report's fold-span rule applies to replica traces
+            trace.finish(resp.status, source="forwarded",
+                         error=resp.error)
+            with lock:
+                statuses[resp.status] = statuses.get(resp.status, 0) + 1
+            if not resp.ok:
+                with lock:
+                    failures.append(f"{resp.status}: {resp.error}")
+            elif resp.coords.shape != (req.length, 3) or \
+                    not np.isfinite(resp.coords).all():
+                with lock:
+                    failures.append(
+                        f"bad coords {resp.coords.shape} for "
+                        f"n={req.length}")
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=run_submitter, daemon=True)
+               for _ in range(max(args.concurrency, 1))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # settle the drain burst: every ticket owes a terminal; a slot the
+    # drained process never answered (or answered with the transport
+    # marker) is re-folded through the live fleet — zero lost requests
+    burst_lost = 0
+    for req, ticket in burst_box["tickets"]:
+        resp = None
+        if ticket is not None:
+            try:
+                resp = ticket.result(timeout=60)
+            except Exception:
+                resp = None
+        if resp is not None and resp.status == "error" and resp.error \
+                and "rpc_transport" in resp.error:
+            resp = None
+        if resp is None:
+            try:
+                resp = client.fold(req)
+            except Exception as exc:
+                burst_lost += 1
+                failures.append(f"burst lost: {exc!r}")
+                continue
+        statuses[resp.status] = statuses.get(resp.status, 0) + 1
+        if not resp.ok:
+            failures.append(f"burst {resp.status}: {resp.error}")
+    serving_wall = time.monotonic() - t0
+
+    # a short budget can drain before the kill-restart finishes: the
+    # tag snapshot and teardown below must not race a replica mid-boot
+    for t in restart_threads:
+        t.join(timeout=240)
+
+    # fleet-wide truth BEFORE teardown: per-replica stats + health
+    per_replica, stale_tag_hits, replica_failovers = {}, 0, 0
+    tags = {}
+    for i, h in enumerate(fleet.replicas):
+        snap = fleet.stats(i)
+        hz = fleet.healthz(i)
+        tags[h.replica_id] = (hz or {}).get("model_tag") or \
+            (hz or {}).get("tag")
+        if snap is None:
+            per_replica[h.replica_id] = None
+            continue
+        extra = snap.get("extra", {})
+        stale_tag_hits += extra.get("peer", {}).get("stale_tag_hits", 0)
+        replica_failovers += snap.get("failovers", 0)
+        per_replica[h.replica_id] = {
+            "served": snap.get("served"),
+            "batches": snap.get("batches"),
+            "failovers": snap.get("failovers"),
+            "drains": snap.get("drains"),
+            "errors": snap.get("errors"),
+            "rollout": extra.get("rollout"),
+        }
+    fleet.stop()
+
+    span_counts = {}
+    if tracer is not None:
+        tracer.close()
+        fleet.merge_traces(args.trace_path,
+                           extra_paths=(driver_trace_path,))
+        with open(args.trace_path) as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                for s in rec.get("spans", ()):
+                    name = s.get("name", "?")
+                    span_counts[name] = span_counts.get(name, 0) + 1
+    if args.prom_path:
+        from alphafold2_tpu import obs as _obs
+        _obs.write_prometheus(args.prom_path)
+
+    expected_tag = rolled_tag if bump_at else model_tag
+    total = counter[0] + len(burst_box["tickets"])
+    report = {
+        "metric": "serve_loadtest_procs",
+        "platform": args.platform,
+        "procs": n,
+        "run_dir": run_dir,
+        "requests": total,
+        "serving_wall_s": round(serving_wall, 3),
+        "requests_per_hour": round(total / serving_wall * 3600.0, 1)
+        if serving_wall else 0.0,
+        "statuses": statuses,
+        "lost": burst_lost,
+        "client": client.snapshot(),
+        "replica_failovers": replica_failovers,
+        "stale_tag_hits": stale_tag_hits,
+        "drain_exit_code": drain_rc[0],
+        "tags": tags,
+        "expected_tag": expected_tag,
+        "events": events,
+        "per_replica": per_replica,
+        "span_counts": {k: span_counts[k]
+                        for k in ("rpc", "drain", "forward", "fold")
+                        if k in span_counts},
+        "trace_path": args.trace_path or None,
+        "failures": failures[:8],
+    }
+    print(json.dumps(report))
+
+    if not args.smoke:
+        return 0
+    problems = []
+    ok_n = statuses.get("ok", 0)
+    if failures:
+        problems.append(f"{len(failures)} failed requests "
+                        f"(first: {failures[0]})")
+    if burst_lost:
+        problems.append(f"{burst_lost} LOST requests")
+    if ok_n != total:
+        problems.append(f"{ok_n}/{total} requests ok "
+                        f"(statuses {statuses})")
+    if drain_at and drain_rc[0] != 0:
+        problems.append(f"drained replica exited {drain_rc[0]}, not 0")
+    if kill_at and "killed" not in {e["event"] for e in events}:
+        problems.append("kill never fired")
+    if stale_tag_hits:
+        problems.append(f"{stale_tag_hits} stale-tag peer hits")
+    bad_tags = {r: t for r, t in tags.items() if t != expected_tag}
+    if bad_tags:
+        problems.append(f"replicas on the wrong tag after "
+                        f"rollout/restart: {bad_tags} "
+                        f"(expected {expected_tag!r})")
+    if tracer is not None and not span_counts.get("rpc"):
+        problems.append("no rpc spans in the merged traces")
+    if tracer is not None and drain_at and not span_counts.get("drain"):
+        problems.append("drain ran but no drain spans in the traces")
+    if problems:
+        print("SMOKE FAIL (procs): " + "; ".join(problems),
+              file=sys.stderr)
+        return 1
+    print(f"SMOKE OK (procs): {ok_n}/{total} ok across {n} processes "
+          f"(client failover {client.snapshot()}, replica failovers "
+          f"{replica_failovers}, drain rc {drain_rc[0]}, "
+          f"0 stale-tag hits, spans {report['span_counts']})",
+          file=sys.stderr)
     return 0
 
 

@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Serving smoke, four phases over the serve.Scheduler on CPU:
+# Serving smoke, six phases over the serve.Scheduler on CPU:
 #
 #   1. 30-second mixed-length load test. FAILS (exit 1) on any shed,
 #      timeout, error, or rejected request at this trivial load — the
@@ -36,10 +36,30 @@
 #      tools/obs_report.py --check over the chaos traces proves no
 #      orphan retry/watchdog spans — recovery cost is fully accounted
 #      in the waterfall. The resilience-subsystem tripwire.
+#   6. multi-process fleet (--procs 3, fleet.procfleet): THREE real
+#      replica processes behind HTTP front doors (fleet.frontdoor),
+#      surviving one kill -9 + restart, one induced network partition,
+#      a fleet-wide model-tag rollout, and one rolling drain-restart
+#      (SIGTERM -> Scheduler.drain -> exit 0 -> respawn at the
+#      PERSISTED rollout epoch + poison quarantine). FAILS unless
+#      every request reaches an ok terminal state (zero lost across
+#      all three faults), the drained replica exits 0, every replica
+#      ends on the rolled tag (restart included), zero stale-tag
+#      serves, and obs_report --check is clean over the merged
+#      driver + replica traces with rpc/drain spans present in the
+#      waterfall. The deployment-seam tripwire.
+#
+# SMOKE_PHASES selects phases without forking the script (constrained
+# runners skip the multi-process phase): a comma-separated list, e.g.
+#   SMOKE_PHASES=1,2,3 bash tools/serve_smoke.sh
+#   SMOKE_PHASES=6 bash tools/serve_smoke.sh
+# Default: all phases. Phase 3 checks phase 1+2's artifacts — select
+# them together.
 #
 # Invoked standalone from the test-tier docs (README "Tests");
-# tests/test_serve.py + tests/test_cache.py + tests/test_obs.py cover
-# the same paths in-process under `-m 'not slow'`.
+# tests/test_serve.py + tests/test_cache.py + tests/test_obs.py +
+# tests/test_frontdoor.py cover the same paths in-process under
+# `-m 'not slow'` (the multi-process tier is `-m slow`).
 #
 #   bash tools/serve_smoke.sh            # default 30s serving window
 #   SMOKE_DURATION_S=10 bash tools/serve_smoke.sh
@@ -50,8 +70,17 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 DURATION="${SMOKE_DURATION_S:-30}"
+PHASES="${SMOKE_PHASES:-1,2,3,4,5,6}"
 
-rm -f /tmp/serve_smoke_traces.jsonl /tmp/serve_smoke_dup_traces.jsonl
+phase_on() {
+    case ",${PHASES}," in
+        *",$1,"*) return 0 ;;
+        *) return 1 ;;
+    esac
+}
+
+if phase_on 1; then
+rm -f /tmp/serve_smoke_traces.jsonl
 
 timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     python tools/serve_loadtest.py \
@@ -67,6 +96,10 @@ timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     --metrics-path /tmp/serve_smoke.jsonl \
     --trace-path /tmp/serve_smoke_traces.jsonl \
     --prom-path /tmp/serve_smoke.prom
+fi
+
+if phase_on 2; then
+rm -f /tmp/serve_smoke_dup_traces.jsonl
 
 timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     python tools/serve_loadtest.py \
@@ -84,10 +117,12 @@ timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     --metrics-path /tmp/serve_smoke_dup.jsonl \
     --trace-path /tmp/serve_smoke_dup_traces.jsonl \
     --prom-path /tmp/serve_smoke_dup.prom
+fi
 
 # phase 3: every completed request left exactly one complete trace
 # (non-zero fold span for accelerator-served ones, no orphan spans,
 # schema-versioned) and the Prometheus exposition parses
+if phase_on 3; then
 timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     python tools/obs_report.py /tmp/serve_smoke_traces.jsonl \
     --check --prom /tmp/serve_smoke.prom
@@ -95,10 +130,12 @@ timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
 timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     python tools/obs_report.py /tmp/serve_smoke_dup_traces.jsonl \
     --check --prom /tmp/serve_smoke_dup.prom
+fi
 
 # phase 4: two-replica fleet vs the two-independent-replica baseline on
 # the identical duplicated workload (same schedule, same round-robin
 # split, same mid-run epoch bump)
+if phase_on 4; then
 rm -f /tmp/serve_smoke_fleet_traces.jsonl
 
 fleet_phase() {  # $1 = on|off, $2 = report path, extra args follow
@@ -165,6 +202,7 @@ print(f"FLEET SMOKE OK: hit_ratio {fleet['hit_ratio']} > "
       f"{fleet['peer_hits']} peer hits, 0 stale-tag hits",
       file=sys.stderr)
 EOF
+fi
 
 # phase 5: the phase-2 workload under seeded chaos — 10% transient
 # executor faults + one poison request; the hardened scheduler must
@@ -172,6 +210,7 @@ EOF
 # terminal tickets / innocent ok-rate / exactly-one quarantine / the
 # log2(max_batch)+1 bisection bound in-process), and the recovery must
 # be fully accounted in the traces (no orphan retry/watchdog spans)
+if phase_on 5; then
 rm -f /tmp/serve_smoke_chaos_traces.jsonl
 
 timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
@@ -194,6 +233,44 @@ timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     --trace-path /tmp/serve_smoke_chaos_traces.jsonl \
     --prom-path /tmp/serve_smoke_chaos.prom
 
-exec timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     python tools/obs_report.py /tmp/serve_smoke_chaos_traces.jsonl \
     --check --prom /tmp/serve_smoke_chaos.prom
+fi
+
+# phase 6: THREE real replica processes (fleet.procfleet) behind HTTP
+# front doors, one kill -9 + restart, one induced partition, a
+# fleet-wide rollout, one rolling drain-restart — zero lost requests,
+# drain exits 0, every replica ends on the rolled tag, zero stale-tag
+# serves (serve_loadtest --smoke --procs enforces all of it), then
+# obs_report --check over the merged driver+replica traces proves the
+# new rpc/drain spans are orphan-free in the waterfall
+if phase_on 6; then
+rm -rf /tmp/serve_smoke_procs
+rm -f /tmp/serve_smoke_procs_traces.jsonl
+
+timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/serve_loadtest.py \
+    --smoke \
+    --procs 3 \
+    --proc-run-dir /tmp/serve_smoke_procs \
+    --proc-kill-at 0.3 \
+    --proc-partition-at 0.5 \
+    --proc-partition-s 2 \
+    --rollout-at 0.65 \
+    --proc-drain-at 0.8 \
+    --requests 60 \
+    --lengths 24,48 \
+    --buckets 32,64 \
+    --msa-depth 3 \
+    --max-batch 2 \
+    --concurrency 3 \
+    --deadline-s 120 \
+    --num-recycles 0 \
+    --trace-path /tmp/serve_smoke_procs_traces.jsonl \
+    --prom-path /tmp/serve_smoke_procs.prom
+
+timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/obs_report.py /tmp/serve_smoke_procs_traces.jsonl \
+    --check --prom /tmp/serve_smoke_procs.prom
+fi
