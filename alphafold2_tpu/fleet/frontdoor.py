@@ -19,7 +19,13 @@ cache tier in `fleet/peer.py`). The protocol is deliberately tiny:
                                  depth, draining — the same shape the
                                  peer cache server serves, so the
                                  router's health walk and the recovery
-                                 probe share one truth
+                                 probe share one truth (a mesh-aware
+                                 scheduler adds its device-slice
+                                 occupancy under "mesh"; /admin/stats
+                                 likewise carries serve_stats()["mesh"]
+                                 — the passthrough needs no wiring here
+                                 because both payloads come whole from
+                                 the scheduler)
     POST /admin/rollout          {"tag": t} -> bump RolloutState
     GET  /admin/stats            serve_stats() as JSON
     POST /admin/partition        {"duration_s": f} -> data-plane 503s

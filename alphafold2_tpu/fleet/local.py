@@ -73,6 +73,14 @@ class InProcessFleet:
     faults: optional serve.faults.FaultPlan threaded into every
         replica's FoldCache and PeerCacheClient (chaos harness; the
         executor side is the caller's to wire via make_executor).
+    mesh_policy_factory: optional per-replica serve.MeshPolicy factory
+        (index -> MeshPolicy or None) for mesh-aware replicas. A
+        FACTORY, not a shared policy: in-process replicas share one
+        device pool, so each needs its own policy/allocator over its
+        own device subset (separate hosts in production own their
+        chips outright). The mesh section then rides each replica's
+        serve_stats()/health() through the fleet stats and /healthz
+        passthrough unchanged.
     """
 
     def __init__(self, make_executor: Callable[[], object],
@@ -87,7 +95,9 @@ class InProcessFleet:
                      Callable[[int], ServeMetrics]] = None,
                  registry: Optional[MetricsRegistry] = None,
                  retry=None,
-                 faults=None):
+                 faults=None,
+                 mesh_policy_factory: Optional[
+                     Callable[[int], object]] = None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self.fleet_enabled = bool(fleet)
@@ -134,7 +144,9 @@ class InProcessFleet:
                 make_executor(), buckets, config,
                 metrics=(metrics_factory(i) if metrics_factory else None),
                 cache=cache, model_tag=model_tag, tracer=tracer,
-                registry=registry, router=router, retry=rep_retry)
+                registry=registry, router=router, retry=rep_retry,
+                mesh_policy=(mesh_policy_factory(i)
+                             if mesh_policy_factory else None))
             # the forwarding transport wraps the peer scheduler's
             # submit (LocalTransport — in-process, zero-copy); set
             # after construction so the registry row is complete

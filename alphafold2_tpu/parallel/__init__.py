@@ -8,6 +8,8 @@ from alphafold2_tpu.parallel.mesh import (  # noqa: F401
 )
 from alphafold2_tpu.parallel.sharding import (  # noqa: F401
     active_mesh,
+    fold_input_shardings,
+    fold_input_specs,
     msa_spec,
     pair_spec,
     pytree_bytes_per_device,

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from alphafold2_tpu.parallel.mesh import PIPE_AXIS
+from alphafold2_tpu.parallel.sharding import shard_map_compat
 
 
 def make_pipeline_mesh(pipe: int, data: int = 1, devices=None) -> Mesh:
@@ -117,10 +118,13 @@ def pipeline_apply(
                              jax.tree.map(lambda x: x[0], xs), zero)
         # the carry becomes device-varying after the first tick; mark the
         # init values as varying over the pipe axis so scan's carry types
-        # line up (jax>=0.8 shard_map vma typing)
-        outputs0 = jax.tree.map(
-            lambda x: jax.lax.pcast(jnp.zeros_like(x), (axis_name,),
-                                    to="varying"), xs)
+        # line up (jax>=0.8 shard_map vma typing; older jax has no vma
+        # types — and no pcast — so the marking is a no-op there)
+        pcast = getattr(jax.lax, "pcast", None)
+        mark = (lambda x: pcast(jnp.zeros_like(x), (axis_name,),
+                                to="varying")) if pcast is not None \
+            else jnp.zeros_like
+        outputs0 = jax.tree.map(mark, xs)
         ring = [(s, (s + 1) % s_count) for s in range(s_count)]
 
         def tick(carry, t):
@@ -166,8 +170,6 @@ def pipeline_apply(
     manual = {axis_name}
     if data_axis is not None and data_axis in mesh.axis_names:
         manual.add(data_axis)
-    fn = jax.shard_map(spmd, mesh=mesh,
-                       in_specs=(param_specs, x_specs),
-                       out_specs=x_specs,
-                       axis_names=frozenset(manual))
+    fn = shard_map_compat(spmd, mesh, (param_specs, x_specs), x_specs,
+                          manual_axes=frozenset(manual))
     return fn(stacked_params, xs)

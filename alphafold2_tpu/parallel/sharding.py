@@ -31,6 +31,43 @@ def active_mesh() -> Optional[Mesh]:
     return getattr(_state, "mesh", None)
 
 
+def shard_map_compat(f, mesh: Mesh, in_specs, out_specs,
+                     manual_axes: Optional[frozenset] = None,
+                     check: Optional[bool] = None):
+    """Version-tolerant shard_map: `jax.shard_map` (jax >= 0.8 — manual
+    axes via `axis_names`, replication typing via `check_vma`) or
+    `jax.experimental.shard_map.shard_map` (jax 0.4.x — the complement
+    `auto=` set and `check_rep`). `manual_axes=None` means fully manual;
+    `check=None` keeps each API's default."""
+    kw = {}
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        if manual_axes is not None:
+            kw["axis_names"] = frozenset(manual_axes)
+        if check is not None:
+            kw["check_vma"] = check
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kw)
+    from jax.experimental.shard_map import shard_map as legacy_sm
+    if manual_axes is not None:
+        kw["auto"] = frozenset(mesh.axis_names) - frozenset(manual_axes)
+    if check is not None:
+        kw["check_rep"] = check
+    return legacy_sm(f, mesh, in_specs, out_specs, **kw)
+
+
+def _enter_mesh(mesh: Mesh):
+    """The version-tolerant ambient-mesh context: `jax.set_mesh` where
+    it exists (jax >= 0.5), else the Mesh's own resource-env context
+    manager (jax 0.4.x — `with mesh:`). Constraints here always name
+    their mesh explicitly via NamedSharding, so the ambient context
+    only matters for closures traced under jit."""
+    set_mesh = getattr(jax, "set_mesh", None)
+    if set_mesh is not None:
+        return set_mesh(mesh)
+    return mesh
+
+
 @contextlib.contextmanager
 def use_mesh(mesh: Optional[Mesh], manual_axes: frozenset = frozenset()):
     """Activate a mesh for model-internal sharding constraints.
@@ -49,7 +86,7 @@ def use_mesh(mesh: Optional[Mesh], manual_axes: frozenset = frozenset()):
     _state.manual = frozenset(manual_axes)
     try:
         if mesh is not None and not manual_axes:
-            with jax.set_mesh(mesh):
+            with _enter_mesh(mesh):
                 yield mesh
         else:
             # inside a shard_map body the ambient mesh is already manual;
@@ -84,7 +121,10 @@ def _constraint(x, spec: P):
         # inside a shard_map body the constraint must name the mesh view
         # whose axis types carry the enclosing Manual axes — that is the
         # trace-time abstract mesh, not the concrete one we stored
-        amesh = jax.sharding.get_abstract_mesh()
+        # (jax < 0.5 has no abstract-mesh API; the concrete-mesh
+        # fallback below is what those versions expect)
+        get_amesh = getattr(jax.sharding, "get_abstract_mesh", None)
+        amesh = get_amesh() if get_amesh is not None else None
         if amesh is not None and amesh.axis_names:
             return jax.lax.with_sharding_constraint(
                 x, NamedSharding(amesh, P(*cleaned)))
@@ -117,6 +157,48 @@ def shard_msa(x):
 def shard_seq(x):
     """(b, n, d) single-track activations: data-parallel only."""
     return _constraint(x, seq_spec())
+
+
+def fold_input_specs() -> dict:
+    """PartitionSpecs for the serving executor's fold INPUTS (the
+    inference-side seam `serve.FoldExecutor` lowers under — training
+    goes through `shard_*` constraints instead).
+
+    Token inputs are tiny next to the in-model pair tensor, so seq/mask
+    replicate; the MSA tokens shard their sequence axis over `i` (same
+    contract as `msa_spec`, one rank lower — no feature dim yet) so the
+    msa embedding materializes already distributed:
+
+    - seq      (b, n)    -> P()
+    - mask     (b, n)    -> P()
+    - msa      (b, m, n) -> P(None, None, i)
+    - msa_mask (b, m, n) -> P(None, None, i)
+    """
+    return {"seq": P(), "mask": P(),
+            "msa": P(None, None, PAIR_I_AXIS),
+            "msa_mask": P(None, None, PAIR_I_AXIS)}
+
+
+def fold_input_shardings(mesh: Mesh, batch: dict) -> dict:
+    """NamedShardings for one assembled serving batch on `mesh`.
+    A spec axis that cannot divide the actual dim (or is missing from
+    the mesh) degrades to replication for that tensor — placement is a
+    performance hint, never a shape constraint."""
+    out = {}
+    for name, spec in fold_input_specs().items():
+        x = batch.get(name)
+        if x is None:
+            out[name] = None
+            continue
+        cleaned = []
+        for dim, axis in zip(x.shape, spec):
+            if axis is None or axis not in mesh.axis_names \
+                    or dim % mesh.shape[axis] != 0:
+                cleaned.append(None)
+            else:
+                cleaned.append(axis)
+        out[name] = NamedSharding(mesh, P(*cleaned))
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -11,6 +11,13 @@ The serving stack, bottom-up:
              (all mirrored into the process-wide obs.MetricsRegistry;
              pass `Scheduler(..., tracer=obs.Tracer(...))` for
              request-scoped traces — README "Observability")
+- meshpolicy: MeshPolicy/FoldMemoryModel/DeviceSliceAllocator — pass
+             `Scheduler(..., mesh_policy=MeshPolicy.from_model(...))`
+             for multi-chip serving: per-bucket device slices (short
+             folds single-chip, long folds pair-sharded over a
+             `parallel.mesh`), concurrent disjoint-slice execution, and
+             the analytic-HBM admission guard (README "Multi-chip
+             serving")
 - resilience: RetryPolicy/CircuitBreaker/Quarantine — pass
              `Scheduler(..., retry=RetryPolicy(...))` for transient-
              batch retry, poison isolation by bisection + quarantine,
@@ -46,6 +53,9 @@ from alphafold2_tpu.obs import (MetricsRegistry, Tracer,  # noqa: F401
 from alphafold2_tpu.serve.bucketing import BucketPolicy, default_policy  # noqa: F401
 from alphafold2_tpu.serve.executor import FoldExecutor  # noqa: F401
 from alphafold2_tpu.serve.faults import FaultInjected, FaultPlan  # noqa: F401
+from alphafold2_tpu.serve.meshpolicy import (DeviceSliceAllocator,  # noqa: F401
+                                             FoldMemoryModel, MeshPolicy,
+                                             SliceLease)
 from alphafold2_tpu.serve.metrics import ServeMetrics  # noqa: F401
 from alphafold2_tpu.serve.request import (FoldRequest, FoldResponse,  # noqa: F401
                                           FoldTicket)

@@ -1,9 +1,9 @@
 """Compiled-executable cache around `predict.fold`.
 
 One compiled executable per (bucket_len, batch_size, msa_depth,
-num_recycles) key: because the scheduler feeds each key exactly one
-shape signature, the executor compiles ahead-of-time
-(`jax.jit(...).lower(args).compile()`) and caches the resulting
+num_recycles, mesh_shape, model_tag) key: because the scheduler feeds
+each key exactly one shape signature, the executor compiles ahead-of-
+time (`jax.jit(...).lower(args).compile()`) and caches the resulting
 `Compiled` object — so LRU-evicting a key actually frees its executable
 (a single shared jit fn would pin every shape it ever saw in its
 internal cache — no eviction handle), and compilation is a separately
@@ -14,6 +14,24 @@ is how a request trace attributes XLA time vs accelerator time
 `max_entries` bounds the resident set and `warmup()` pre-pays compiles
 before traffic arrives instead of on the first unlucky request.
 
+The key's last two elements close two staleness holes (ISSUE 7):
+`model_tag` means a weight rollout (the scheduler re-tags the executor)
+can never serve an executable compiled against the previous weights'
+identity, and `mesh_shape` keeps single-chip and mesh-sharded
+executables for the same bucket coexisting in the LRU.
+
+Multi-chip execution (`run(..., devices=, mesh_shape=)` — driven by the
+scheduler's `serve.meshpolicy.MeshPolicy`): the fold lowers under
+`parallel.mesh.make_mesh` with the model's own `shard_pair/shard_msa`
+constraints live (FastFold-style 2-D pair sharding at inference),
+params placed once per (device slice, model_tag) via
+`parallel.sharding.shard_pytree_tp` and reused across executables,
+inputs placed per `parallel.sharding.fold_input_shardings`. A 1-device
+slice skips the mesh entirely and just pins args to that device, so
+several short folds run concurrently on disjoint chips. `devices=None`
+(the default) is byte-for-byte the single-chip behavior this file
+always had.
+
 `stats()` exposes hits/misses/evictions; misses == distinct XLA
 compilations triggered through this executor, the number the e2e test
 pins to the bucket count.
@@ -21,40 +39,78 @@ pins to the bucket count.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from collections import OrderedDict
-from typing import Iterable, Tuple
+from typing import Iterable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from alphafold2_tpu.obs.trace import NULL_TRACE
+from alphafold2_tpu.parallel.mesh import make_mesh
+from alphafold2_tpu.parallel.sharding import (fold_input_shardings,
+                                              shard_pytree_tp, use_mesh)
 from alphafold2_tpu.predict import FoldResult, fold
 from alphafold2_tpu.serve.bucketing import msa_depth_of
+from alphafold2_tpu.serve.meshpolicy import MeshShape, factor_chips, \
+    mesh_label
 
-# (bucket_len, batch_size, msa_depth, num_recycles)
-ExecKey = Tuple[int, int, int, int]
+# (bucket_len, batch_size, msa_depth, num_recycles, mesh_shape, model_tag)
+ExecKey = Tuple[int, int, int, int, MeshShape, str]
+
+_SINGLE: MeshShape = (1, 1)
+_BATCH_INPUTS = ("seq", "mask", "msa", "msa_mask")
 
 
 class FoldExecutor:
     """LRU cache of compiled fold executables, keyed by shape signature.
 
+    model_tag: weight identity baked into every ExecKey; reassigning it
+        (the scheduler does on a rollout) makes every prior executable
+        unreachable by construction — no stale compiled state can serve
+        the new tag.
     faults: optional serve.faults.FaultPlan — chaos-injection hook
         (exceptions / latency spikes before the device call, NaN
         mutation after); None (default) costs nothing on the hot path.
     """
 
-    def __init__(self, model, params, max_entries: int = 8, faults=None):
+    def __init__(self, model, params, max_entries: int = 8, faults=None,
+                 model_tag: str = ""):
         assert model.predict_coords, "serving needs predict_coords=True"
         self.model = model
         self.params = params
         self.max_entries = max(1, int(max_entries))
         self.faults = faults
-        self._cache: "OrderedDict[ExecKey, callable]" = OrderedDict()
+        # executable cache key: ExecKey + concrete device ids (an
+        # executable is bound to the devices it lowered for; two
+        # disjoint 1-chip slices need two executables)
+        self._cache: "OrderedDict[tuple, callable]" = OrderedDict()
+        # (device_ids, model_tag) -> (mesh_or_None, placed_params):
+        # params are transferred/sharded ONCE per slice and reused by
+        # every executable compiled on that slice
+        self._placed: dict = {}
         self._lock = threading.Lock()
+        self.model_tag = model_tag
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+
+    @property
+    def model_tag(self) -> str:
+        return self._model_tag
+
+    @model_tag.setter
+    def model_tag(self, tag: str):
+        """A rollout re-tags the executor (the scheduler's own
+        model_tag setter forwards here): besides re-keying every
+        future ExecKey, drop param placements minted under any OTHER
+        tag NOW — a slice that sees no post-rollout traffic must not
+        keep the rolled-out weights' copies pinned in device memory."""
+        self._model_tag = tag
+        with self._lock:
+            for k in [k for k in self._placed if k[1] != tag]:
+                del self._placed[k]
 
     def rebuild(self) -> "FoldExecutor":
         """Fresh executor over the same (model, params): empty
@@ -65,7 +121,8 @@ class FoldExecutor:
         result can never land in the serving path."""
         return FoldExecutor(self.model, self.params,
                             max_entries=self.max_entries,
-                            faults=self.faults)
+                            faults=self.faults,
+                            model_tag=self.model_tag)
 
     def _build(self, num_recycles: int):
         def run(params, seq, mask, msa, msa_mask) -> FoldResult:
@@ -74,80 +131,187 @@ class FoldExecutor:
 
         return jax.jit(run)
 
-    def _compile(self, key: ExecKey, args):
+    def _compile(self, cache_key: tuple, num_recycles: int, args,
+                 mesh=None):
         """AOT-compile the key's executable OUTSIDE the cache lock (an
         XLA compile can take seconds; holding the lock would stall
         concurrent hit lookups) and insert it. Falls back to the lazily
         compiling jitted callable on JAX versions/paths where AOT
-        lowering refuses the argument structure."""
-        jitted = self._build(key[3])
+        lowering refuses the argument structure. `mesh` (multi-chip
+        slices only) is entered during lowering so the model's sharding
+        constraints bake into the executable."""
+        jitted = self._build(num_recycles)
+        ctx = use_mesh(mesh) if mesh is not None \
+            else contextlib.nullcontext()
         try:
-            fn = jitted.lower(*args).compile()
+            with ctx:
+                fn = jitted.lower(*args).compile()
         except Exception:
             fn = jitted          # first call will compile lazily
         with self._lock:
             self.misses += 1
-            existing = self._cache.get(key)
+            existing = self._cache.get(cache_key)
             if existing is not None:
                 # raced with another compiler of the same key: keep the
                 # resident one (both are valid; counters stay honest)
-                self._cache.move_to_end(key)
+                self._cache.move_to_end(cache_key)
                 return existing
-            self._cache[key] = fn
+            self._cache[cache_key] = fn
             while len(self._cache) > self.max_entries:
                 self._cache.popitem(last=False)
                 self.evictions += 1
         return fn
 
-    def _lookup(self, key: ExecKey):
+    def _lookup(self, cache_key: tuple):
         with self._lock:
-            fn = self._cache.get(key)
+            fn = self._cache.get(cache_key)
             if fn is not None:
                 self.hits += 1
-                self._cache.move_to_end(key)
+                self._cache.move_to_end(cache_key)
             return fn
 
-    def key_for(self, batch: dict, num_recycles: int) -> ExecKey:
+    def key_for(self, batch: dict, num_recycles: int,
+                mesh_shape: Optional[MeshShape] = None) -> ExecKey:
         b, n = batch["seq"].shape
-        return (int(n), int(b), msa_depth_of(batch), int(num_recycles))
+        shape = _SINGLE if mesh_shape is None \
+            else tuple(int(x) for x in mesh_shape)
+        return (int(n), int(b), msa_depth_of(batch), int(num_recycles),
+                shape, self.model_tag)
+
+    def _normalize_key(self, key) -> ExecKey:
+        """Accept legacy 4-tuple (len, batch, msa_depth, recycles) and
+        5-tuple (+ mesh_shape) keys alongside the full 6-tuple —
+        `warmup()` callers predate the mesh/model_tag elements."""
+        key = tuple(key)
+        if len(key) == 4:
+            return key + (_SINGLE, self.model_tag)
+        if len(key) == 5:
+            return key[:4] + (tuple(key[4]), self.model_tag)
+        return key[:4] + (tuple(key[4]), key[5])
+
+    # -- device-slice plumbing -------------------------------------------
+
+    def _placed_params(self, devices: Sequence, mesh_shape: MeshShape):
+        """(mesh_or_None, params placed on the slice), computed once per
+        (device slice, model_tag). Placements for rolled-out tags are
+        pruned eagerly by the model_tag setter."""
+        dev_ids = tuple(int(d.id) for d in devices)
+        cache_k = (dev_ids, self.model_tag)
+        with self._lock:
+            placed = self._placed.get(cache_k)
+        if placed is not None:
+            return placed
+        if len(devices) == 1:
+            mesh = None
+            params = jax.device_put(self.params, devices[0])
+        else:
+            mesh = make_mesh(1, mesh_shape[0], mesh_shape[1],
+                             devices=devices)
+            params = shard_pytree_tp(self.params, mesh)
+        with self._lock:
+            existing = self._placed.get(cache_k)
+            if existing is not None:
+                return existing          # raced: keep the resident copy
+            self._placed[cache_k] = (mesh, params)
+        return mesh, params
+
+    def _place_inputs(self, batch: dict, mesh, devices: Sequence):
+        if mesh is None:
+            dev = devices[0]
+            return tuple(None if batch[k] is None
+                         else jax.device_put(batch[k], dev)
+                         for k in _BATCH_INPUTS)
+        shardings = fold_input_shardings(mesh, batch)
+        return tuple(None if batch[k] is None
+                     else jax.device_put(batch[k], shardings[k])
+                     for k in _BATCH_INPUTS)
+
+    # -- execution -------------------------------------------------------
 
     def run(self, batch: dict, num_recycles: int,
-            trace=NULL_TRACE) -> FoldResult:
+            trace=NULL_TRACE, devices: Optional[Sequence] = None,
+            mesh_shape: Optional[MeshShape] = None) -> FoldResult:
         """Fold one assembled batch; blocks until device results land so
         the caller's latency measurement is honest. `trace` (a Trace /
         MultiTrace; NULL_TRACE default is zero-cost) gets a `compile`
         span when this signature is built fresh and a `fold` span for
-        the execution itself."""
+        the execution itself.
+
+        devices: optional device slice (a SliceLease's devices). None —
+        the default — is the single-chip path, unchanged. With a slice,
+        `mesh_shape` (i, j) factorizes it (default: squarest face); the
+        trace additionally gets a `shard` span covering params/input
+        placement and the fold span is tagged with the mesh label.
+        """
+        if devices:
+            return self._run_on_slice(batch, num_recycles, trace,
+                                      list(devices), mesh_shape)
         key = self.key_for(batch, num_recycles)
         args = (self.params, batch["seq"], batch["mask"], batch["msa"],
                 batch["msa_mask"])
-        fn = self._lookup(key)
+        cache_key = key + ((),)
+        fn = self._lookup(cache_key)
         if fn is None:
             with trace.span("compile", bucket_len=key[0],
                             batch_size=key[1], msa_depth=key[2],
                             num_recycles=key[3]):
-                fn = self._compile(key, args)
+                fn = self._compile(cache_key, key[3], args)
         with trace.span("fold", bucket_len=key[0]):
-            if self.faults is not None:
-                # injected exceptions/latency fire BEFORE the device
-                # call (a chaos fault must not waste real accelerator
-                # time); NaN-poison rows are patched in after
-                self.faults.on_executor_run(batch)
-            result = fn(*args)
-            result = jax.block_until_ready(result)
-            if self.faults is not None:
-                result = self.faults.mutate_result(batch, result)
-            return result
+            return self._invoke(fn, args, batch)
 
-    def warmup(self, keys: Iterable[ExecKey],
-               timer=None) -> int:
-        """Compile (and discard) each (len, batch, msa_depth, recycles)
-        signature with a zero batch. Returns the number of fresh
-        compiles. Optional `timer` is a profiling.StepTimer measuring
-        each warmup (== compile+first-run) wall time."""
+    def _run_on_slice(self, batch: dict, num_recycles: int, trace,
+                      devices, mesh_shape) -> FoldResult:
+        if mesh_shape is None:
+            mesh_shape = factor_chips(len(devices))
+        mesh_shape = tuple(int(x) for x in mesh_shape)
+        label = mesh_label(mesh_shape)
+        key = self.key_for(batch, num_recycles, mesh_shape=mesh_shape)
+        dev_ids = tuple(int(d.id) for d in devices)
+        cache_key = key + (dev_ids,)
+        with trace.span("shard", mesh=label, devices=len(devices)):
+            mesh, params = self._placed_params(devices, mesh_shape)
+            args = (params,) + self._place_inputs(batch, mesh, devices)
+        fn = self._lookup(cache_key)
+        if fn is None:
+            with trace.span("compile", bucket_len=key[0],
+                            batch_size=key[1], msa_depth=key[2],
+                            num_recycles=key[3], mesh=label):
+                fn = self._compile(cache_key, key[3], args, mesh=mesh)
+        with trace.span("fold", bucket_len=key[0], mesh=label):
+            # the lazy-compile fallback traces on first call, so the
+            # mesh context must be live during invocation too
+            ctx = use_mesh(mesh) if mesh is not None \
+                else contextlib.nullcontext()
+            with ctx:
+                return self._invoke(fn, args, batch)
+
+    def _invoke(self, fn, args, batch) -> FoldResult:
+        if self.faults is not None:
+            # injected exceptions/latency fire BEFORE the device
+            # call (a chaos fault must not waste real accelerator
+            # time); NaN-poison rows are patched in after
+            self.faults.on_executor_run(batch)
+        result = fn(*args)
+        result = jax.block_until_ready(result)
+        if self.faults is not None:
+            result = self.faults.mutate_result(batch, result)
+        return result
+
+    def warmup(self, keys: Iterable,
+               timer=None, devices: Optional[Sequence] = None,
+               mesh_shape: Optional[MeshShape] = None) -> int:
+        """Compile (and discard) each key's signature with a zero batch.
+        Keys may be legacy 4-tuples (len, batch, msa_depth, recycles) or
+        full ExecKeys; `devices`/`mesh_shape` warm the slice-bound
+        executable the scheduler will actually run (the mesh-aware
+        scheduler warms per bucket with the bucket's own lease).
+        Returns the number of fresh compiles. Optional `timer` is a
+        profiling.StepTimer measuring each warmup (== compile+first-run)
+        wall time."""
         fresh = 0
         for key in keys:
-            bucket_len, batch_size, msa_depth, num_recycles = key
+            bucket_len, batch_size, msa_depth, num_recycles = \
+                self._normalize_key(key)[:4]
             before = self.misses
             batch = {
                 "seq": jnp.zeros((batch_size, bucket_len), jnp.int32),
@@ -161,9 +325,11 @@ class FoldExecutor:
                     (batch_size, msa_depth, bucket_len), bool)
             if timer is not None:
                 with timer.measure():
-                    self.run(batch, num_recycles)
+                    self.run(batch, num_recycles, devices=devices,
+                             mesh_shape=mesh_shape)
             else:
-                self.run(batch, num_recycles)
+                self.run(batch, num_recycles, devices=devices,
+                         mesh_shape=mesh_shape)
             fresh += self.misses - before
         return fresh
 
@@ -173,4 +339,5 @@ class FoldExecutor:
                     "evictions": self.evictions,
                     "resident": len(self._cache),
                     "max_entries": self.max_entries,
-                    "keys": list(self._cache.keys())}
+                    "keys": [k[:6] for k in self._cache.keys()],
+                    "placed_param_slices": len(self._placed)}

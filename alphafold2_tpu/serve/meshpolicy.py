@@ -1,0 +1,364 @@
+"""Per-bucket mesh policy: match each length bucket to a device slice.
+
+The serving insight (ParaFold: match each workload to the pool shape
+that fits it; FastFold DAP: shard the O(L^2) pair representation at
+inference) is that one executor topology cannot serve both ends of the
+length distribution well:
+
+- SHORT buckets saturate a single chip at batch 1 — spreading them over
+  a mesh buys nothing and costs collective latency, so they stay on a
+  1-chip slice (and, with several 1-chip slices free, fold CONCURRENTLY
+  instead of queueing behind each other);
+- LONG/flagship buckets are HBM-bound: the pair track is O(L^2) in
+  activations, so past the single-chip ceiling the fold must 2-D shard
+  the pair axes (`parallel.mesh` i x j) across a multi-chip slice or it
+  simply cannot be served.
+
+`MeshPolicy` is the bucket -> slice-shape map the `serve.Scheduler`
+consults. Built explicitly (`MeshPolicy({64: 1, 512: 4})`) or derived
+(`MeshPolicy.from_model`) from an analytic HBM footprint
+(`FoldMemoryModel`) that picks the smallest power-of-two slice whose
+per-device bytes fit — and marks buckets no configured slice can hold,
+which the scheduler's admission guard rejects as status "too_large"
+instead of dying in an XLA OOM mid-batch.
+
+`DeviceSliceAllocator` hands out DISJOINT aligned device groups
+(`SliceLease`) so batches on different slices execute concurrently;
+the scheduler holds one lease per in-flight batch.
+
+Everything here is policy + bookkeeping: no jax computation happens in
+this module beyond enumerating devices, and a scheduler constructed
+with `mesh_policy=None` never touches it.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+MeshShape = Tuple[int, int]          # (i, j) pair-axis factorization
+
+
+def factor_chips(n: int) -> MeshShape:
+    """Canonical (i, j) factorization of an n-chip slice: both powers of
+    two, i <= j, i * j == n — the squarest face, so ring collectives
+    over the sharded pair axes stay short on an ICI torus."""
+    if n < 1 or n & (n - 1):
+        raise ValueError(f"slice size must be a power of two, got {n}")
+    i = 1
+    while (i * 2) * (i * 2) <= n:
+        i *= 2
+    return (i, n // i)
+
+
+def normalize_shape(shape: Union[int, Sequence[int]]) -> MeshShape:
+    """Accept an int chip count or an explicit (i, j) pair."""
+    if isinstance(shape, int):
+        return factor_chips(shape)
+    i, j = (int(x) for x in shape)
+    if i < 1 or j < 1:
+        raise ValueError(f"mesh shape must be positive, got {(i, j)}")
+    return (i, j)
+
+
+def mesh_label(shape: MeshShape) -> str:
+    """Stable human/metric label: (2, 4) -> '2x4'."""
+    return f"{shape[0]}x{shape[1]}"
+
+
+def chips_of(shape: MeshShape) -> int:
+    return shape[0] * shape[1]
+
+
+@dataclass
+class FoldMemoryModel:
+    """Analytic per-device HBM footprint of one fold batch.
+
+    Deliberately a handful of named terms, not a compiler: the point is
+    a monotone, explainable admission signal (BENCH_r05 showed the real
+    flagship at 15.63/16 GB — the terms below are the ones that put it
+    there), cross-checkable against `tools/memory_probe.py`'s XLA
+    memory analysis.
+
+    Terms, for a (B, L, M) batch on a `chips`-device slice:
+
+    - params: replicated per device (tensor-parallel placement shards
+      some projections, but counting them full keeps the guard
+      conservative);
+    - pair track: B * L^2 * (dim + heads) * dtype_bytes * pair_live —
+      activations plus attention logits; `pair_live` is the scan+remat
+      live-set coefficient (residual + recyclables + workspace), NOT
+      depth — remat keeps the live set O(1) in depth. 2-D sharded over
+      the slice, so divided by `chips`;
+    - msa track: B * M * L * dim * dtype_bytes * msa_live, sharded over
+      the i axis ONLY (msa_spec/fold_input_specs place nothing on j),
+      so it divides by the slice's i factor, not the chip count;
+    - distogram head: B * L^2 * distogram_buckets * 4, counted
+      replicated — it is the output the host gathers.
+    """
+
+    param_bytes: int
+    dim: int
+    heads: int = 8
+    dtype_bytes: int = 4
+    pair_live: float = 6.0
+    msa_live: float = 4.0
+    distogram_buckets: int = 37
+    hbm_bytes_per_device: int = 16 << 30
+
+    @classmethod
+    def from_model(cls, model, params, hbm_gb: float = 16.0,
+                   **overrides) -> "FoldMemoryModel":
+        import jax
+        import jax.numpy as jnp
+
+        param_bytes = sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(params)
+            if hasattr(leaf, "size"))
+        dtype = getattr(model, "dtype", None)
+        dtype_bytes = 2 if dtype == jnp.bfloat16 else 4
+        kwargs = dict(param_bytes=int(param_bytes), dim=int(model.dim),
+                      heads=int(getattr(model, "heads", 8)),
+                      dtype_bytes=dtype_bytes,
+                      hbm_bytes_per_device=int(hbm_gb * (1 << 30)))
+        kwargs.update(overrides)
+        return cls(**kwargs)
+
+    def fold_bytes(self, bucket_len: int, batch_size: int,
+                   msa_depth: int, chips: int = 1,
+                   shape: Optional[MeshShape] = None) -> int:
+        """Estimated peak per-device bytes for one fold batch. Pass the
+        actual slice `shape` when known (admits() does) — the MSA track
+        divides by its i factor only; a bare `chips` count prices the
+        canonical squarest factorization."""
+        L, B, M = int(bucket_len), int(batch_size), int(msa_depth)
+        if shape is not None:
+            i = max(int(shape[0]), 1)
+            chips = max(int(shape[0]) * int(shape[1]), 1)
+        else:
+            chips = max(int(chips), 1)
+            try:
+                i = factor_chips(chips)[0]
+            except ValueError:
+                i = 1
+        pair = B * L * L * (self.dim + self.heads) * self.dtype_bytes \
+            * self.pair_live
+        msa = B * max(M, 1) * L * self.dim * self.dtype_bytes \
+            * self.msa_live
+        dist = B * L * L * self.distogram_buckets * 4
+        return int(self.param_bytes + dist + pair / chips + msa / i)
+
+    def fits(self, bucket_len: int, batch_size: int, msa_depth: int,
+             chips: int = 1,
+             shape: Optional[MeshShape] = None) -> bool:
+        return self.fold_bytes(bucket_len, batch_size, msa_depth,
+                               chips, shape) <= self.hbm_bytes_per_device
+
+
+@dataclass
+class SliceLease:
+    """One acquired device slice; hold it for the duration of a batch."""
+
+    devices: List[object]
+    shape: MeshShape
+    start: int                       # first device index in the pool
+
+    @property
+    def label(self) -> str:
+        return mesh_label(self.shape)
+
+
+class DeviceSliceAllocator:
+    """Disjoint, aligned device slices over one device pool.
+
+    Slices of size n start at multiples of n (aligned), so the same
+    slice identities recur under low load and compiled executables
+    (bound to concrete devices) are reused instead of re-minted per
+    acquire. Thread-safe; `acquire` is non-blocking (the scheduler
+    worker only forms batches it can place), `acquire_blocking` exists
+    for warmup.
+    """
+
+    def __init__(self, devices: Sequence[object]):
+        self.devices = list(devices)
+        if not self.devices:
+            raise ValueError("allocator needs at least one device")
+        self._busy = [False] * len(self.devices)
+        self._cond = threading.Condition()
+
+    @property
+    def total_devices(self) -> int:
+        return len(self.devices)
+
+    @property
+    def busy_devices(self) -> int:
+        with self._cond:
+            return sum(self._busy)
+
+    def _find(self, size: int) -> Optional[int]:
+        """Caller holds self._cond: first free aligned group, or None."""
+        for start in range(0, len(self.devices) - size + 1, size):
+            if not any(self._busy[start:start + size]):
+                return start
+        return None
+
+    def can_allocate(self, shape: MeshShape) -> bool:
+        size = chips_of(shape)
+        if size > len(self.devices):
+            return False
+        with self._cond:
+            return self._find(size) is not None
+
+    def slices(self, shape: MeshShape) -> List[List[object]]:
+        """Every aligned device group this shape can ever be leased —
+        the set warmup must precompile, because an executable is bound
+        to its concrete devices and a batch that lands on a cold slice
+        pays a fresh XLA compile mid-serving."""
+        size = chips_of(shape)
+        if size > len(self.devices):
+            return []
+        return [self.devices[start:start + size]
+                for start in range(0, len(self.devices) - size + 1,
+                                   size)]
+
+    def acquire(self, shape: MeshShape) -> Optional[SliceLease]:
+        size = chips_of(shape)
+        if size > len(self.devices):
+            return None
+        with self._cond:
+            start = self._find(size)
+            if start is None:
+                return None
+            for k in range(start, start + size):
+                self._busy[k] = True
+        return SliceLease(self.devices[start:start + size], shape, start)
+
+    def acquire_blocking(self, shape: MeshShape,
+                         timeout_s: Optional[float] = None) -> SliceLease:
+        size = chips_of(shape)
+        if size > len(self.devices):
+            raise ValueError(
+                f"slice of {size} devices > pool of {len(self.devices)}")
+        with self._cond:
+            while True:
+                start = self._find(size)
+                if start is not None:
+                    for k in range(start, start + size):
+                        self._busy[k] = True
+                    return SliceLease(self.devices[start:start + size],
+                                      shape, start)
+                if not self._cond.wait(timeout=timeout_s):
+                    raise TimeoutError(
+                        f"no free {mesh_label(shape)} slice within "
+                        f"{timeout_s}s")
+
+    def release(self, lease: SliceLease):
+        size = chips_of(lease.shape)
+        with self._cond:
+            for k in range(lease.start, lease.start + size):
+                self._busy[k] = False
+            self._cond.notify_all()
+
+    def snapshot(self) -> dict:
+        with self._cond:
+            busy = sum(self._busy)
+        return {"total_devices": len(self.devices), "busy_devices": busy}
+
+
+class MeshPolicy:
+    """bucket_len -> device-slice shape, plus the HBM admission model.
+
+    shapes: mapping of bucket edge -> slice (an int chip count or an
+        explicit (i, j) pair). Buckets absent from the map default to a
+        single chip. Shapes larger than the device pool are CLAMPED to
+        the largest power-of-two slice the pool holds (recorded in
+        `clamped` and the snapshot) so a policy written for an 8-chip
+        host degrades cleanly on a 1-device CI runner.
+    devices: the device pool to slice (default: jax.devices()).
+    memory: optional FoldMemoryModel backing `admits()`; None admits
+        everything (the guard is opt-in like everything else here).
+    """
+
+    def __init__(self, shapes: Mapping[int, Union[int, Sequence[int]]],
+                 devices: Optional[Sequence[object]] = None,
+                 memory: Optional[FoldMemoryModel] = None):
+        if devices is None:
+            import jax
+            devices = jax.devices()
+        self.devices = list(devices)
+        n_dev = len(self.devices)
+        cap = 1
+        while cap * 2 <= n_dev:
+            cap *= 2
+        self.shapes: Dict[int, MeshShape] = {}
+        self.clamped: Dict[int, str] = {}
+        for bucket, s in shapes.items():
+            shape = normalize_shape(s)
+            if chips_of(shape) > n_dev:
+                self.clamped[int(bucket)] = mesh_label(shape)
+                shape = factor_chips(cap)
+            self.shapes[int(bucket)] = shape
+        self.memory = memory
+
+    @classmethod
+    def from_model(cls, model, params, buckets: Sequence[int],
+                   max_batch: int = 1, msa_depth: int = 0,
+                   hbm_gb: float = 16.0,
+                   devices: Optional[Sequence[object]] = None,
+                   max_chips: Optional[int] = None,
+                   **memory_overrides) -> "MeshPolicy":
+        """Derive the policy analytically: for each bucket edge, the
+        smallest power-of-two slice whose estimated per-device footprint
+        fits `hbm_gb`. A bucket that does not fit even the largest slice
+        still gets that slice in the map but fails `admits()` — the
+        scheduler rejects it at submit as "too_large"."""
+        if devices is None:
+            import jax
+            devices = jax.devices()
+        edges = getattr(buckets, "edges", buckets)
+        memory = FoldMemoryModel.from_model(model, params, hbm_gb=hbm_gb,
+                                            **memory_overrides)
+        cap = min(max_chips or len(devices), len(devices))
+        shapes: Dict[int, int] = {}
+        for edge in edges:
+            n = 1
+            while not memory.fits(edge, max_batch, msa_depth, n) \
+                    and n * 2 <= cap:
+                n *= 2
+            shapes[int(edge)] = n
+        return cls(shapes, devices=devices, memory=memory)
+
+    def shape_for(self, bucket_len: int) -> MeshShape:
+        return self.shapes.get(int(bucket_len), (1, 1))
+
+    def chips_for(self, bucket_len: int) -> int:
+        return chips_of(self.shape_for(bucket_len))
+
+    def admits(self, bucket_len: int, batch_size: int, msa_depth: int)\
+            -> bool:
+        """False when the bucket's configured slice — already the
+        largest one the policy was willing/able to assign — cannot hold
+        the batch's analytic footprint. The scheduler maps False to
+        status "too_large" at submit."""
+        if self.memory is None:
+            return True
+        return self.memory.fits(bucket_len, batch_size, msa_depth,
+                                shape=self.shape_for(bucket_len))
+
+    def allocator(self) -> DeviceSliceAllocator:
+        return DeviceSliceAllocator(self.devices)
+
+    def snapshot(self) -> dict:
+        snap = {
+            "devices": len(self.devices),
+            "policy": {str(b): mesh_label(s)
+                       for b, s in sorted(self.shapes.items())},
+        }
+        if self.clamped:
+            snap["clamped"] = {str(b): lbl
+                               for b, lbl in sorted(self.clamped.items())}
+        if self.memory is not None:
+            snap["hbm_bytes_per_device"] = self.memory.hbm_bytes_per_device
+        return snap

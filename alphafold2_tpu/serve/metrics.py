@@ -49,6 +49,8 @@ class ServeMetrics:
         self.degraded = 0           # fast-shed while the breaker is open
         self.poisoned = 0           # quarantined poison terminal states
         self.retried = 0            # re-enqueues after transient failures
+        # HBM admission guard (zero without a mesh policy)
+        self.too_large = 0          # rejected: exceeds largest mesh slice
         self.batches = 0
         self.queue_depth = 0
         # result-cache outcomes at submit (all zero when caching is off)
@@ -129,6 +131,11 @@ class ServeMetrics:
         with self._lock:
             self.poisoned += n
         self._m_outcomes.inc(n, outcome="poisoned")
+
+    def record_too_large(self, n: int = 1):
+        with self._lock:
+            self.too_large += n
+        self._m_outcomes.inc(n, outcome="too_large")
 
     def record_retried(self, n: int = 1):
         """Requests re-enqueued after a transient batch failure (NOT a
@@ -245,6 +252,7 @@ class ServeMetrics:
                 "degraded": self.degraded,
                 "poisoned": self.poisoned,
                 "retried": self.retried,
+                "too_large": self.too_large,
                 "batches": self.batches,
                 "queue_depth": self.queue_depth,
                 "padding_waste": waste,

@@ -77,7 +77,10 @@ class FoldResponse:
             submit while the scheduler recovers) |
             "poisoned" (the request's content key is quarantined as a
             poison input — it failed deterministically in isolation or
-            produced non-finite output; duplicates fail fast forever).
+            produced non-finite output; duplicates fail fast forever) |
+            "too_large" (mesh-aware scheduler only: the analytic HBM
+            footprint exceeds the largest configured device slice, so
+            the fold is rejected at submit instead of OOMing mid-batch).
     source: how the result was obtained — "fold" (ran on the
             accelerator), "cache" (content-addressed result store hit),
             "coalesced" (attached to an identical in-flight fold; for

@@ -73,11 +73,25 @@ consecutive systemic failures — novel submits fast-shed with status
 "degraded" while cache/coalesce hits keep serving, then a half-open
 probe batch closes the breaker when the device recovers.
 
+With a `mesh_policy` (serve.meshpolicy.MeshPolicy — OFF by default,
+and with it off this scheduler is byte-for-byte the single-chip
+behavior), serving becomes mesh-aware end to end: each bucket maps to
+a device-slice shape (1 chip for short buckets, a 2/4/8-chip
+pair-sharded mesh for long ones, chosen by an analytic HBM model), a
+DeviceSliceAllocator hands each formed batch a DISJOINT slice so short
+traffic no longer queues behind a flagship fold (batches on different
+slices execute concurrently on a small pool of dispatch threads), the
+executor lowers long-bucket folds under `parallel.mesh` with params
+sharded once per slice, and submits whose analytic footprint exceeds
+even the largest configured slice resolve status "too_large"
+(`serve_too_large_total`) instead of dying in an XLA OOM mid-batch.
+`serve_stats()["mesh"]` reports the policy, per-shape fold counts, and
+allocator occupancy; fold spans are tagged with their mesh label and a
+`shard` span prices params/input placement in the waterfall.
+
 Batches are always padded to `max_batch_size` (bucketing.assemble), so
 the compiled-shape set is closed: one executable per (bucket,
-num_recycles), never one per observed batch size. The scheduler/executor
-seam is deliberate — a later multi-chip server replaces FoldExecutor
-with a `parallel.mesh`-sharded one and this file does not change.
+num_recycles), never one per observed batch size.
 """
 
 from __future__ import annotations
@@ -88,6 +102,7 @@ import random
 import threading
 import time
 from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -99,6 +114,7 @@ from alphafold2_tpu.obs.trace import (MultiTrace, NULL_TRACE, NULL_TRACER,
                                       Tracer)
 from alphafold2_tpu.serve.bucketing import BucketPolicy
 from alphafold2_tpu.serve.executor import FoldExecutor
+from alphafold2_tpu.serve.meshpolicy import MeshPolicy, SliceLease
 from alphafold2_tpu.serve.metrics import ServeMetrics
 from alphafold2_tpu.serve.request import (FoldRequest, FoldResponse,
                                           FoldTicket)
@@ -226,6 +242,12 @@ class Scheduler:
         "poisoned" from the first submit — a restarted replica never
         re-pays the bisection executions for a known poison. Put it
         next to the cache dir; the keys are the same content digests.
+    mesh_policy: optional serve.meshpolicy.MeshPolicy (OFF when None —
+        the default, which byte-for-byte preserves single-chip
+        behavior). Requires a mesh-capable executor (FoldExecutor is).
+        Buckets route to their policy slice, disjoint slices fold
+        concurrently, and the analytic HBM admission guard rejects
+        folds no configured slice can hold (status "too_large").
     """
 
     def __init__(self, executor: FoldExecutor, buckets: BucketPolicy,
@@ -238,7 +260,8 @@ class Scheduler:
                  router=None,
                  retry: Optional[RetryPolicy] = None,
                  executor_factory: Optional[Callable[[], object]] = None,
-                 quarantine_path: Optional[str] = None):
+                 quarantine_path: Optional[str] = None,
+                 mesh_policy: Optional[MeshPolicy] = None):
         self.executor = executor
         self.buckets = buckets
         self.config = config or SchedulerConfig()
@@ -295,6 +318,39 @@ class Scheduler:
             self._c_nonfinite = reg.counter(
                 "serve_nonfinite_outputs_total",
                 "fold outputs rejected by non-finite validation")
+        self.mesh_policy = mesh_policy
+        self._allocator = None
+        self._mesh_pool: Optional[ThreadPoolExecutor] = None
+        self._inflight_execs = 0        # guarded by _cond (mesh only)
+        self._mesh_batches: Dict[str, int] = {}   # label -> batch count
+        self._mesh_served: Dict[str, int] = {}    # label -> served reqs
+        if mesh_policy is not None:
+            self._allocator = mesh_policy.allocator()
+            # read-busy + set-gauge must be one atomic step: two pool
+            # threads releasing concurrently could otherwise publish a
+            # stale nonzero occupancy that sticks until the next lease
+            self._gauge_lock = threading.Lock()
+            # one executable per (bucket, aligned slice) must fit the
+            # LRU or warmup evicts its own work and serving pays the
+            # cold mid-batch compile anyway — the scheduler knows the
+            # policy and the allocator, so the sizing lives here, not
+            # in every caller
+            if hasattr(executor, "max_entries"):
+                needed = sum(
+                    len(self._allocator.slices(
+                        mesh_policy.shape_for(edge)))
+                    for edge in self.buckets.edges)
+                executor.max_entries = max(executor.max_entries, needed)
+            self._c_mesh_folds = reg.counter(
+                "serve_mesh_folds_total",
+                "fold batches executed, by mesh shape", ("mesh",))
+            self._g_mesh_busy = reg.gauge(
+                "serve_mesh_busy_devices",
+                "devices currently leased to in-flight fold batches")
+            self._c_too_large = reg.counter(
+                "serve_too_large_total",
+                "folds rejected by the HBM admission guard: footprint "
+                "exceeds the largest configured mesh slice")
         self._c_drains = reg.counter(
             "serve_drains_total", "graceful drains started")
         self._c_failovers = reg.counter(
@@ -312,6 +368,24 @@ class Scheduler:
         self._outstanding_forwards = 0   # guarded by _cond
         self._worker: Optional[threading.Thread] = None
 
+    # -- model identity ---------------------------------------------------
+
+    @property
+    def model_tag(self) -> str:
+        return self._model_tag
+
+    @model_tag.setter
+    def model_tag(self, tag: str):
+        """Reassigning the tag (a weight rollout — fleet.RolloutState
+        subscribers do exactly this) re-keys every subsequent cache
+        submit AND re-tags the executor, whose ExecKeys carry the tag:
+        a rolled scheduler can never serve an executable compiled under
+        the previous weights' identity (ISSUE 7 staleness fix)."""
+        self._model_tag = tag
+        ex = getattr(self, "executor", None)
+        if ex is not None and hasattr(ex, "model_tag"):
+            ex.model_tag = tag
+
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> "Scheduler":
@@ -321,6 +395,10 @@ class Scheduler:
             self._running = True
             self._drain = True
             self._draining = False
+        if self._allocator is not None and self._mesh_pool is None:
+            self._mesh_pool = ThreadPoolExecutor(
+                max_workers=max(1, self._allocator.total_devices),
+                thread_name_prefix="serve-mesh")
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="serve-scheduler")
         self._worker.start()
@@ -337,6 +415,11 @@ class Scheduler:
         if self._worker is not None:
             self._worker.join()
             self._worker = None
+        if self._mesh_pool is not None:
+            # the worker already waited out in-flight mesh executions,
+            # so this is a fast thread teardown; start() re-creates it
+            self._mesh_pool.shutdown(wait=True)
+            self._mesh_pool = None
 
     def drain(self, timeout_s: float = 30.0) -> bool:
         """Graceful drain — THE process-level shutdown path (wire it to
@@ -391,12 +474,17 @@ class Scheduler:
             depth = self._depth
             running = self._running
             draining = self._draining
-        return {"running": running,
-                "draining": draining,
-                "queue_depth": depth,
-                "breaker": (None if self._breaker is None
-                            else self._breaker.state),
-                "model_tag": self.model_tag}
+        payload = {"running": running,
+                   "draining": draining,
+                   "queue_depth": depth,
+                   "breaker": (None if self._breaker is None
+                               else self._breaker.state),
+                   "model_tag": self.model_tag}
+        if self._allocator is not None:
+            # mesh occupancy rides the one health payload every probe
+            # shares, so the fleet front door / peer probes see it free
+            payload["mesh"] = self._allocator.snapshot()
+        return payload
 
     def __enter__(self) -> "Scheduler":
         return self.start()
@@ -409,12 +497,29 @@ class Scheduler:
         real request pays queueing, not XLA. Returns fresh compiles.
         Defaults to the config's pinned msa_depth; the guarantee only
         holds when serving shapes are pinned to match (config.msa_depth,
-        or uniform-depth traffic equal to this depth)."""
+        or uniform-depth traffic equal to this depth). With a mesh
+        policy, each bucket warms on EVERY aligned slice of its shape:
+        executables are bound to concrete devices, so a batch dispatched
+        to a cold slice would pay a fresh XLA compile mid-serving —
+        exactly the unlucky-first-request cost warmup exists to
+        pre-pay. (Run warmup before start(); it touches slices without
+        leasing them.)"""
         if msa_depth is None:
             msa_depth = self.config.msa_depth or 0
         keys = [(edge, self.config.max_batch_size, msa_depth,
                  self.config.num_recycles) for edge in self.buckets.edges]
-        return self.executor.warmup(keys)
+        if self._allocator is None:
+            return self.executor.warmup(keys)
+        fresh = 0
+        for key in keys:
+            if not self.mesh_policy.admits(key[0], key[1], key[2]):
+                continue     # the guard rejects this bucket at submit;
+                #              compiling it would be the OOM we prevent
+            shape = self.mesh_policy.shape_for(key[0])
+            for devices in self._allocator.slices(shape):
+                fresh += self.executor.warmup(
+                    [key], devices=devices, mesh_shape=shape)
+        return fresh
 
     # -- submission ------------------------------------------------------
 
@@ -441,6 +546,26 @@ class Scheduler:
             entry.trace.finish("rejected", error="draining")
             raise DrainingError(
                 "Scheduler draining: not admitting new requests")
+        # HBM admission guard: a fold whose analytic footprint exceeds
+        # even the largest configured mesh slice would die in an XLA
+        # OOM mid-batch, taking its whole cohort with it — reject it at
+        # the door instead. An unpinned msa_depth (None) prices the
+        # REQUEST's own depth: assemble pads the batch to its members'
+        # max, so each member is priced at (at least) what it brings.
+        # A store hit still serves (mirroring degraded mode — a cached
+        # result costs no device memory); only coalescing/forwarding is
+        # pointless for work this process can never execute.
+        if self.mesh_policy is not None:
+            guard_msa = self.config.msa_depth
+            if guard_msa is None:
+                guard_msa = 0 if request.msa is None \
+                    else int(request.msa.shape[0])
+            if not self.mesh_policy.admits(
+                    bucket_len, self.config.max_batch_size, guard_msa):
+                self._raise_unless_running(entry)
+                if not self._serve_too_large_from_cache(entry):
+                    self._too_large_shed(entry)
+                return entry.ticket
         # quarantined poison fails fast BEFORE cache/coalesce/forward:
         # a known-bad key must not re-fold, park followers, or burn a
         # forwarding hop
@@ -635,6 +760,48 @@ class Scheduler:
             error=f"request key quarantined as poison "
                   f"({self._quarantine.reason(key)}); failing fast"))
         return True
+
+    def _serve_too_large_from_cache(self, entry: _Entry) -> bool:
+        """Store-only lookup for a fold the admission guard would
+        reject: a result computed elsewhere (a peer with bigger slices,
+        an offline warm, this replica before a policy change) serves at
+        zero device cost. No coalescing — there is no in-flight leader
+        to park behind for work this process can never execute."""
+        if self.cache is None:
+            return False
+        try:
+            key = self._entry_key(entry)
+            if key is None:
+                return False
+            cached = self.cache.get(key, trace=entry.trace)
+        except Exception:
+            return False
+        if cached is None:
+            return False
+        self.metrics.record_cache_hit()
+        entry.resolve(FoldResponse(
+            request_id=entry.request.request_id, status="ok",
+            coords=cached.coords.copy(),
+            confidence=cached.confidence.copy(),
+            bucket_len=entry.bucket_len,
+            latency_s=time.monotonic() - entry.enqueued_at,
+            source="cache"))
+        return True
+
+    def _too_large_shed(self, entry: _Entry):
+        """HBM admission guard fast path: resolve a fold no configured
+        mesh slice can hold as status "too_large" without enqueueing."""
+        self.metrics.record_too_large()
+        self._c_too_large.inc()
+        entry.trace.event("too_large")
+        chips = self.mesh_policy.chips_for(entry.bucket_len)
+        entry.resolve(FoldResponse(
+            request_id=entry.request.request_id, status="too_large",
+            bucket_len=entry.bucket_len,
+            latency_s=time.monotonic() - entry.enqueued_at,
+            error=f"analytic HBM footprint of bucket {entry.bucket_len} "
+                  f"exceeds the largest configured mesh slice "
+                  f"({chips} chips); rejected by the admission guard"))
 
     def _degraded_shed(self, entry: _Entry):
         """Breaker-open fast path: resolve a novel submit as
@@ -927,6 +1094,16 @@ class Scheduler:
                 "watchdog_s": self.retry.watchdog_s,
                 "max_attempts": self.retry.max_attempts,
             }
+        if self.mesh_policy is not None:
+            with self._cond:
+                folds = {label: {"batches": self._mesh_batches[label],
+                                 "served": self._mesh_served.get(label, 0)}
+                         for label in sorted(self._mesh_batches)}
+                inflight = self._inflight_execs
+            stats["mesh"] = dict(self.mesh_policy.snapshot(),
+                                 allocator=self._allocator.snapshot(),
+                                 inflight_batches=inflight,
+                                 folds=folds)
         with self._cond:
             stats["running"] = self._running
             stats["draining"] = self._draining
@@ -972,11 +1149,22 @@ class Scheduler:
             batch = self._form_batch(stopping)
             just_executed = batch is not None
             if batch is not None:
-                self._execute(*batch)
+                self._dispatch(*batch)
                 continue
             if stopping:
                 with self._cond:
                     if self._incoming or any(self._pending.values()):
+                        if self._allocator is not None:
+                            # every eligible slice is busy: wait for a
+                            # completion to free one, don't hot-spin
+                            self._cond.wait(timeout=poll_s)
+                        continue
+                    if self._inflight_execs > 0:
+                        # mesh batches still running on the dispatch
+                        # pool: a drained stop means every ticket
+                        # resolved, so wait them out (they may also
+                        # requeue retries — re-check from the top)
+                        self._cond.wait(timeout=poll_s)
                         continue
                 break
 
@@ -1051,6 +1239,13 @@ class Scheduler:
         for bucket_len, entries in self._pending.items():
             if not entries:
                 continue
+            # mesh: a bucket whose slice shape has no free devices is
+            # not ready — forming its batch would just park it; other
+            # buckets' slices may be free right now
+            if self._allocator is not None and not \
+                    self._allocator.can_allocate(
+                        self.mesh_policy.shape_for(bucket_len)):
+                continue
             cand = self._bucket_candidate(entries, stopping, now)
             if cand is not None and (best is None or cand[0] < best[0]):
                 best = (cand[0], bucket_len, cand[1])
@@ -1116,7 +1311,58 @@ class Scheduler:
                                               e.enqueued_at))
         return oldest, take[:cfg.max_batch_size]
 
-    def _execute(self, bucket_len: int, entries: List[_Entry]):
+    def _dispatch(self, bucket_len: int, entries: List[_Entry]):
+        """Run one formed batch: inline (the classic single-chip path,
+        byte-for-byte the old behavior) or, with a mesh policy, on a
+        leased device slice via the dispatch pool — so batches holding
+        DISJOINT slices execute concurrently and short traffic never
+        queues behind a flagship fold."""
+        if self._allocator is None:
+            self._execute(bucket_len, entries)
+            return
+        lease = self._allocator.acquire(
+            self.mesh_policy.shape_for(bucket_len))
+        if lease is None:
+            # _form_batch checked availability and the worker is the
+            # only acquirer, so this is unreachable in practice — but a
+            # policy/allocator bug must degrade to a serial fold on the
+            # default device, never lose the batch
+            self._execute(bucket_len, entries)
+            return
+        self._set_busy_gauge()
+        with self._cond:
+            self._inflight_execs += 1
+        try:
+            self._mesh_pool.submit(self._execute_on_lease, bucket_len,
+                                   entries, lease)
+        except BaseException:
+            # pool unavailable (shutdown race): fall back inline
+            self._release_lease(lease)
+            with self._cond:
+                self._inflight_execs -= 1
+                self._cond.notify_all()
+            self._execute(bucket_len, entries)
+
+    def _execute_on_lease(self, bucket_len: int, entries: List[_Entry],
+                          lease: SliceLease):
+        try:
+            self._execute(bucket_len, entries, lease=lease)
+        finally:
+            self._release_lease(lease)
+            with self._cond:
+                self._inflight_execs -= 1
+                self._cond.notify_all()
+
+    def _release_lease(self, lease: SliceLease):
+        self._allocator.release(lease)
+        self._set_busy_gauge()
+
+    def _set_busy_gauge(self):
+        with self._gauge_lock:
+            self._g_mesh_busy.set(self._allocator.busy_devices)
+
+    def _execute(self, bucket_len: int, entries: List[_Entry],
+                 lease: Optional[SliceLease] = None):
         cfg = self.config
         t0 = time.monotonic()
         if self.tracer.enabled:
@@ -1140,7 +1386,7 @@ class Scheduler:
                 batch, waste = self.buckets.assemble(
                     [e.request for e in entries], bucket_len,
                     cfg.max_batch_size, msa_depth=cfg.msa_depth)
-            result = self._run_executor(batch, batch_trace)
+            result = self._run_executor(batch, batch_trace, lease)
             coords = np.asarray(result.coords)
             confidence = np.asarray(result.confidence)
         except Exception as exc:  # resolve/retry, never kill the worker
@@ -1210,7 +1456,14 @@ class Scheduler:
                             error=f"post-fold resolution failed: "
                                   f"{exc!r}"))
             return
+        if lease is not None:
+            self._c_mesh_folds.inc(mesh=lease.label)
         with self._cond:
+            if lease is not None:
+                self._mesh_batches[lease.label] = \
+                    self._mesh_batches.get(lease.label, 0) + 1
+                self._mesh_served[lease.label] = \
+                    self._mesh_served.get(lease.label, 0) + len(entries)
             depth = self._depth
         try:
             self.metrics.record_batch(
@@ -1227,18 +1480,25 @@ class Scheduler:
 
     # -- resilience: worker side -----------------------------------------
 
-    def _run_executor(self, batch: dict, batch_trace):
+    def _run_executor(self, batch: dict, batch_trace,
+                      lease: Optional[SliceLease] = None):
         """executor.run with the optional per-batch watchdog deadline.
-        The trace kwarg is only passed when tracing, so alternate
-        executors (tests, the future mesh-sharded one) needn't know
-        about obs; `self.executor` is read inside the closure so a
-        rebuild between batches takes effect immediately."""
-        if batch_trace is NULL_TRACE:
+        The trace/devices kwargs are only passed when in use, so
+        alternate executors (tests) needn't know about obs or meshes;
+        `self.executor` is read inside the closure so a rebuild between
+        batches takes effect immediately."""
+        kw = {}
+        if batch_trace is not NULL_TRACE:
+            kw["trace"] = batch_trace
+        if lease is not None:
+            kw["devices"] = lease.devices
+            kw["mesh_shape"] = lease.shape
+        if kw:
             call = lambda: self.executor.run(  # noqa: E731
-                batch, self.config.num_recycles)
+                batch, self.config.num_recycles, **kw)
         else:
             call = lambda: self.executor.run(  # noqa: E731
-                batch, self.config.num_recycles, trace=batch_trace)
+                batch, self.config.num_recycles)
         watchdog_s = None if self.retry is None else self.retry.watchdog_s
         if watchdog_s is None:
             return call()
@@ -1362,11 +1622,14 @@ class Scheduler:
             e.not_before = not_before
             if tracing:
                 e.trace.begin("retry")
-        # _pending is worker-owned (we ARE the worker); only the depth
-        # accounting needs the lock
-        self._pending.setdefault(bucket_len, []).extend(entries)
+        # through _incoming, NOT _pending: with a mesh policy this runs
+        # on a dispatch-pool thread while the worker owns _pending; the
+        # worker moves incoming entries into their bucket under _cond,
+        # so the requeue is race-free on both paths
         with self._cond:
+            self._incoming.extend(entries)
             self._depth += len(entries)
+            self._cond.notify_all()
 
     def _rebuild_executor(self):
         """Watchdog fired: swap the executor for a fresh one. The hung
@@ -1376,6 +1639,8 @@ class Scheduler:
         try:
             if self.executor_factory is not None:
                 self.executor = self.executor_factory()
+                if hasattr(self.executor, "model_tag"):
+                    self.executor.model_tag = self._model_tag
             elif hasattr(self.executor, "rebuild"):
                 self.executor = self.executor.rebuild()
             else:

@@ -205,7 +205,9 @@ class TestExecutor:
         ex.run(b32, 0)                       # evicts the 16-bucket entry
         stats = ex.stats()
         assert stats["evictions"] == 1 and stats["resident"] == 1
-        assert stats["keys"] == [(32, 1, MSA_DEPTH, 0)]
+        # ExecKey grew (mesh_shape, model_tag) in ISSUE 7 (see
+        # MIGRATING): single-chip untagged executors key as (1,1)/""
+        assert stats["keys"] == [(32, 1, MSA_DEPTH, 0, (1, 1), "")]
         ex.run(b16, 0)                       # cold again after eviction
         assert ex.stats()["misses"] == 3
 

@@ -1,0 +1,579 @@
+"""Mesh-aware serving tests (ISSUE 7) on the virtual 8-device CPU
+platform: per-bucket mesh policy + analytic HBM admission, the
+slice allocator, the mesh-capable FoldExecutor (sharded == single-chip
+numerics, ExecKey staleness fixes), and the scheduler's concurrent
+disjoint-slice dispatch — plus the mesh_policy=None byte-identical
+regression guard."""
+
+import json
+import threading
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu import Alphafold2
+from alphafold2_tpu.data.synthetic import synthetic_requests
+from alphafold2_tpu.obs.registry import MetricsRegistry
+from alphafold2_tpu.serve import (BucketPolicy, DeviceSliceAllocator,
+                                  FoldExecutor, FoldMemoryModel,
+                                  FoldRequest, MeshPolicy, Scheduler,
+                                  SchedulerConfig, ServeMetrics)
+from alphafold2_tpu.serve.meshpolicy import (factor_chips, mesh_label,
+                                             normalize_shape)
+
+MSA_DEPTH = 3
+
+multichip = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = Alphafold2(dim=32, depth=1, heads=2, dim_head=16,
+                       predict_coords=True, structure_module_depth=1)
+    n = 16
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, n), jnp.int32),
+        msa=jnp.zeros((1, MSA_DEPTH, n), jnp.int32),
+        mask=jnp.ones((1, n), bool),
+        msa_mask=jnp.ones((1, MSA_DEPTH, n), bool))
+    return model, params
+
+
+def _batch(bucket_len=16, batch=2, msa_depth=MSA_DEPTH, seed=0):
+    rng = np.random.default_rng(seed)
+    out = {"seq": jnp.asarray(
+               rng.integers(0, 20, (batch, bucket_len)), jnp.int32),
+           "mask": jnp.ones((batch, bucket_len), bool),
+           "msa": None, "msa_mask": None}
+    if msa_depth:
+        out["msa"] = jnp.asarray(
+            rng.integers(0, 20, (batch, msa_depth, bucket_len)),
+            jnp.int32)
+        out["msa_mask"] = jnp.ones((batch, msa_depth, bucket_len), bool)
+    return out
+
+
+@pytest.mark.quick
+class TestMeshShapes:
+    def test_factor_chips(self):
+        assert factor_chips(1) == (1, 1)
+        assert factor_chips(2) == (1, 2)
+        assert factor_chips(4) == (2, 2)
+        assert factor_chips(8) == (2, 4)
+        with pytest.raises(ValueError):
+            factor_chips(3)
+
+    def test_normalize_and_label(self):
+        assert normalize_shape(4) == (2, 2)
+        assert normalize_shape((4, 2)) == (4, 2)
+        assert mesh_label((2, 4)) == "2x4"
+
+
+@pytest.mark.quick
+class TestFoldMemoryModel:
+    def test_monotone_in_length_and_sharding(self):
+        mem = FoldMemoryModel(param_bytes=1 << 20, dim=64, heads=4)
+        b16 = mem.fold_bytes(16, 2, 3)
+        b64 = mem.fold_bytes(64, 2, 3)
+        b256 = mem.fold_bytes(256, 2, 3)
+        assert b16 < b64 < b256                   # O(L^2) dominates
+        # sharding divides the activation terms, never below params
+        assert mem.fold_bytes(256, 2, 3, chips=4) < b256
+        assert mem.fold_bytes(256, 2, 3, chips=8) \
+            >= mem.param_bytes
+
+    def test_msa_term_shards_over_i_only(self):
+        """The MSA track is sharded over the i axis only (msa_spec /
+        fold_input_specs place nothing on j): a (1, 8) slice leaves the
+        MSA replicated while (8, 1) divides it 8-fold — the footprint
+        must price the actual shape, not the chip count."""
+        mem = FoldMemoryModel(param_bytes=0, dim=64, heads=4)
+        wide_j = mem.fold_bytes(256, 1, 512, shape=(1, 8))
+        wide_i = mem.fold_bytes(256, 1, 512, shape=(8, 1))
+        assert wide_j > wide_i
+        # bare chip count prices the canonical squarest factorization
+        assert mem.fold_bytes(256, 1, 512, chips=8) \
+            == mem.fold_bytes(256, 1, 512, shape=(2, 4))
+
+    def test_fits_boundary(self):
+        mem = FoldMemoryModel(param_bytes=0, dim=32, heads=2,
+                              hbm_bytes_per_device=10 << 20)
+        assert mem.fits(16, 2, 3)
+        assert not mem.fits(2048, 2, 3)
+        # a bucket that misses single-chip can fit a bigger slice
+        for L in (128, 256, 512):
+            if not mem.fits(L, 2, 3, 1):
+                assert mem.fold_bytes(L, 2, 3, 8) \
+                    < mem.fold_bytes(L, 2, 3, 1)
+
+    def test_from_model_reads_params(self, model_and_params):
+        model, params = model_and_params
+        mem = FoldMemoryModel.from_model(model, params, hbm_gb=16.0)
+        n_params = sum(leaf.size for leaf in jax.tree.leaves(params))
+        assert mem.param_bytes == n_params * 4
+        assert mem.dim == 32 and mem.heads == 2
+
+
+@pytest.mark.quick
+class TestMeshPolicy:
+    def test_shape_map_and_default(self):
+        pol = MeshPolicy({32: 1, 512: 4}, devices=list(range(8)))
+        assert pol.shape_for(32) == (1, 1)
+        assert pol.shape_for(512) == (2, 2)
+        assert pol.shape_for(64) == (1, 1)      # unmapped -> single chip
+        assert pol.chips_for(512) == 4
+        assert pol.snapshot()["policy"] == {"32": "1x1", "512": "2x2"}
+
+    def test_clamps_to_device_pool(self):
+        pol = MeshPolicy({512: 8}, devices=list(range(2)))
+        assert pol.chips_for(512) == 2
+        assert pol.snapshot()["clamped"] == {"512": "2x4"}
+        # degenerate 1-device pool: everything single-chip, no crash
+        pol1 = MeshPolicy({512: 8}, devices=list(range(1)))
+        assert pol1.shape_for(512) == (1, 1)
+
+    def test_from_model_picks_smallest_fitting_slice(self,
+                                                     model_and_params):
+        model, params = model_and_params
+        pol = MeshPolicy.from_model(
+            model, params, BucketPolicy((32, 64, 512)), max_batch=2,
+            msa_depth=MSA_DEPTH, hbm_gb=0.01, devices=list(range(8)))
+        mem = pol.memory
+        # every assigned slice is the SMALLEST fitting power of two
+        for edge in (32, 64, 512):
+            chips = pol.chips_for(edge)
+            if mem.fits(edge, 2, MSA_DEPTH, chips) and chips > 1:
+                assert not mem.fits(edge, 2, MSA_DEPTH, chips // 2)
+        # short buckets stay single-chip at this budget
+        assert pol.chips_for(32) == 1
+
+    def test_admits(self, model_and_params):
+        model, params = model_and_params
+        pol = MeshPolicy.from_model(
+            model, params, BucketPolicy((32, 4096)), max_batch=2,
+            msa_depth=MSA_DEPTH, hbm_gb=0.01, devices=list(range(8)))
+        assert pol.admits(32, 2, MSA_DEPTH)
+        assert not pol.admits(4096, 2, MSA_DEPTH)
+        # no memory model -> admit everything
+        assert MeshPolicy({}, devices=[0]).admits(4096, 2, MSA_DEPTH)
+
+
+@pytest.mark.quick
+class TestDeviceSliceAllocator:
+    def test_aligned_disjoint_slices(self):
+        alloc = DeviceSliceAllocator(list(range(8)))
+        a = alloc.acquire((2, 2))
+        b = alloc.acquire((2, 2))
+        assert a.devices == [0, 1, 2, 3] and b.devices == [4, 5, 6, 7]
+        assert alloc.acquire((1, 1)) is None     # pool exhausted
+        assert not alloc.can_allocate((1, 1))
+        alloc.release(a)
+        c = alloc.acquire((1, 2))
+        assert c.devices == [0, 1]               # aligned reuse
+        assert alloc.busy_devices == 6
+
+    def test_oversized_and_snapshot(self):
+        alloc = DeviceSliceAllocator(list(range(2)))
+        assert alloc.acquire((2, 2)) is None
+        assert not alloc.can_allocate((2, 2))
+        assert alloc.snapshot() == {"total_devices": 2,
+                                    "busy_devices": 0}
+
+    def test_blocking_acquire_wakes_on_release(self):
+        alloc = DeviceSliceAllocator(list(range(2)))
+        first = alloc.acquire((1, 2))
+        got = []
+
+        def waiter():
+            got.append(alloc.acquire_blocking((1, 2), timeout_s=10))
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        alloc.release(first)
+        t.join(timeout=10)
+        assert got and got[0].devices == [0, 1]
+        with pytest.raises(TimeoutError):
+            alloc.acquire_blocking((1, 2), timeout_s=0.05)
+
+
+@multichip
+class TestExecutorMesh:
+    def test_sharded_matches_single_chip(self, model_and_params):
+        model, params = model_and_params
+        ex = FoldExecutor(model, params, max_entries=16, model_tag="v1")
+        batch = _batch()
+        ref = ex.run(batch, 0)
+        for devices, shape in ((jax.devices()[:2], (1, 2)),
+                               (jax.devices()[:4], (2, 2))):
+            got = ex.run(batch, 0, devices=devices, mesh_shape=shape)
+            np.testing.assert_allclose(
+                np.asarray(got.coords), np.asarray(ref.coords),
+                atol=1e-3)
+            np.testing.assert_allclose(
+                np.asarray(got.confidence), np.asarray(ref.confidence),
+                atol=1e-3)
+
+    def test_single_device_slice_off_default_device(self,
+                                                    model_and_params):
+        model, params = model_and_params
+        ex = FoldExecutor(model, params, max_entries=16)
+        batch = _batch(seed=1)
+        ref = ex.run(batch, 0)
+        got = ex.run(batch, 0, devices=[jax.devices()[5]])
+        np.testing.assert_allclose(np.asarray(got.coords),
+                                   np.asarray(ref.coords), atol=1e-3)
+
+    def test_exec_key_covers_mesh_shape_and_model_tag(
+            self, model_and_params):
+        model, params = model_and_params
+        ex = FoldExecutor(model, params, max_entries=16, model_tag="v1")
+        batch = _batch()
+        k_single = ex.key_for(batch, 0)
+        k_mesh = ex.key_for(batch, 0, mesh_shape=(2, 2))
+        assert k_single[:4] == k_mesh[:4]
+        assert k_single != k_mesh
+        assert k_single[4] == (1, 1) and k_single[5] == "v1"
+
+    def test_rollout_never_serves_stale_executable(self,
+                                                   model_and_params):
+        """ISSUE 7 satellite: a weight rollout (model_tag reassignment)
+        must compile fresh, never reuse an executable minted under the
+        previous tag — for the default path AND warmup."""
+        model, params = model_and_params
+        ex = FoldExecutor(model, params, max_entries=16, model_tag="v1")
+        batch = _batch()
+        ex.run(batch, 0)
+        hits = ex.hits
+        ex.run(batch, 0)
+        assert ex.hits == hits + 1                 # same tag: cache hit
+        ex.model_tag = "v2"
+        misses = ex.misses
+        ex.run(batch, 0)
+        assert ex.misses == misses + 1             # rolled: fresh compile
+        # warmup keys carry the tag too: legacy 4-tuples normalize onto
+        # the CURRENT tag, so a rolled executor re-warms for real
+        fresh = ex.warmup([(16, 2, MSA_DEPTH, 0)])
+        assert fresh == 0                          # already compiled @v2
+        ex.model_tag = "v3"
+        assert ex.warmup([(16, 2, MSA_DEPTH, 0)]) == 1
+
+    def test_scheduler_retag_propagates_to_executor(self,
+                                                    model_and_params):
+        model, params = model_and_params
+        ex = FoldExecutor(model, params, model_tag="v1")
+        sched = Scheduler(ex, BucketPolicy((16,)), model_tag="v1")
+        sched.model_tag = "v1+rolled"              # what a rollout does
+        assert ex.model_tag == "v1+rolled"
+        # rebuild (watchdog path) carries the tag forward
+        assert ex.rebuild().model_tag == "v1+rolled"
+
+
+def _fake_fold_result(batch):
+    b, n = batch["seq"].shape
+    return SimpleNamespace(coords=np.zeros((b, n, 3), np.float32),
+                           confidence=np.ones((b, n), np.float32))
+
+
+class _BarrierExecutor:
+    """Fake mesh-capable executor: run() blocks on a barrier, so the
+    test only passes when two batches are IN FLIGHT simultaneously."""
+
+    def __init__(self, parties):
+        self.barrier = threading.Barrier(parties)
+        self.calls = []
+
+    def run(self, batch, num_recycles, trace=None, devices=None,
+            mesh_shape=None):
+        self.calls.append(tuple(getattr(d, "id", d) for d in devices))
+        self.barrier.wait(timeout=30)
+        return _fake_fold_result(batch)
+
+    def stats(self):
+        return {"hits": 0, "misses": 0, "evictions": 0}
+
+
+@multichip
+class TestSchedulerMesh:
+    def _scheduler(self, model_and_params, mesh_policy, tracer=None,
+                   registry=None, **kw):
+        model, params = model_and_params
+        ex = FoldExecutor(model, params, max_entries=32, model_tag="v1")
+        cfg = SchedulerConfig(max_batch_size=2, max_wait_ms=10.0,
+                              num_recycles=0, msa_depth=MSA_DEPTH)
+        return Scheduler(
+            ex, BucketPolicy((16, 32)), cfg,
+            metrics=ServeMetrics(registry=registry or MetricsRegistry()),
+            model_tag="v1", tracer=tracer,
+            registry=registry or MetricsRegistry(),
+            mesh_policy=mesh_policy, **kw)
+
+    def test_mesh_e2e_outputs_match_single_chip(self, model_and_params,
+                                                tmp_path):
+        """Acceptance: a long-bucket fold sharded over a 2x2 slice
+        matches the single-chip scheduler's coordinates/confidence
+        within 1e-3, short folds stay 1-chip, and serve_stats()["mesh"]
+        reports both shapes."""
+        from alphafold2_tpu import obs
+
+        reqs = synthetic_requests(jax.random.PRNGKey(1), num=6,
+                                  lengths=(12, 24), msa_depth=MSA_DEPTH)
+        tracer = obs.Tracer(jsonl_path=str(tmp_path / "traces.jsonl"))
+        mesh_sched = self._scheduler(
+            model_and_params, MeshPolicy({16: 1, 32: 4}), tracer=tracer)
+        plain_sched = self._scheduler(model_and_params, None)
+
+        def serve(sched):
+            sched.warmup()
+            out = {}
+            with sched:
+                for r in reqs:
+                    t = sched.submit(FoldRequest(seq=r.seq, msa=r.msa))
+                    out[r.request_id] = t.result(timeout=300)
+            return out
+
+        mesh_out = serve(mesh_sched)
+        snap = mesh_sched.serve_stats()
+        plain_out = serve(plain_sched)
+        for rid, resp in mesh_out.items():
+            assert resp.ok, resp.error
+            ref = plain_out[rid]
+            np.testing.assert_allclose(resp.coords, ref.coords,
+                                       atol=1e-3)
+            np.testing.assert_allclose(resp.confidence, ref.confidence,
+                                       atol=1e-3)
+        mesh = snap["mesh"]
+        assert mesh["policy"] == {"16": "1x1", "32": "2x2"}
+        assert mesh["folds"]["2x2"]["batches"] >= 1
+        assert mesh["folds"]["1x1"]["batches"] >= 1
+        assert mesh["allocator"]["busy_devices"] == 0    # all released
+        # health carries occupancy for the fleet passthrough
+        health_mesh = mesh_sched.health().get("mesh")
+        assert health_mesh == {"total_devices": 8, "busy_devices": 0}
+        # traces: every sharded fold has a shard span and a mesh-tagged
+        # fold span; plain stats must NOT grow a mesh section
+        tracer.close()
+        fold_mesh, shard_spans = set(), 0
+        with open(tmp_path / "traces.jsonl") as fh:
+            for line in fh:
+                for s in json.loads(line).get("spans", ()):
+                    if s["name"] == "shard":
+                        shard_spans += 1
+                    if s["name"] == "fold":
+                        fold_mesh.add(
+                            (s.get("attrs") or {}).get("mesh"))
+        assert shard_spans > 0
+        assert {"1x1", "2x2"} <= fold_mesh
+        assert "mesh" not in plain_sched.serve_stats()
+        assert "mesh" not in plain_sched.health()
+
+    def test_disjoint_slices_run_concurrently(self, model_and_params):
+        """Two buckets on two 1-chip slices must be in flight AT THE
+        SAME TIME: the barrier only releases when both executions have
+        entered run() — a serial scheduler would deadlock (and fail via
+        the barrier timeout)."""
+        ex = _BarrierExecutor(parties=2)
+        cfg = SchedulerConfig(max_batch_size=4, max_wait_ms=5.0,
+                              num_recycles=0, msa_depth=MSA_DEPTH)
+        sched = Scheduler(
+            ex, BucketPolicy((16, 32)), cfg,
+            metrics=ServeMetrics(registry=MetricsRegistry()),
+            registry=MetricsRegistry(),
+            mesh_policy=MeshPolicy({16: 1, 32: 1},
+                                   devices=jax.devices()[:2]))
+        reqs = synthetic_requests(jax.random.PRNGKey(2), num=2,
+                                  lengths=(12, 24), msa_depth=MSA_DEPTH)
+        with sched:
+            tickets = [sched.submit(FoldRequest(seq=r.seq, msa=r.msa))
+                       for r in reqs]
+            resps = [t.result(timeout=60) for t in tickets]
+        assert [r.status for r in resps] == ["ok", "ok"]
+        assert len(ex.calls) == 2
+        assert set(ex.calls[0]).isdisjoint(ex.calls[1])   # disjoint chips
+
+    def test_hbm_admission_guard_rejects_too_large(self,
+                                                   model_and_params):
+        """ISSUE 7 satellite: a fold whose analytic footprint exceeds
+        the largest configured slice resolves "too_large" at submit —
+        no queue, no executor, counter incremented."""
+        model, params = model_and_params
+        mem = FoldMemoryModel.from_model(model, params, hbm_gb=16.0)
+        # budget between the 16-bucket and 32-bucket footprints
+        lo = mem.fold_bytes(16, 2, MSA_DEPTH, 1)
+        hi = mem.fold_bytes(32, 2, MSA_DEPTH, 1)
+        assert lo < hi
+        mem.hbm_bytes_per_device = (lo + hi) // 2
+        reg = MetricsRegistry()
+        pol = MeshPolicy({16: 1, 32: 1}, devices=jax.devices()[:1],
+                         memory=mem)
+        ex = FoldExecutor(model, params, max_entries=8, model_tag="v1")
+        sched = Scheduler(
+            ex, BucketPolicy((16, 32)),
+            SchedulerConfig(max_batch_size=2, max_wait_ms=5.0,
+                            num_recycles=0, msa_depth=MSA_DEPTH),
+            metrics=ServeMetrics(registry=reg), registry=reg,
+            mesh_policy=pol)
+        short, long_ = synthetic_requests(
+            jax.random.PRNGKey(3), num=2, lengths=(12, 24),
+            msa_depth=MSA_DEPTH)
+        misses_before = ex.misses
+        with sched:
+            sched.warmup(msa_depth=MSA_DEPTH)
+            ok = sched.submit(
+                FoldRequest(seq=short.seq, msa=short.msa)).result(
+                    timeout=300)
+            too = sched.submit(
+                FoldRequest(seq=long_.seq, msa=long_.msa)).result(
+                    timeout=300)
+        assert ok.ok
+        assert too.status == "too_large"
+        assert "admission guard" in too.error
+        snap = sched.serve_stats()
+        assert snap["too_large"] == 1
+        counter = reg.snapshot()["serve_too_large_total"]
+        assert sum(s["value"] for s in counter["samples"]) == 1
+        # the rejected bucket never reached the executor — warmup skips
+        # unadmitted buckets too, so exactly ONE signature compiled
+        assert ex.misses == misses_before + 1
+
+    def test_fleet_passthrough_carries_mesh(self, model_and_params):
+        """ISSUE 7 fleet satellite: a mesh-aware replica's mesh section
+        rides the existing fleet stats/health passthrough — no fleet
+        wiring changed, the payloads come whole from the scheduler."""
+        from alphafold2_tpu import fleet
+
+        model, params = model_and_params
+        fl = fleet.InProcessFleet(
+            lambda: FoldExecutor(model, params, max_entries=8),
+            BucketPolicy((16, 32)),
+            SchedulerConfig(max_batch_size=2, msa_depth=MSA_DEPTH,
+                            num_recycles=0),
+            n_replicas=1, fleet=False, registry=MetricsRegistry(),
+            mesh_policy_factory=lambda i: MeshPolicy(
+                {16: 1, 32: 2}, devices=jax.devices()[:2]))
+        rep = fl.replicas[0]
+        assert rep.scheduler.health()["mesh"] == {
+            "total_devices": 2, "busy_devices": 0}
+        assert fl.stats()["replicas"]["r0"]["mesh"]["policy"] == \
+            {"16": "1x1", "32": "1x2"}
+
+    def test_too_large_guard_prices_request_msa_when_unpinned(
+            self, model_and_params):
+        """config.msa_depth=None must price each request's OWN MSA
+        depth, not zero — a deep-MSA fold that cannot fit is rejected
+        while the same sequence MSA-free is admitted."""
+        model, params = model_and_params
+        mem = FoldMemoryModel.from_model(model, params, hbm_gb=16.0)
+        free = mem.fold_bytes(16, 2, 0, shape=(1, 1))
+        deep = mem.fold_bytes(16, 2, 64, shape=(1, 1))
+        assert free < deep
+        mem.hbm_bytes_per_device = (free + deep) // 2
+        ex = _BarrierExecutor(parties=1)
+        sched = Scheduler(
+            ex, BucketPolicy((16,)),
+            SchedulerConfig(max_batch_size=2, max_wait_ms=5.0,
+                            num_recycles=0, msa_depth=None),
+            metrics=ServeMetrics(registry=MetricsRegistry()),
+            registry=MetricsRegistry(),
+            mesh_policy=MeshPolicy({16: 1}, devices=jax.devices()[:1],
+                                   memory=mem))
+        rng = np.random.default_rng(7)
+        with sched:
+            ok = sched.submit(FoldRequest(
+                seq=rng.integers(0, 20, 12))).result(timeout=60)
+            too = sched.submit(FoldRequest(
+                seq=rng.integers(0, 20, 12),
+                msa=rng.integers(0, 20, (64, 12)))).result(timeout=60)
+        assert ok.status == "ok"
+        assert too.status == "too_large"
+
+    def test_too_large_still_serves_from_cache(self, model_and_params):
+        """A fold this process can never execute may still have been
+        computed elsewhere (peer with bigger slices, offline warm):
+        a store hit serves it instead of rejecting — mirroring
+        degraded mode's cache-hits-keep-serving contract."""
+        from alphafold2_tpu.cache import FoldCache
+
+        model, params = model_and_params
+        mem = FoldMemoryModel.from_model(model, params, hbm_gb=16.0)
+        mem.hbm_bytes_per_device = 1          # nothing fits
+        ex = _BarrierExecutor(parties=1)
+        sched = Scheduler(
+            ex, BucketPolicy((16,)),
+            SchedulerConfig(max_batch_size=2, max_wait_ms=5.0,
+                            num_recycles=0, msa_depth=0),
+            metrics=ServeMetrics(registry=MetricsRegistry()),
+            cache=FoldCache(registry=MetricsRegistry()),
+            model_tag="v1", registry=MetricsRegistry(),
+            mesh_policy=MeshPolicy({16: 1}, devices=jax.devices()[:1],
+                                   memory=mem))
+        rng = np.random.default_rng(8)
+        req = FoldRequest(seq=rng.integers(0, 20, 12))
+        with sched:
+            first = sched.submit(req).result(timeout=60)
+            assert first.status == "too_large"
+            # the result arrives out of band (peer / offline warm)
+            key = sched._cache_key_for(req)
+            sched.cache.put(key, np.zeros((12, 3), np.float32),
+                            np.ones((12,), np.float32))
+            again = sched.submit(FoldRequest(seq=req.seq.copy())) \
+                .result(timeout=60)
+        assert again.status == "ok" and again.source == "cache"
+        assert sched.serve_stats()["too_large"] == 1
+
+    def test_mesh_autosizes_executor_lru(self, model_and_params):
+        """Warmup compiles one executable per (bucket, aligned slice);
+        the scheduler must grow the executor LRU to hold them or warmup
+        evicts its own work."""
+        model, params = model_and_params
+        ex = FoldExecutor(model, params, max_entries=1)
+        Scheduler(ex, BucketPolicy((16, 32)),
+                  SchedulerConfig(max_batch_size=2, msa_depth=MSA_DEPTH),
+                  metrics=ServeMetrics(registry=MetricsRegistry()),
+                  registry=MetricsRegistry(),
+                  mesh_policy=MeshPolicy({16: 1, 32: 4}))
+        assert ex.max_entries == 8 + 2        # 8 1-chip + 2 4-chip slices
+
+    def test_retag_prunes_param_placements(self, model_and_params):
+        model, params = model_and_params
+        ex = FoldExecutor(model, params, max_entries=8, model_tag="v1")
+        ex.run(_batch(), 0, devices=[jax.devices()[3]])
+        assert ex.stats()["placed_param_slices"] == 1
+        ex.model_tag = "v2"                   # rollout: prune NOW, not
+        assert ex.stats()["placed_param_slices"] == 0   # on next traffic
+
+    def test_mesh_policy_none_serve_stats_byte_identical(
+            self, model_and_params):
+        """The off switch: mesh_policy=None must leave serve_stats()
+        byte-identical to a scheduler that has never heard of meshes
+        (scrubbed of wall-clock fields, same as the transport
+        equivalence test)."""
+        def scrub(obj):
+            if isinstance(obj, dict):
+                return {k: scrub(v) for k, v in sorted(obj.items())
+                        if k != "traces" and not k.endswith("_s")}
+            if isinstance(obj, list):
+                return [scrub(v) for v in obj]
+            return obj
+
+        def run_one(mesh_policy):
+            sched = self._scheduler(model_and_params, mesh_policy)
+            reqs = synthetic_requests(jax.random.PRNGKey(4), num=4,
+                                      lengths=(12, 24),
+                                      msa_depth=MSA_DEPTH)
+            with sched:
+                for r in reqs:
+                    resp = sched.submit(
+                        FoldRequest(seq=r.seq, msa=r.msa)).result(
+                            timeout=300)
+                    assert resp.ok
+            return scrub(sched.serve_stats())
+
+        a = run_one(None)
+        b = run_one(None)
+        assert json.dumps(a, sort_keys=True, default=str) \
+            == json.dumps(b, sort_keys=True, default=str)
+        assert "mesh" not in a

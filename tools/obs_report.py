@@ -46,12 +46,16 @@ from alphafold2_tpu.utils.profiling import percentile  # noqa: E402
 # watchdog (the killed window of a hung execution) with ISSUE 5;
 # rpc (one front-door HTTP hop, client-measured: submit POST or the
 # whole forwarded exchange) and drain (time a request rode a graceful
-# drain, from drain start to its terminal state) with ISSUE 6 —
+# drain, from drain start to its terminal state) with ISSUE 6;
+# shard (mesh serving: params/input placement onto the batch's device
+# slice) with ISSUE 7 — fold spans additionally carry a `mesh` attr
+# ("1x1", "2x4") the per-mesh latency section below groups by.
 # --check's orphan-span rules apply to all of them unchanged, which is
 # how the chaos smokes prove recovery cost is fully accounted.
 STAGE_ORDER = ("submit", "forward", "rpc", "queue", "parked", "retry",
-               "drain", "batch_form", "compile", "fold", "watchdog",
-               "writeback", "peer_fetch", "cache_lookup", "write")
+               "drain", "batch_form", "shard", "compile", "fold",
+               "watchdog", "writeback", "peer_fetch", "cache_lookup",
+               "write")
 
 # span/trace boundary slack: start_s, dur_s, and duration_s are each
 # INDEPENDENTLY rounded to 1e-6 when emitted, so a span auto-closed at
@@ -130,6 +134,33 @@ def stage_stats(records: List[dict]) -> dict:
                      "p99_s": percentile(durs, 99),
                      "total_s": sum(durs)}
     return out
+
+
+def mesh_fold_stats(records: List[dict]) -> dict:
+    """Per-mesh-shape fold latency: {mesh_label: {count, p50_s, p99_s}}.
+    Fold spans without a `mesh` attr (the classic single-chip executor)
+    group under "1x1", so a mixed mesh-on/off trace file still separates
+    1-chip from 8-chip folds. Empty when no fold spans exist."""
+    by_mesh = {}
+    for rec in records:
+        for span in rec.get("spans", ()):
+            if span.get("name") != "fold":
+                continue
+            mesh = (span.get("attrs") or {}).get("mesh", "1x1")
+            by_mesh.setdefault(str(mesh), []).append(
+                float(span.get("dur_s", 0.0)))
+    return {mesh: {"count": len(durs),
+                   "p50_s": percentile(durs, 50),
+                   "p99_s": percentile(durs, 99)}
+            for mesh, durs in sorted(by_mesh.items())}
+
+
+def render_mesh_folds(stats: dict) -> str:
+    lines = [f"{'mesh':>12}  {'folds':>6}  {'p50':>9}  {'p99':>9}"]
+    for mesh, s in stats.items():
+        lines.append(f"{mesh:>12}  {s['count']:>6}  {s['p50_s']:>9.4f}  "
+                     f"{s['p99_s']:>9.4f}")
+    return "\n".join(lines)
 
 
 def render_waterfall(stats: dict, width: int = 40) -> str:
@@ -241,6 +272,7 @@ def main(argv=None) -> int:
     if args.json:
         out = summarize(records)
         out["stages"] = stage_stats(records)
+        out["mesh_folds"] = mesh_fold_stats(records)
         out["problems"] = problems[:20]
         print(json.dumps(out))
     else:
@@ -249,6 +281,10 @@ def main(argv=None) -> int:
               f"(status {s['by_status']}, source {s['by_source']}, "
               f"{s['linked_followers']} linked followers) ==")
         print(render_waterfall(stage_stats(records)))
+        mesh = mesh_fold_stats(records)
+        if len(mesh) > 1 or any(m != "1x1" for m in mesh):
+            print("\n-- fold latency by mesh shape --")
+            print(render_mesh_folds(mesh))
         print(f"\n-- top {args.top} slowest --")
         print(render_slowest(records, args.top))
         if problems:

@@ -48,6 +48,16 @@
 #      serves, and obs_report --check is clean over the merged
 #      driver + replica traces with rpc/drain spans present in the
 #      waterfall. The deployment-seam tripwire.
+#   7. multi-chip mesh serving (--mesh-policy, serve.MeshPolicy) under
+#      XLA_FLAGS=--xla_force_host_platform_device_count=8: a mixed
+#      short+long workload where the long bucket is pinned to a 4-chip
+#      pair-sharded slice and short folds stay single-chip. FAILS
+#      unless every request resolves ok, at least one sharded-bucket
+#      batch actually executed on a >1-chip mesh (serve_loadtest
+#      --smoke enforces it from serve_stats()["mesh"]["folds"]; the
+#      assertion is skipped cleanly when only 1 device is visible),
+#      and obs_report --check finds no orphan shard spans in the
+#      traces. The mesh-serving tripwire.
 #
 # SMOKE_PHASES selects phases without forking the script (constrained
 # runners skip the multi-process phase): a comma-separated list, e.g.
@@ -70,7 +80,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 DURATION="${SMOKE_DURATION_S:-30}"
-PHASES="${SMOKE_PHASES:-1,2,3,4,5,6}"
+PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7}"
 
 phase_on() {
     case ",${PHASES}," in
@@ -273,4 +283,35 @@ timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
 timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     python tools/obs_report.py /tmp/serve_smoke_procs_traces.jsonl \
     --check --prom /tmp/serve_smoke_procs.prom
+fi
+
+# phase 7: mesh serving — 8 virtual devices, short bucket single-chip,
+# long bucket on a 2x2 pair-sharded slice; serve_loadtest --smoke fails
+# unless sharded batches actually executed on the multi-chip mesh (or
+# skips that assertion cleanly when only 1 device is visible), then
+# obs_report --check proves the new shard spans (and mesh-tagged fold
+# spans) are orphan-free
+if phase_on 7; then
+rm -f /tmp/serve_smoke_mesh_traces.jsonl
+
+timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python tools/serve_loadtest.py \
+    --smoke \
+    --requests 48 \
+    --lengths 24,48 \
+    --buckets 32,64 \
+    --mesh-policy 32=1,64=4 \
+    --msa-depth 3 \
+    --max-batch 2 \
+    --concurrency 2 \
+    --deadline-s 120 \
+    --num-recycles 0 \
+    --metrics-path /tmp/serve_smoke_mesh.jsonl \
+    --trace-path /tmp/serve_smoke_mesh_traces.jsonl \
+    --prom-path /tmp/serve_smoke_mesh.prom
+
+timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/obs_report.py /tmp/serve_smoke_mesh_traces.jsonl \
+    --check --prom /tmp/serve_smoke_mesh.prom
 fi
