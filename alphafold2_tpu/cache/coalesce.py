@@ -95,17 +95,49 @@ class InflightRegistry:
                 leader = None
                 is_leader = True
             else:
-                leader = self._leader_objs.get(key)
-                if on_follower is not None:
-                    on_follower(leader)
-                waiting.append(follower)
-                self.coalesced += 1
+                leader = self._record_follower_locked(key, waiting,
+                                                      follower,
+                                                      on_follower)
                 is_leader = False
         if is_leader:
             self._m_leaders.inc()
         else:
             self._m_followers.inc()
         return is_leader, leader
+
+    def _record_follower_locked(self, key, waiting, follower,
+                                on_follower):
+        """Caller holds self._lock and verified `waiting` exists: the
+        ONE copy of follower-attach bookkeeping, shared by
+        attach_with_leader and attach_follower so their accounting
+        cannot drift."""
+        leader = self._leader_objs.get(key)
+        if on_follower is not None:
+            on_follower(leader)
+        waiting.append(follower)
+        self.coalesced += 1
+        return leader
+
+    def attach_follower(self, key: str, follower: Any,
+                        on_follower: Optional[
+                            Callable[[Any], None]] = None) -> bool:
+        """Attach ONLY when `key` already has an in-flight leader:
+        True = recorded as a follower (on_follower ran under the lock,
+        same contract as attach_with_leader), False = no leader, the
+        follower was NOT recorded and the caller keeps full ownership.
+        This is the cache-aware admission primitive (ISSUE 9): a
+        duplicate of in-flight work costs ~0 to serve, so the scheduler
+        admits it past a "full" queue — but only as a follower; it must
+        never become a leader that enqueues real work the queue bound
+        just refused."""
+        with self._lock:
+            waiting = self._followers.get(key)
+            if waiting is None:
+                return False
+            self._record_follower_locked(key, waiting, follower,
+                                         on_follower)
+        self._m_followers.inc()
+        return True
 
     def settle(self, key: str) -> List[Any]:
         """Close out `key`: the leader's work reached a terminal state

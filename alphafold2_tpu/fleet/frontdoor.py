@@ -12,7 +12,19 @@ cache tier in `fleet/peer.py`). The protocol is deliberately tiny:
     GET  /v1/result/<id>?wait_s= long-poll; 200 npz + X-Status/X-Source/
                                  X-Attempts/X-Error when terminal
                                  (single pickup: the slot is freed),
-                                 204 still in flight, 404 unknown
+                                 204 still in flight, 404 unknown.
+                                 `&progress=1` opts into PROGRESSIVE
+                                 results (step-mode scheduling,
+                                 serve.recycle.RecyclePolicy(stream=
+                                 True)): the long-poll returns 206 +
+                                 the latest per-recycle coords/
+                                 confidence npz with X-Recycle = its
+                                 iteration index as soon as an update
+                                 NEWER than `&after=<recycle>`
+                                 (default -1) exists — poll again with
+                                 after=<last X-Recycle> to stream; the
+                                 slot stays parked and the terminal
+                                 200 still follows
     POST /v1/cancel/<id>         best-effort: drop the parked slot
     GET  /healthz                the fleet's ONE health payload:
                                  replica, tag, epoch, breaker, queue
@@ -330,7 +342,40 @@ class FrontDoorServer:
         except ValueError:
             wait_s = 0.0
         wait_s = max(0.0, min(wait_s, self.max_wait_s))
-        if not slot.event.wait(wait_s):
+        query = urlparse.parse_qs(parsed.query)
+        if query.get("progress", ["0"])[0] == "1":
+            # progressive long-poll: return 206 + the latest
+            # per-recycle update as soon as one NEWER than the
+            # client's `after=<recycle>` cursor exists, instead of
+            # sitting out the whole wait on the terminal event (a
+            # streaming client would otherwise see at most one stale
+            # update per window). Short wait slices: FoldTicket has no
+            # progress event to block on, and recycles are
+            # 10s-of-ms-granular.
+            try:
+                after = int(query.get("after", ["-1"])[0])
+            except ValueError:
+                after = -1
+            deadline = time.monotonic() + wait_s
+            while True:
+                if slot.event.is_set():
+                    break                        # terminal: 200 below
+                latest = self._latest_progress(slot)
+                if latest is not None and int(latest.recycle) > after:
+                    from alphafold2_tpu.fleet.rpc import encode_arrays
+                    self._m_rpc.inc(route="result", outcome="progress")
+                    return h._reply(
+                        206, encode_arrays(latest.coords,
+                                           latest.confidence),
+                        headers={"X-Status": "running",
+                                 "X-Recycle": str(int(latest.recycle))},
+                        content_type="application/octet-stream")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._m_rpc.inc(route="result", outcome="pending")
+                    return h._reply(204, b"")
+                slot.event.wait(min(0.05, remaining))
+        elif not slot.event.wait(wait_s):
             self._m_rpc.inc(route="result", outcome="pending")
             return h._reply(204, b"")
         body, headers = encode_response(slot.response)
@@ -339,6 +384,16 @@ class FrontDoorServer:
         self._m_rpc.inc(route="result", outcome="ok")
         h._reply(200, body, headers=headers,
                  content_type="application/octet-stream")
+
+    @staticmethod
+    def _latest_progress(slot):
+        getter = getattr(slot.ticket, "latest_progress", None)
+        if not callable(getter):
+            return None
+        try:
+            return getter()
+        except Exception:
+            return None
 
     def _cancel(self, h, ticket_id: str):
         ticket_id = urlparse.unquote(ticket_id)

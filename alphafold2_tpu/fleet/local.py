@@ -73,6 +73,9 @@ class InProcessFleet:
     faults: optional serve.faults.FaultPlan threaded into every
         replica's FoldCache and PeerCacheClient (chaos harness; the
         executor side is the caller's to wire via make_executor).
+    recycle_policy: optional serve.recycle.RecyclePolicy applied to
+        EVERY replica's scheduler (step-mode recycle scheduling:
+        early-exit, preemption, progressive results; off when None).
     mesh_policy_factory: optional per-replica serve.MeshPolicy factory
         (index -> MeshPolicy or None) for mesh-aware replicas. A
         FACTORY, not a shared policy: in-process replicas share one
@@ -97,7 +100,8 @@ class InProcessFleet:
                  retry=None,
                  faults=None,
                  mesh_policy_factory: Optional[
-                     Callable[[int], object]] = None):
+                     Callable[[int], object]] = None,
+                 recycle_policy=None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self.fleet_enabled = bool(fleet)
@@ -146,7 +150,8 @@ class InProcessFleet:
                 cache=cache, model_tag=model_tag, tracer=tracer,
                 registry=registry, router=router, retry=rep_retry,
                 mesh_policy=(mesh_policy_factory(i)
-                             if mesh_policy_factory else None))
+                             if mesh_policy_factory else None),
+                recycle_policy=recycle_policy)
             # the forwarding transport wraps the peer scheduler's
             # submit (LocalTransport — in-process, zero-copy); set
             # after construction so the registry row is complete

@@ -117,7 +117,10 @@ class ProcFleet:
                  num_recycles: int = 0,
                  model: Optional[dict] = None,
                  retry: bool = True,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 mesh_policy: str = "",
+                 mesh_hbm_gb: float = 16.0,
+                 recycle: Optional[dict] = None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self.run_dir = os.path.abspath(run_dir)
@@ -142,6 +145,24 @@ class ProcFleet:
                 num_recycles=int(num_recycles),
                 model=dict(model or {"dim": 32, "depth": 1,
                                      "msa_depth": 3}),
+                # per-replica mesh serving (ISSUE 9 satellite closing
+                # the PR-7 ROADMAP item): the spec string rides the
+                # config and each replica PROCESS builds its own
+                # MeshPolicy over its own device pool at boot
+                # (serve.MeshPolicy.parse: "", "auto", or
+                # "BUCKET=CHIPS,..."; shapes wider than the pool clamp
+                # cleanly, so one fleet config serves 1-device CI and
+                # 8-chip hosts alike)
+                mesh_policy=str(mesh_policy),
+                mesh_hbm_gb=float(mesh_hbm_gb),
+                # each replica claims the i-th 1/N share of whatever
+                # device pool its PROCESS sees: co-hosted replicas must
+                # not double-book chips (separate hosts see disjoint
+                # pools anyway, so the share is the whole pool there)
+                mesh_device_share=[i, n_replicas],
+                # optional step-mode recycle scheduling knobs
+                # (serve.RecyclePolicy kwargs); None = opaque folds
+                recycle=(None if recycle is None else dict(recycle)),
                 retry=bool(retry),
                 peers=[p for p in peer_rows
                        if p["replica_id"] != row["replica_id"]])
@@ -483,6 +504,32 @@ def replica_main(config: dict) -> int:
     if config.get("retry", True):
         retry = serve.RetryPolicy(max_attempts=4, backoff_base_s=0.02,
                                   backoff_max_s=0.5)
+    # optional step-mode recycle scheduling from the fleet config:
+    # the same RecyclePolicy knobs the loadtest's --recycle-sched sets
+    recycle_cfg = config.get("recycle")
+    recycle_policy = (None if not recycle_cfg
+                      else serve.RecyclePolicy(**recycle_cfg))
+    # per-replica mesh policy from the fleet config (PR-7 ROADMAP item:
+    # each replica pins its own chip SUBSET): the config's
+    # mesh_device_share = [i, n] hands this replica the i-th 1/n chunk
+    # of whatever pool its process sees, so co-hosted replicas never
+    # double-book a chip (on separate hosts the pools are disjoint and
+    # the share covers them whole); shapes wider than the chunk clamp
+    # cleanly, so the same spec serves 1-device CI and multi-chip hosts
+    mesh_devices = None
+    if config.get("mesh_policy"):
+        share = config.get("mesh_device_share") or [0, 1]
+        pool = jax.devices()
+        chunk = max(1, len(pool) // max(int(share[1]), 1))
+        i = int(share[0])
+        mesh_devices = pool[i * chunk:(i + 1) * chunk] or pool[-chunk:]
+    mesh_policy = serve.MeshPolicy.parse(
+        config.get("mesh_policy", ""), model=model, params=params,
+        buckets=policy, max_batch=int(config["max_batch"]),
+        msa_depth=msa_depth,
+        hbm_gb=float(config.get("mesh_hbm_gb", 16.0)),
+        devices=mesh_devices,
+        carry_recyclables=recycle_policy is not None)
     scheduler = serve.Scheduler(
         executor, policy,
         serve.SchedulerConfig(
@@ -492,7 +539,8 @@ def replica_main(config: dict) -> int:
             msa_depth=msa_depth),
         cache=cache, model_tag=rollout.tag, tracer=tracer,
         router=router, retry=retry,
-        quarantine_path=os.path.join(state_dir, "quarantine.jsonl"))
+        quarantine_path=os.path.join(state_dir, "quarantine.jsonl"),
+        mesh_policy=mesh_policy, recycle_policy=recycle_policy)
     rollout.subscribe(
         lambda tag, epoch: setattr(scheduler, "model_tag", tag))
 

@@ -66,6 +66,8 @@ _HDR_SOURCE = "X-Source"
 _HDR_ATTEMPTS = "X-Attempts"
 _HDR_BUCKET = "X-Bucket-Len"
 _HDR_ERROR = "X-Error"
+_HDR_RECYCLES = "X-Recycles"         # step-mode: iterations executed
+_HDR_RECYCLE = "X-Recycle"           # progressive result: its iteration
 
 
 # -- wire format ---------------------------------------------------------
@@ -118,18 +120,27 @@ def decode_request(body: bytes, headers) -> FoldRequest:
         **kwargs)
 
 
+def encode_arrays(coords=None, confidence=None) -> bytes:
+    """The ONE coords/confidence npz framing every result body uses —
+    terminal responses here and the front door's progressive 206
+    (frontdoor._result) share it, so the two wire encodings cannot
+    drift."""
+    buf = io.BytesIO()
+    arrays = {}
+    if coords is not None:
+        arrays["coords"] = np.asarray(coords, np.float32)
+    if confidence is not None:
+        arrays["confidence"] = np.asarray(confidence, np.float32)
+    np.savez(buf, **arrays) if arrays else np.savez(
+        buf, empty=np.zeros(0, np.float32))
+    return buf.getvalue()
+
+
 def encode_response(response: FoldResponse) -> tuple:
     """(body_bytes, headers) for one terminal FoldResponse. Arrays in
     the npz body, everything else in headers — a non-ok response is an
     empty npz plus headers."""
-    buf = io.BytesIO()
-    arrays = {}
-    if response.coords is not None:
-        arrays["coords"] = np.asarray(response.coords, np.float32)
-    if response.confidence is not None:
-        arrays["confidence"] = np.asarray(response.confidence, np.float32)
-    np.savez(buf, **arrays) if arrays else np.savez(
-        buf, empty=np.zeros(0, np.float32))
+    body = encode_arrays(response.coords, response.confidence)
     headers = {_HDR_REQUEST_ID: response.request_id,
                _HDR_STATUS: response.status,
                _HDR_SOURCE: response.source,
@@ -137,11 +148,15 @@ def encode_response(response: FoldResponse) -> tuple:
                "Content-Type": "application/octet-stream"}
     if response.bucket_len is not None:
         headers[_HDR_BUCKET] = str(int(response.bucket_len))
+    # getattr: pre-ISSUE-9 peers' responses have no recycles field
+    recycles = getattr(response, "recycles", None)
+    if recycles is not None:
+        headers[_HDR_RECYCLES] = str(int(recycles))
     if response.error:
         # headers must be latin-1-safe single-line; errors are ours
         headers[_HDR_ERROR] = str(response.error)[:512].replace(
             "\n", " ").encode("ascii", "replace").decode("ascii")
-    return buf.getvalue(), headers
+    return body, headers
 
 
 def decode_response(body: bytes, headers) -> FoldResponse:
@@ -164,13 +179,15 @@ def decode_response(body: bytes, headers) -> FoldResponse:
                            or confidence.shape != (coords.shape[0],)):
         raise ValueError("ok result fails shape validation")
     bucket = headers.get(_HDR_BUCKET)
+    recycles = headers.get(_HDR_RECYCLES)
     return FoldResponse(
         request_id=headers.get(_HDR_REQUEST_ID, "?"),
         status=status, coords=coords, confidence=confidence,
         bucket_len=None if bucket is None else int(bucket),
         error=headers.get(_HDR_ERROR) or None,
         source=headers.get(_HDR_SOURCE, "fold"),
-        attempts=int(headers.get(_HDR_ATTEMPTS, "1") or 1))
+        attempts=int(headers.get(_HDR_ATTEMPTS, "1") or 1),
+        recycles=None if recycles is None else int(recycles))
 
 
 # -- transports ----------------------------------------------------------

@@ -24,6 +24,23 @@ class FoldResult(NamedTuple):
     recyclables: Recyclables
 
 
+class FoldStepState(NamedTuple):
+    """One recycle iteration's full output — the carry of the
+    scheduler-owned step loop (serve/recycle.py). Identical fields to
+    FoldResult on purpose: after the LAST step the state IS the fold
+    result, and `recyclables` is the only part the next step consumes.
+    `confidence` is already sigmoided to [0, 1] (the same
+    `sigmoid(raw[..., 0])` fold() applies once at the end — applying it
+    per step changes nothing for the final state and gives every
+    intermediate state a client-meaningful confidence for progressive
+    results)."""
+
+    coords: jnp.ndarray          # (b, n, 3)
+    confidence: jnp.ndarray      # (b, n) in [0, 1]
+    distogram: jnp.ndarray       # (b, n, n, buckets)
+    recyclables: Recyclables
+
+
 # single source of truth for the recycling default: fold_and_write's
 # cache keys hash the effective value, so a drifting duplicate literal
 # would silently serve results computed under one default as another
@@ -49,17 +66,11 @@ def fold(
     assert model.predict_coords, "fold() needs predict_coords=True"
 
     def one_pass(recyclables):
-        coords, ret = model.apply(
-            params, seq, msa=msa, mask=mask, msa_mask=msa_mask,
-            recyclables=recyclables, return_aux_logits=True,
-            return_recyclables=True,
-            # a deterministic 'performer' rng: under the trunk scan its
-            # split_rngs give each layer an INDEPENDENT FAVOR+ projection
-            # at inference (per-layer estimator errors average out instead
-            # of adding coherently); unused collections are harmless for
-            # models without Performer layers
-            rngs={"performer": jax.random.PRNGKey(0)}, **extra)
-        return coords, ret
+        # delegates to the SAME _one_pass the step-mode entry points
+        # (fold_init/fold_step) trace, so the step-loop == scan
+        # exactness contract cannot drift between two call sites
+        return _one_pass(model, params, seq, msa, mask, msa_mask,
+                         recyclables, extra)
 
     # first pass has no recyclables (params cover both traces via the
     # init-time branch coverage)
@@ -84,6 +95,61 @@ def fold(
 
     conf = jax.nn.sigmoid(confidence[..., 0].astype(jnp.float32))
     return FoldResult(coords, conf, distance, recyclables)
+
+
+def _one_pass(model, params, seq, msa, mask, msa_mask, recyclables,
+              extra):
+    """One trunk+structure pass — THE call fold()'s closure and the
+    step-mode entry points (fold_init/fold_step) all trace, so the
+    step-loop == scan exactness contract cannot drift between call
+    sites. The deterministic 'performer' rng: under the trunk scan its
+    split_rngs give each layer an INDEPENDENT FAVOR+ projection at
+    inference (per-layer estimator errors average out instead of
+    adding coherently); unused collections are harmless for models
+    without Performer layers."""
+    return model.apply(
+        params, seq, msa=msa, mask=mask, msa_mask=msa_mask,
+        recyclables=recyclables, return_aux_logits=True,
+        return_recyclables=True,
+        rngs={"performer": jax.random.PRNGKey(0)}, **extra)
+
+
+def _step_state(coords, ret) -> FoldStepState:
+    conf = jax.nn.sigmoid(ret.confidence[..., 0].astype(jnp.float32))
+    return FoldStepState(coords, conf, ret.distance, ret.recyclables)
+
+
+def fold_init(model, params, seq, msa=None, mask=None, msa_mask=None,
+              **extra) -> FoldStepState:
+    """The embed+first-pass executable of step-mode folding: exactly
+    fold(..., num_recycles=0), but returning a FoldStepState whose
+    `recyclables` seed `fold_step`. Jit-safe the same way fold() is.
+
+    Step-mode contract (tests/test_recycle.py pins it): for any R,
+        state = fold_init(...); repeat R times: state = fold_step(state)
+    produces coords/confidence/distogram numerically identical to
+    `fold(..., num_recycles=R)` — the scan body and the step body are
+    one function (`_one_pass`), so splitting the loop moves WHO owns
+    the iteration (the scheduler instead of XLA), never what it
+    computes. The identity holds between COMPILED programs (jit both
+    sides — the serving executor always does); eager op-by-op
+    execution rounds differently than the scan body's compiled HLO and
+    is not covered."""
+    assert model.predict_coords, "fold_init() needs predict_coords=True"
+    coords, ret = _one_pass(model, params, seq, msa, mask, msa_mask,
+                            None, extra)
+    return _step_state(coords, ret)
+
+
+def fold_step(model, params, seq, recyclables: Recyclables, msa=None,
+              mask=None, msa_mask=None, **extra) -> FoldStepState:
+    """One recycle iteration: the `lax.scan` body of fold() as its own
+    executable. Feed it the previous state's `recyclables` (from
+    fold_init or an earlier fold_step)."""
+    assert model.predict_coords, "fold_step() needs predict_coords=True"
+    coords, ret = _one_pass(model, params, seq, msa, mask, msa_mask,
+                            recyclables, extra)
+    return _step_state(coords, ret)
 
 
 def fold_and_write(model, params, seq, out_path: str, cache=None,

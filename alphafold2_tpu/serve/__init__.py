@@ -18,6 +18,11 @@ The serving stack, bottom-up:
              `parallel.mesh`), concurrent disjoint-slice execution, and
              the analytic-HBM admission guard (README "Multi-chip
              serving")
+- recycle:   RecyclePolicy — pass `Scheduler(recycle_policy=
+             RecyclePolicy(converge_tol=...))` and the scheduler owns
+             the recycle loop: early-exit converged folds, preempt
+             between recycles for deadline traffic, stream per-recycle
+             progressive results (README "Iteration-level scheduling")
 - resilience: RetryPolicy/CircuitBreaker/Quarantine — pass
              `Scheduler(..., retry=RetryPolicy(...))` for transient-
              batch retry, poison isolation by bisection + quarantine,
@@ -57,8 +62,9 @@ from alphafold2_tpu.serve.meshpolicy import (DeviceSliceAllocator,  # noqa: F401
                                              FoldMemoryModel, MeshPolicy,
                                              SliceLease)
 from alphafold2_tpu.serve.metrics import ServeMetrics  # noqa: F401
-from alphafold2_tpu.serve.request import (FoldRequest, FoldResponse,  # noqa: F401
-                                          FoldTicket)
+from alphafold2_tpu.serve.recycle import RecyclePolicy  # noqa: F401
+from alphafold2_tpu.serve.request import (FoldProgress, FoldRequest,  # noqa: F401
+                                          FoldResponse, FoldTicket)
 from alphafold2_tpu.serve.resilience import (CircuitBreaker,  # noqa: F401
                                              Quarantine, RetryPolicy,
                                              TransientExecutorError,

@@ -1,7 +1,7 @@
 """Compiled-executable cache around `predict.fold`.
 
 One compiled executable per (bucket_len, batch_size, msa_depth,
-num_recycles, mesh_shape, model_tag) key: because the scheduler feeds
+num_recycles, mesh_shape, model_tag, variant) key: because the scheduler feeds
 each key exactly one shape signature, the executor compiles ahead-of-
 time (`jax.jit(...).lower(args).compile()`) and caches the resulting
 `Compiled` object — so LRU-evicting a key actually frees its executable
@@ -14,11 +14,20 @@ is how a request trace attributes XLA time vs accelerator time
 `max_entries` bounds the resident set and `warmup()` pre-pays compiles
 before traffic arrives instead of on the first unlucky request.
 
-The key's last two elements close two staleness holes (ISSUE 7):
-`model_tag` means a weight rollout (the scheduler re-tags the executor)
-can never serve an executable compiled against the previous weights'
-identity, and `mesh_shape` keeps single-chip and mesh-sharded
-executables for the same bucket coexisting in the LRU.
+The key's mesh_shape/model_tag elements close two staleness holes
+(ISSUE 7): `model_tag` means a weight rollout (the scheduler re-tags
+the executor) can never serve an executable compiled against the
+previous weights' identity, and `mesh_shape` keeps single-chip and
+mesh-sharded executables for the same bucket coexisting in the LRU.
+
+The `variant` element (ISSUE 9, see MIGRATING) names WHICH compiled
+program serves the key: "fold" is the classic opaque executable (all
+recycles inside one `lax.scan`), "init" is the embed+first-pass
+executable and "step" the single-recycle executable of the
+scheduler-owned recycle loop (`run_init`/`run_step`, driven by
+`serve.recycle.RecyclePolicy`). init/step keys pin num_recycles to 0 —
+the step program is recycle-count-independent by construction, so one
+step executable serves every configured recycle depth.
 
 Multi-chip execution (`run(..., devices=, mesh_shape=)` — driven by the
 scheduler's `serve.meshpolicy.MeshPolicy`): the fold lowers under
@@ -51,13 +60,15 @@ from alphafold2_tpu.obs.trace import NULL_TRACE
 from alphafold2_tpu.parallel.mesh import make_mesh
 from alphafold2_tpu.parallel.sharding import (fold_input_shardings,
                                               shard_pytree_tp, use_mesh)
-from alphafold2_tpu.predict import FoldResult, fold
+from alphafold2_tpu.predict import (FoldResult, FoldStepState, fold,
+                                    fold_init, fold_step)
 from alphafold2_tpu.serve.bucketing import msa_depth_of
 from alphafold2_tpu.serve.meshpolicy import MeshShape, factor_chips, \
     mesh_label
 
-# (bucket_len, batch_size, msa_depth, num_recycles, mesh_shape, model_tag)
-ExecKey = Tuple[int, int, int, int, MeshShape, str]
+# (bucket_len, batch_size, msa_depth, num_recycles, mesh_shape,
+#  model_tag, variant) — variant in ("fold", "init", "step")
+ExecKey = Tuple[int, int, int, int, MeshShape, str, str]
 
 _SINGLE: MeshShape = (1, 1)
 _BATCH_INPUTS = ("seq", "mask", "msa", "msa_mask")
@@ -131,8 +142,33 @@ class FoldExecutor:
 
         return jax.jit(run)
 
+    def _builder(self, variant: str, num_recycles: int):
+        """The jitted callable for one ExecKey variant: "fold" is the
+        opaque all-recycles program, "init"/"step" the two halves of
+        the scheduler-owned recycle loop (predict.fold_init/fold_step —
+        the scan body as its own executable, so step-mode numerics
+        match the scan path exactly)."""
+        if variant == "fold":
+            return self._build(num_recycles)
+        if variant == "init":
+            def run_init(params, seq, mask, msa,
+                         msa_mask) -> FoldStepState:
+                return fold_init(self.model, params, seq, msa=msa,
+                                 mask=mask, msa_mask=msa_mask)
+
+            return jax.jit(run_init)
+        if variant != "step":
+            raise ValueError(f"unknown executable variant {variant!r}")
+
+        def run_step(params, seq, mask, msa, msa_mask,
+                     recyclables) -> FoldStepState:
+            return fold_step(self.model, params, seq, recyclables,
+                             msa=msa, mask=mask, msa_mask=msa_mask)
+
+        return jax.jit(run_step)
+
     def _compile(self, cache_key: tuple, num_recycles: int, args,
-                 mesh=None):
+                 mesh=None, variant: str = "fold"):
         """AOT-compile the key's executable OUTSIDE the cache lock (an
         XLA compile can take seconds; holding the lock would stall
         concurrent hit lookups) and insert it. Falls back to the lazily
@@ -140,7 +176,7 @@ class FoldExecutor:
         lowering refuses the argument structure. `mesh` (multi-chip
         slices only) is entered during lowering so the model's sharding
         constraints bake into the executable."""
-        jitted = self._build(num_recycles)
+        jitted = self._builder(variant, num_recycles)
         ctx = use_mesh(mesh) if mesh is not None \
             else contextlib.nullcontext()
         try:
@@ -171,23 +207,31 @@ class FoldExecutor:
             return fn
 
     def key_for(self, batch: dict, num_recycles: int,
-                mesh_shape: Optional[MeshShape] = None) -> ExecKey:
+                mesh_shape: Optional[MeshShape] = None,
+                variant: str = "fold") -> ExecKey:
         b, n = batch["seq"].shape
         shape = _SINGLE if mesh_shape is None \
             else tuple(int(x) for x in mesh_shape)
-        return (int(n), int(b), msa_depth_of(batch), int(num_recycles),
-                shape, self.model_tag)
+        # init/step programs are recycle-count-independent: pinning the
+        # recycles element to 0 means one step executable serves every
+        # configured depth instead of minting one per config
+        recycles = int(num_recycles) if variant == "fold" else 0
+        return (int(n), int(b), msa_depth_of(batch), recycles,
+                shape, self.model_tag, variant)
 
     def _normalize_key(self, key) -> ExecKey:
-        """Accept legacy 4-tuple (len, batch, msa_depth, recycles) and
-        5-tuple (+ mesh_shape) keys alongside the full 6-tuple —
-        `warmup()` callers predate the mesh/model_tag elements."""
+        """Accept legacy 4-tuple (len, batch, msa_depth, recycles),
+        5-tuple (+ mesh_shape), and 6-tuple (+ model_tag) keys
+        alongside the full 7-tuple — `warmup()` callers predate the
+        mesh/model_tag/variant elements."""
         key = tuple(key)
         if len(key) == 4:
-            return key + (_SINGLE, self.model_tag)
+            return key + (_SINGLE, self.model_tag, "fold")
         if len(key) == 5:
-            return key[:4] + (tuple(key[4]), self.model_tag)
-        return key[:4] + (tuple(key[4]), key[5])
+            return key[:4] + (tuple(key[4]), self.model_tag, "fold")
+        if len(key) == 6:
+            return key[:4] + (tuple(key[4]), key[5], "fold")
+        return key[:4] + (tuple(key[4]),) + tuple(key[5:7])
 
     # -- device-slice plumbing -------------------------------------------
 
@@ -285,6 +329,89 @@ class FoldExecutor:
             with ctx:
                 return self._invoke(fn, args, batch)
 
+    # -- step-mode execution (scheduler-owned recycle loop) --------------
+
+    def run_init(self, batch: dict, trace=NULL_TRACE,
+                 devices: Optional[Sequence] = None,
+                 mesh_shape: Optional[MeshShape] = None) -> FoldStepState:
+        """The embed+first-pass executable: recycle iteration 0 of the
+        scheduler-owned loop (`serve.recycle.RecyclePolicy`). Blocks
+        until the device result lands. Spans: `compile` when the
+        init-variant signature is built fresh, `fold` for the execution
+        itself (the obs checker's accelerator-time rule keys off a
+        non-zero fold span, and this IS the fold's first pass)."""
+        return self._run_stepmode("init", batch, (), trace, devices,
+                                  mesh_shape, span="fold", attrs={})
+
+    def run_step(self, batch: dict, state: FoldStepState,
+                 recycle_index: int, trace=NULL_TRACE,
+                 devices: Optional[Sequence] = None,
+                 mesh_shape: Optional[MeshShape] = None) -> FoldStepState:
+        """One recycle iteration: feeds `state.recyclables` (from
+        run_init or a previous run_step on the same slice) through the
+        step executable. Span: `recycle`, tagged with the iteration
+        index (and mesh label on a slice)."""
+        return self._run_stepmode(
+            "step", batch, (state.recyclables,), trace, devices,
+            mesh_shape, span="recycle",
+            attrs={"recycle": int(recycle_index)})
+
+    def _run_stepmode(self, variant: str, batch: dict, extra_args,
+                      trace, devices, mesh_shape, span: str,
+                      attrs: dict):
+        """Shared lookup/compile/execute path for the init/step
+        variants, covering both the single-chip and device-slice
+        cases. `extra_args` (the step's carried recyclables) ride after
+        the placed batch inputs; they are prior outputs of this very
+        slice, so they are already resident where the executable
+        expects them."""
+        if devices:
+            devices = list(devices)
+            if mesh_shape is None:
+                mesh_shape = factor_chips(len(devices))
+            mesh_shape = tuple(int(x) for x in mesh_shape)
+            label = mesh_label(mesh_shape)
+            key = self.key_for(batch, 0, mesh_shape=mesh_shape,
+                               variant=variant)
+            dev_ids = tuple(int(d.id) for d in devices)
+            cache_key = key + (dev_ids,)
+            # the batch inputs are identical across a step loop's
+            # iterations, so their device placement is cached ON the
+            # batch dict (keyed by slice identity): one host-to-slice
+            # transfer + one `shard` span per loop, not one per step.
+            # A repack mints a fresh batch dict (repack_batch copies
+            # only the canonical keys), which drops the stale cache.
+            place_key = ("_placed", dev_ids)
+            mesh, params = self._placed_params(devices, mesh_shape)
+            placed = batch.get(place_key)
+            if placed is None:
+                with trace.span("shard", mesh=label,
+                                devices=len(devices)):
+                    placed = self._place_inputs(batch, mesh, devices)
+                batch[place_key] = placed
+            args = (params,) + placed + tuple(extra_args)
+            attrs = dict(attrs, mesh=label)
+        else:
+            mesh = None
+            key = self.key_for(batch, 0, variant=variant)
+            cache_key = key + ((),)
+            args = (self.params, batch["seq"], batch["mask"],
+                    batch["msa"], batch["msa_mask"]) + tuple(extra_args)
+        fn = self._lookup(cache_key)
+        if fn is None:
+            with trace.span("compile", bucket_len=key[0],
+                            batch_size=key[1], msa_depth=key[2],
+                            variant=variant,
+                            **({"mesh": attrs["mesh"]}
+                               if "mesh" in attrs else {})):
+                fn = self._compile(cache_key, 0, args, mesh=mesh,
+                                   variant=variant)
+        with trace.span(span, bucket_len=key[0], **attrs):
+            ctx = use_mesh(mesh) if mesh is not None \
+                else contextlib.nullcontext()
+            with ctx:
+                return self._invoke(fn, args, batch)
+
     def _invoke(self, fn, args, batch) -> FoldResult:
         if self.faults is not None:
             # injected exceptions/latency fire BEFORE the device
@@ -299,12 +426,16 @@ class FoldExecutor:
 
     def warmup(self, keys: Iterable,
                timer=None, devices: Optional[Sequence] = None,
-               mesh_shape: Optional[MeshShape] = None) -> int:
+               mesh_shape: Optional[MeshShape] = None,
+               step_mode: bool = False) -> int:
         """Compile (and discard) each key's signature with a zero batch.
         Keys may be legacy 4-tuples (len, batch, msa_depth, recycles) or
         full ExecKeys; `devices`/`mesh_shape` warm the slice-bound
         executable the scheduler will actually run (the mesh-aware
         scheduler warms per bucket with the bucket's own lease).
+        `step_mode` warms the init+step executable PAIR instead of the
+        opaque fold — what a scheduler driving the recycle loop
+        (recycle_policy set) will actually execute.
         Returns the number of fresh compiles. Optional `timer` is a
         profiling.StepTimer measuring each warmup (== compile+first-run)
         wall time."""
@@ -323,13 +454,22 @@ class FoldExecutor:
                     (batch_size, msa_depth, bucket_len), jnp.int32)
                 batch["msa_mask"] = jnp.zeros(
                     (batch_size, msa_depth, bucket_len), bool)
-            if timer is not None:
-                with timer.measure():
+
+            def _one():
+                if step_mode:
+                    state = self.run_init(batch, devices=devices,
+                                          mesh_shape=mesh_shape)
+                    self.run_step(batch, state, 0, devices=devices,
+                                  mesh_shape=mesh_shape)
+                else:
                     self.run(batch, num_recycles, devices=devices,
                              mesh_shape=mesh_shape)
+
+            if timer is not None:
+                with timer.measure():
+                    _one()
             else:
-                self.run(batch, num_recycles, devices=devices,
-                         mesh_shape=mesh_shape)
+                _one()
             fresh += self.misses - before
         return fresh
 
@@ -339,5 +479,5 @@ class FoldExecutor:
                     "evictions": self.evictions,
                     "resident": len(self._cache),
                     "max_entries": self.max_entries,
-                    "keys": [k[:6] for k in self._cache.keys()],
+                    "keys": [k[:-1] for k in self._cache.keys()],
                     "placed_param_slices": len(self._placed)}

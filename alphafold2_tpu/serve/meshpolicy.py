@@ -95,7 +95,17 @@ class FoldMemoryModel:
       the i axis ONLY (msa_spec/fold_input_specs place nothing on j),
       so it divides by the slice's i factor, not the chip count;
     - distogram head: B * L^2 * distogram_buckets * 4, counted
-      replicated — it is the output the host gathers.
+      replicated — it is the output the host gathers;
+    - recycle carry (step-mode scheduling only, `carry_recyclables=`):
+      the scheduler-owned recycle loop holds the PREVIOUS step's
+      `Recyclables` (pairwise repr + single row + coords) live across
+      the step executable's execution — the opaque `lax.scan` fold
+      keeps that carry inside one program where the pair_live
+      coefficient already prices it, but step mode double-buffers it
+      at the seam (prev state alive while the next computes), so a
+      step-scheduled bucket pays `recycle_carry_live` extra copies of
+      the pairwise term (sharded like the pair track) plus the
+      unsharded single-row/coords terms.
     """
 
     param_bytes: int
@@ -104,6 +114,7 @@ class FoldMemoryModel:
     dtype_bytes: int = 4
     pair_live: float = 6.0
     msa_live: float = 4.0
+    recycle_carry_live: float = 2.0
     distogram_buckets: int = 37
     hbm_bytes_per_device: int = 16 << 30
 
@@ -128,11 +139,14 @@ class FoldMemoryModel:
 
     def fold_bytes(self, bucket_len: int, batch_size: int,
                    msa_depth: int, chips: int = 1,
-                   shape: Optional[MeshShape] = None) -> int:
+                   shape: Optional[MeshShape] = None,
+                   carry_recyclables: bool = False) -> int:
         """Estimated peak per-device bytes for one fold batch. Pass the
         actual slice `shape` when known (admits() does) — the MSA track
         divides by its i factor only; a bare `chips` count prices the
-        canonical squarest factorization."""
+        canonical squarest factorization. `carry_recyclables` adds the
+        step-mode recycle carry (the scheduler passes it iff a
+        RecyclePolicy drives the loop)."""
         L, B, M = int(bucket_len), int(batch_size), int(msa_depth)
         if shape is not None:
             i = max(int(shape[0]), 1)
@@ -148,13 +162,21 @@ class FoldMemoryModel:
         msa = B * max(M, 1) * L * self.dim * self.dtype_bytes \
             * self.msa_live
         dist = B * L * L * self.distogram_buckets * 4
-        return int(self.param_bytes + dist + pair / chips + msa / i)
+        total = self.param_bytes + dist + pair / chips + msa / i
+        if carry_recyclables:
+            carry_pair = B * L * L * self.dim * self.dtype_bytes / chips
+            carry_rest = B * L * (self.dim + 3) * self.dtype_bytes
+            total += self.recycle_carry_live * (carry_pair + carry_rest)
+        return int(total)
 
     def fits(self, bucket_len: int, batch_size: int, msa_depth: int,
              chips: int = 1,
-             shape: Optional[MeshShape] = None) -> bool:
-        return self.fold_bytes(bucket_len, batch_size, msa_depth,
-                               chips, shape) <= self.hbm_bytes_per_device
+             shape: Optional[MeshShape] = None,
+             carry_recyclables: bool = False) -> bool:
+        return self.fold_bytes(
+            bucket_len, batch_size, msa_depth, chips, shape,
+            carry_recyclables=carry_recyclables) \
+            <= self.hbm_bytes_per_device
 
 
 @dataclass
@@ -254,6 +276,24 @@ class DeviceSliceAllocator:
                         f"no free {mesh_label(shape)} slice within "
                         f"{timeout_s}s")
 
+    def acquire_span(self, lease: SliceLease) -> SliceLease:
+        """Blocking re-acquire of the EXACT device span of a released
+        lease (step-mode preemption: the loop's carried state and its
+        compiled executables are bound to those devices, so after
+        yielding the slice for a preemption gap it must come back to
+        the same chips). Waits indefinitely — the holder released
+        everything before waiting, so there is no cycle to deadlock
+        on, and whoever borrowed the span releases it after a bounded
+        batch."""
+        size = chips_of(lease.shape)
+        with self._cond:
+            while any(self._busy[lease.start:lease.start + size]):
+                self._cond.wait()
+            for k in range(lease.start, lease.start + size):
+                self._busy[k] = True
+        return SliceLease(self.devices[lease.start:lease.start + size],
+                          lease.shape, lease.start)
+
     def release(self, lease: SliceLease):
         size = chips_of(lease.shape)
         with self._cond:
@@ -308,12 +348,20 @@ class MeshPolicy:
                    hbm_gb: float = 16.0,
                    devices: Optional[Sequence[object]] = None,
                    max_chips: Optional[int] = None,
+                   carry_recyclables: bool = False,
                    **memory_overrides) -> "MeshPolicy":
         """Derive the policy analytically: for each bucket edge, the
         smallest power-of-two slice whose estimated per-device footprint
         fits `hbm_gb`. A bucket that does not fit even the largest slice
         still gets that slice in the map but fails `admits()` — the
-        scheduler rejects it at submit as "too_large"."""
+        scheduler rejects it at submit as "too_large".
+
+        carry_recyclables: size slices for STEP-MODE serving (a
+        RecyclePolicy will drive the loop): the fitting loop then
+        prices the carried Recyclables exactly like the admission
+        guard will, so a bucket whose opaque fold just fits an n-chip
+        slice is assigned the bigger slice it actually needs instead
+        of being auto-sized into a guaranteed "too_large"."""
         if devices is None:
             import jax
             devices = jax.devices()
@@ -324,11 +372,51 @@ class MeshPolicy:
         shapes: Dict[int, int] = {}
         for edge in edges:
             n = 1
-            while not memory.fits(edge, max_batch, msa_depth, n) \
+            while not memory.fits(edge, max_batch, msa_depth, n,
+                                  carry_recyclables=carry_recyclables) \
                     and n * 2 <= cap:
                 n *= 2
             shapes[int(edge)] = n
         return cls(shapes, devices=devices, memory=memory)
+
+    @classmethod
+    def parse(cls, spec: str, model=None, params=None, buckets=None,
+              max_batch: int = 1, msa_depth: int = 0,
+              hbm_gb: float = 16.0,
+              devices: Optional[Sequence[object]] = None,
+              carry_recyclables: bool = False,
+              **memory_overrides) -> Optional["MeshPolicy"]:
+        """The ONE parser for every `--mesh-policy` surface (the
+        loadtest CLI, `fleet.ProcFleet` replica configs,
+        `fleet.procfleet.replica_main`): "" -> None (single-chip,
+        today's behavior), "auto" -> `from_model` with the analytic
+        HBM budget (requires model/params/buckets), or an explicit
+        "BUCKET=CHIPS,..." map, e.g. "32=1,128=4". Raises ValueError
+        on a malformed spec — a typo'd policy must fail loudly at
+        boot, not silently serve single-chip."""
+        spec = (spec or "").strip()
+        if not spec:
+            return None
+        if spec == "auto":
+            if model is None or params is None or buckets is None:
+                raise ValueError(
+                    "--mesh-policy auto needs model/params/buckets")
+            return cls.from_model(model, params, buckets,
+                                  max_batch=max_batch,
+                                  msa_depth=msa_depth, hbm_gb=hbm_gb,
+                                  devices=devices,
+                                  carry_recyclables=carry_recyclables,
+                                  **memory_overrides)
+        shapes = {}
+        for kv in spec.split(","):
+            try:
+                bucket, chips = kv.split("=")
+                shapes[int(bucket)] = int(chips)
+            except ValueError:
+                raise ValueError(
+                    f"bad --mesh-policy entry {kv!r} "
+                    f"(want BUCKET=CHIPS, e.g. 32=1,128=4)")
+        return cls(shapes, devices=devices)
 
     def shape_for(self, bucket_len: int) -> MeshShape:
         return self.shapes.get(int(bucket_len), (1, 1))
@@ -336,16 +424,20 @@ class MeshPolicy:
     def chips_for(self, bucket_len: int) -> int:
         return chips_of(self.shape_for(bucket_len))
 
-    def admits(self, bucket_len: int, batch_size: int, msa_depth: int)\
-            -> bool:
+    def admits(self, bucket_len: int, batch_size: int, msa_depth: int,
+               carry_recyclables: bool = False) -> bool:
         """False when the bucket's configured slice — already the
         largest one the policy was willing/able to assign — cannot hold
         the batch's analytic footprint. The scheduler maps False to
-        status "too_large" at submit."""
+        status "too_large" at submit, and passes `carry_recyclables`
+        iff a RecyclePolicy makes it run the step loop (whose carried
+        Recyclables are extra live bytes the opaque fold never
+        double-buffers)."""
         if self.memory is None:
             return True
         return self.memory.fits(bucket_len, batch_size, msa_depth,
-                                shape=self.shape_for(bucket_len))
+                                shape=self.shape_for(bucket_len),
+                                carry_recyclables=carry_recyclables)
 
     def allocator(self) -> DeviceSliceAllocator:
         return DeviceSliceAllocator(self.devices)

@@ -1,0 +1,185 @@
+"""Recycle-aware iteration-level scheduling policy (ISSUE 9).
+
+Until now a fold was an opaque unit of work: `predict.fold` runs every
+recycle inside one `lax.scan`, so a fold that converged after recycle 1
+still pays for all N, and a flagship batch holds its device slice
+hostage until the last recycle finishes. ParaFold's workload analysis
+(PAPERS.md) makes recycle count the dominant inference-throughput lever,
+and the recycling loop is a natural scheduling quantum — the same
+insight iteration-level LLM servers exploit between decode tokens.
+
+`RecyclePolicy` makes the SCHEDULER own that loop. With
+`Scheduler(recycle_policy=RecyclePolicy(...))` the executor compiles an
+embed+first-pass executable plus a single-recycle step executable
+(`FoldExecutor.run_init` / `run_step`; `predict.fold_init` /
+`fold_step` are the underlying programs, one function with the scan
+body, so step-loop numerics match the `lax.scan` path EXACTLY when no
+early exit fires), and between steps the scheduler can:
+
+- EARLY-EXIT converged elements: when an element's inter-recycle delta
+  (max of mean-abs CA displacement over its real residues and max-abs
+  confidence change) drops below `converge_tol`, its ticket resolves
+  NOW with the current coords/confidence (`FoldResponse.recycles` says
+  how many iterations it actually ran) and the survivor batch is
+  re-packed; when every real element has converged the remaining steps
+  are skipped entirely (`serve_recycles_skipped_total`);
+- PREEMPT between recycles: tight-deadline traffic lands between a
+  flagship batch's steps instead of behind its last recycle
+  (`serve_preemptions_total`), so both traffic classes coexist on one
+  fleet;
+- STREAM progressive results: every step publishes a `FoldProgress`
+  (coords + confidence + recycle index) to the caller's `FoldTicket`,
+  and the fleet front door exposes the latest one on the existing
+  long-poll (`GET /v1/result/<id>?progress=1` -> 206 + X-Recycle).
+
+`converge_tol=0.0` (the default) disables early exit — every element
+runs the full `num_recycles`, and because the step body IS the scan
+body the served numerics are bit-identical to the opaque path. Only a
+policy with `converge_tol > 0` can serve a result that differs from
+the fixed-recycle fold, which is why the scheduler keys such results
+under distinct cache keys (`RecyclePolicy.key_extras` feeds
+`fold_key(extras=)` — an early-exited result can never be served to a
+caller demanding full recycles).
+
+`Scheduler(recycle_policy=None)` — the default — is byte-for-byte the
+pre-ISSUE-9 behavior: one opaque `lax.scan` fold per batch, no step
+executables, identical scrubbed `serve_stats()`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class RecyclePolicy:
+    """How the scheduler drives the recycle loop.
+
+    converge_tol: per-element convergence threshold on the
+        inter-recycle delta (see `element_deltas`); an element retires
+        as soon as its delta <= tol after at least `min_recycles`
+        steps. 0.0 = never (early exit off; numerics match the opaque
+        `lax.scan` fold exactly).
+    min_recycles: floor on recycle iterations before early exit may
+        fire (the embed pass is iteration 0 and never counts as a
+        recycle). Only meaningful with converge_tol > 0.
+    preempt: allow tight-deadline pending work to execute between this
+        batch's recycles (it jumps the max_wait window — the whole
+        point is jumping the queue). A preempting batch cannot itself
+        be preempted, so preemption depth is bounded at 1.
+    stream: publish per-recycle FoldProgress updates (coords +
+        confidence) to each element's FoldTicket. Costs one host copy
+        of the element's rows per step; off by default.
+    """
+
+    converge_tol: float = 0.0
+    min_recycles: int = 0
+    preempt: bool = True
+    stream: bool = False
+
+    def __post_init__(self):
+        if self.converge_tol < 0:
+            raise ValueError(
+                f"converge_tol must be >= 0, got {self.converge_tol}")
+        if self.min_recycles < 0:
+            raise ValueError(
+                f"min_recycles must be >= 0, got {self.min_recycles}")
+
+    def affects_results(self) -> bool:
+        """True when this policy can serve a result that differs from
+        the fixed-full-recycle fold — exactly the converge_tol > 0
+        case. Preemption and streaming change WHEN work happens, never
+        what is computed, so they do not split cache keys."""
+        return self.converge_tol > 0.0
+
+    def key_extras(self) -> Optional[tuple]:
+        """Cache-key contribution (`cache.keys.fold_key(extras=)`).
+        None when the policy cannot change results, so tol-0 /
+        policy-off schedulers (and offline `fold_and_write` callers)
+        keep sharing entries; a result-affecting policy keys under
+        ("recycle_policy", tol, min_recycles) so an early-exited result
+        is never served to a caller demanding full recycles."""
+        if not self.affects_results():
+            return None
+        return ("recycle_policy", float(self.converge_tol),
+                int(self.min_recycles))
+
+    def snapshot(self) -> dict:
+        return {"converge_tol": self.converge_tol,
+                "min_recycles": self.min_recycles,
+                "preempt": self.preempt,
+                "stream": self.stream}
+
+
+def element_deltas(prev_coords: np.ndarray, prev_conf: np.ndarray,
+                   coords: np.ndarray, conf: np.ndarray,
+                   lengths: Sequence[int],
+                   rows: Optional[Sequence[int]] = None) -> List[float]:
+    """Per-element convergence signal between two consecutive recycle
+    states: max(mean |Δcoords| over the element's real residues,
+    max |Δconfidence|). Mean-abs displacement (not max) for coords so
+    one flexible terminal residue cannot hold a converged core hostage;
+    max for confidence because it is already per-residue bounded in
+    [0, 1]. Padding rows/residues are excluded — they carry garbage
+    that must not gate real elements. `rows` maps element position to
+    its batch row (default: position == row — the dense-prefix case);
+    the scheduler passes the live row map when retired rows stay in
+    place (multi-chip leases skip physical repacking)."""
+    out = []
+    for i, n in enumerate(lengths):
+        n = int(n)
+        r = i if rows is None else int(rows[i])
+        if n <= 0:
+            out.append(0.0)
+            continue
+        dc = float(np.abs(coords[r, :n] - prev_coords[r, :n]).mean())
+        df = float(np.abs(conf[r, :n] - prev_conf[r, :n]).max())
+        out.append(max(dc, df))
+    return out
+
+
+def repack_rows(state, rows: Sequence[int], batch_size: int):
+    """Gather survivor rows to the front of the carried FoldStepState
+    (and return the same row order for the batch tensors): retired
+    rows stop occupying live row slots, so the survivor batch stays a
+    dense prefix and per-step host fetches/convergence bookkeeping
+    slice `[:len(rows)]`. The batch shape is CLOSED (always padded to
+    max_batch_size), so rows must be padded back to `batch_size`; the
+    pad index repeats the last survivor — its output is never read.
+
+    Device-side gather on the batch axis only: the pair/msa sharded
+    axes are untouched, so the same repack works on a mesh-sharded
+    carry (the caller decides whether to bother — see the scheduler's
+    step loop)."""
+    import jax
+    import jax.numpy as jnp
+
+    if not rows:
+        raise ValueError("repack_rows needs at least one survivor")
+    idx_list = list(rows) + [rows[-1]] * (batch_size - len(rows))
+    idx = jnp.asarray(np.asarray(idx_list, np.int32))
+    return jax.tree_util.tree_map(lambda x: jnp.take(x, idx, axis=0),
+                                  state), idx_list
+
+
+def repack_batch(batch: dict, idx_list: Sequence[int]) -> dict:
+    """Re-pack the assembled batch tensors with the same row order
+    `repack_rows` chose, so the step executable's inputs and its
+    carried state stay row-aligned. Only the canonical input keys are
+    carried over — auxiliary keys (e.g. the executor's cached device
+    placement) are row-stale by definition and must be dropped."""
+    import jax
+    import jax.numpy as jnp
+
+    idx = jnp.asarray(np.asarray(idx_list, np.int32))
+    return {k: (None if batch[k] is None
+                else jnp.take(batch[k], idx, axis=0))
+            for k in ("seq", "mask", "msa", "msa_mask")}
+
+
+def steps_saved(num_recycles: int, executed: int) -> int:
+    """Batch-level recycle steps skipped by early exit."""
+    return max(0, int(num_recycles) - int(executed))

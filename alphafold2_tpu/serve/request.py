@@ -103,10 +103,32 @@ class FoldResponse:
     error: Optional[str] = None
     source: str = "fold"
     attempts: int = 1
+    # recycle iterations actually executed for this result (step-mode
+    # scheduling only — serve.recycle.RecyclePolicy; None everywhere
+    # else, including cache/coalesced/forwarded serves and the classic
+    # opaque-fold path). With early exit this can be < the configured
+    # num_recycles: the element converged and skipped the rest.
+    recycles: Optional[int] = None
 
     @property
     def ok(self) -> bool:
         return self.status == "ok"
+
+
+@dataclass
+class FoldProgress:
+    """One progressive (per-recycle) result published to a FoldTicket
+    by the step-mode scheduler (RecyclePolicy(stream=True)): the
+    element's coords + confidence after `recycle` iterations
+    (0 = the embed/first pass). `converged` marks the update that
+    retired the element early — its terminal FoldResponse carries the
+    same arrays."""
+
+    request_id: str
+    recycle: int
+    coords: np.ndarray                # (n, 3), unpadded
+    confidence: np.ndarray            # (n,)
+    converged: bool = False
 
 
 class FoldTicket:
@@ -118,6 +140,8 @@ class FoldTicket:
         self._response: Optional[FoldResponse] = None
         self._lock = threading.Lock()
         self._callbacks: list = []
+        self._progress: list = []           # FoldProgress, oldest first
+        self._progress_callbacks: list = []
         # optional hook fired (best-effort, once per expiry) when
         # result(timeout=) gives up on this ticket — fleet transports
         # use it to send the remote owner a cancel so a caller that
@@ -154,6 +178,43 @@ class FoldTicket:
                 fn(self._response)
             except Exception:
                 pass
+
+    def _publish_progress(self, progress: FoldProgress):
+        """Step-mode scheduler hook: record one per-recycle progressive
+        result and fan it out to progress observers. Runs on the
+        executing thread between recycles — observers must be short and
+        never block; their exceptions are swallowed like done-callback
+        ones."""
+        with self._lock:
+            self._progress.append(progress)
+            callbacks = list(self._progress_callbacks)
+        for cb in callbacks:
+            try:
+                cb(progress)
+            except Exception:
+                pass
+
+    def add_progress_callback(self, fn):
+        """Run `fn(FoldProgress)` for every progressive update,
+        including (immediately) any already published."""
+        with self._lock:
+            backlog = list(self._progress)
+            self._progress_callbacks.append(fn)
+        for p in backlog:
+            try:
+                fn(p)
+            except Exception:
+                pass
+
+    def progress(self) -> list:
+        """All progressive updates published so far, oldest first
+        (empty unless the scheduler runs RecyclePolicy(stream=True))."""
+        with self._lock:
+            return list(self._progress)
+
+    def latest_progress(self) -> Optional[FoldProgress]:
+        with self._lock:
+            return self._progress[-1] if self._progress else None
 
     def done(self) -> bool:
         return self._event.is_set()

@@ -89,6 +89,31 @@ even the largest configured slice resolve status "too_large"
 allocator occupancy; fold spans are tagged with their mesh label and a
 `shard` span prices params/input placement in the waterfall.
 
+With a `recycle_policy` (serve.recycle.RecyclePolicy — OFF by default,
+and with it off this scheduler is byte-for-byte the opaque-fold
+behavior), the SCHEDULER owns the recycle loop instead of `lax.scan`:
+each batch runs the embed+first-pass executable then one single-recycle
+step executable per iteration (`FoldExecutor.run_init`/`run_step` —
+the scan body as its own program, so full-recycle numerics match the
+opaque path exactly), and between steps the scheduler retires
+converged elements early (per-element coordinate/confidence delta
+below `converge_tol`; the survivor batch is re-packed and a fully
+converged batch skips its remaining recycles —
+`serve_recycles_skipped_total`), lets tight-deadline pending work
+PREEMPT the gap (`serve_preemptions_total`), and streams per-recycle
+progressive results to each FoldTicket (`RecyclePolicy(stream=True)`).
+A result-affecting policy (converge_tol > 0) keys the cache under
+distinct `fold_key` extras, so an early-exited result is never served
+to a caller demanding fixed full recycles.
+
+Cache-aware admission (`SchedulerConfig.parked_bytes_budget` > 0): an
+in-flight duplicate costs ~0 — it parks as a follower and never
+touches the accelerator — so submit() admits coalescing followers PAST
+a "full" queue, bounded by the budget on their parked request bytes
+(`serve_parked_admits_total`). Novel work still honors `queue_limit`
+exactly as before; the budget only widens the door for work that is
+already being done.
+
 Batches are always padded to `max_batch_size` (bucketing.assemble), so
 the compiled-shape set is closed: one executable per (bucket,
 num_recycles), never one per observed batch size.
@@ -114,10 +139,14 @@ from alphafold2_tpu.obs.trace import (MultiTrace, NULL_TRACE, NULL_TRACER,
                                       Tracer)
 from alphafold2_tpu.serve.bucketing import BucketPolicy
 from alphafold2_tpu.serve.executor import FoldExecutor
-from alphafold2_tpu.serve.meshpolicy import MeshPolicy, SliceLease
+from alphafold2_tpu.serve.meshpolicy import (MeshPolicy, SliceLease,
+                                             chips_of)
 from alphafold2_tpu.serve.metrics import ServeMetrics
-from alphafold2_tpu.serve.request import (FoldRequest, FoldResponse,
-                                          FoldTicket)
+from alphafold2_tpu.serve.recycle import (RecyclePolicy, element_deltas,
+                                          repack_batch, repack_rows,
+                                          steps_saved)
+from alphafold2_tpu.serve.request import (FoldProgress, FoldRequest,
+                                          FoldResponse, FoldTicket)
 from alphafold2_tpu.serve.resilience import (CircuitBreaker, Quarantine,
                                              RetryPolicy, WatchdogTimeout,
                                              run_with_watchdog)
@@ -150,6 +179,11 @@ class SchedulerConfig:
     # pad shallow, keep the first msa_depth rows of deeper MSAs) for
     # production traffic; 0 serves MSA-free.
     msa_depth: Optional[int] = None
+    # Cache-aware admission: bytes of parked duplicate-request arrays
+    # submit() may admit as coalescing followers PAST a full queue
+    # (an in-flight duplicate costs ~0 to serve). 0 (default) = off:
+    # duplicates respect queue_limit exactly like novel work.
+    parked_bytes_budget: int = 0
 
     def __post_init__(self):
         if self.full_policy not in ("reject", "block"):
@@ -157,12 +191,15 @@ class SchedulerConfig:
                              f"got {self.full_policy!r}")
         if self.max_batch_size < 1 or self.queue_limit < 1:
             raise ValueError("max_batch_size and queue_limit must be >= 1")
+        if self.parked_bytes_budget < 0:
+            raise ValueError("parked_bytes_budget must be >= 0")
 
 
 class _Entry:
     __slots__ = ("request", "ticket", "bucket_len", "enqueued_at",
                  "deadline", "cache_key", "store_key", "trace", "route",
-                 "attempts", "not_before", "group")
+                 "attempts", "not_before", "group",
+                 "parked_admit_bytes")
 
     def __init__(self, request: FoldRequest, bucket_len: int):
         self.request = request
@@ -180,6 +217,9 @@ class _Entry:
         # bisection isolation group: entries sharing a group id batch
         # ONLY with each other, so a failing cohort stays cornered
         self.group: Optional[int] = None
+        # bytes this entry holds of the cache-aware admission budget
+        # (nonzero only for followers admitted past a full queue)
+        self.parked_admit_bytes = 0
         self.mark_enqueued()
 
     def resolve(self, response: FoldResponse):
@@ -248,6 +288,14 @@ class Scheduler:
         Buckets route to their policy slice, disjoint slices fold
         concurrently, and the analytic HBM admission guard rejects
         folds no configured slice can hold (status "too_large").
+    recycle_policy: optional serve.recycle.RecyclePolicy (OFF when
+        None — the default, which byte-for-byte preserves the opaque
+        `lax.scan` fold behavior). Requires a step-capable executor
+        (FoldExecutor is; an executor without run_init/run_step keeps
+        the opaque path). The scheduler then drives the recycle loop
+        one step at a time: early-exit on convergence, preemption
+        between recycles, progressive results — see the module
+        docstring and serve/recycle.py.
     """
 
     def __init__(self, executor: FoldExecutor, buckets: BucketPolicy,
@@ -261,7 +309,8 @@ class Scheduler:
                  retry: Optional[RetryPolicy] = None,
                  executor_factory: Optional[Callable[[], object]] = None,
                  quarantine_path: Optional[str] = None,
-                 mesh_policy: Optional[MeshPolicy] = None):
+                 mesh_policy: Optional[MeshPolicy] = None,
+                 recycle_policy: Optional[RecyclePolicy] = None):
         self.executor = executor
         self.buckets = buckets
         self.config = config or SchedulerConfig()
@@ -318,6 +367,50 @@ class Scheduler:
             self._c_nonfinite = reg.counter(
                 "serve_nonfinite_outputs_total",
                 "fold outputs rejected by non-finite validation")
+        # step-mode recycle scheduling (before the mesh block: the LRU
+        # autosizing below must know whether each (bucket, slice) needs
+        # one executable or the init+step pair)
+        self.recycle_policy = recycle_policy
+        self._step_capable = hasattr(executor, "run_init") \
+            and hasattr(executor, "run_step")
+        self._n_recycles_exec = 0       # batch-level step executions
+        self._n_recycles_skipped = 0    # batch-level steps early-exited
+        self._n_preemptions = 0
+        self._n_retired_early = 0       # elements resolved before the
+        self._n_parked_admits = 0       # last configured recycle
+        # "a preemptor never preempts": per-thread reentrancy guard for
+        # the between-recycles preemption window
+        self._preempting = threading.local()
+        if recycle_policy is not None:
+            self._c_recycles = reg.counter(
+                "serve_recycles_total",
+                "recycle step executions by the step-mode scheduler")
+            self._c_recycles_skipped = reg.counter(
+                "serve_recycles_skipped_total",
+                "recycle steps skipped because every batch element "
+                "converged early")
+            self._c_preemptions = reg.counter(
+                "serve_preemptions_total",
+                "batches preempted between recycles by tighter-deadline "
+                "pending work")
+            # step mode needs TWO executables per (bucket, slice) —
+            # init + step; grow the LRU so warmup's pair is not
+            # self-evicting (the mesh block below multiplies its own
+            # sizing the same way)
+            if self._step_capable and hasattr(executor, "max_entries"):
+                executor.max_entries = max(executor.max_entries,
+                                           2 * len(self.buckets.edges))
+        if self.config.parked_bytes_budget > 0 or cache is not None:
+            self._c_parked_admits = reg.counter(
+                "serve_parked_admits_total",
+                "coalescing followers admitted past a full queue under "
+                "the parked-bytes budget")
+        self._parked_admit_bytes = 0     # guarded by _cond
+        # best-effort preemption signal for leased step loops: the
+        # tightest deadline currently pending, refreshed by the worker
+        # each loop pass (pool threads read it under _cond)
+        self._pending_tightest: Optional[float] = None
+        self._pending_tightest_chips: Optional[int] = None
         self.mesh_policy = mesh_policy
         self._allocator = None
         self._mesh_pool: Optional[ThreadPoolExecutor] = None
@@ -340,6 +433,8 @@ class Scheduler:
                     len(self._allocator.slices(
                         mesh_policy.shape_for(edge)))
                     for edge in self.buckets.edges)
+                if recycle_policy is not None and self._step_capable:
+                    needed *= 2          # init + step pair per slice
                 executor.max_entries = max(executor.max_entries, needed)
             self._c_mesh_folds = reg.counter(
                 "serve_mesh_folds_total",
@@ -508,18 +603,27 @@ class Scheduler:
             msa_depth = self.config.msa_depth or 0
         keys = [(edge, self.config.max_batch_size, msa_depth,
                  self.config.num_recycles) for edge in self.buckets.edges]
+        # with a recycle policy the serving path runs the init+step
+        # executable pair, never the opaque fold — warm what will run
+        step_mode = self._use_step_loop()
         if self._allocator is None:
-            return self.executor.warmup(keys)
+            return self.executor.warmup(keys, step_mode=step_mode)
         fresh = 0
         for key in keys:
-            if not self.mesh_policy.admits(key[0], key[1], key[2]):
+            if not self.mesh_policy.admits(
+                    key[0], key[1], key[2],
+                    carry_recyclables=step_mode):
                 continue     # the guard rejects this bucket at submit;
                 #              compiling it would be the OOM we prevent
             shape = self.mesh_policy.shape_for(key[0])
             for devices in self._allocator.slices(shape):
                 fresh += self.executor.warmup(
-                    [key], devices=devices, mesh_shape=shape)
+                    [key], devices=devices, mesh_shape=shape,
+                    step_mode=step_mode)
         return fresh
+
+    def _use_step_loop(self) -> bool:
+        return self.recycle_policy is not None and self._step_capable
 
     # -- submission ------------------------------------------------------
 
@@ -561,7 +665,8 @@ class Scheduler:
                 guard_msa = 0 if request.msa is None \
                     else int(request.msa.shape[0])
             if not self.mesh_policy.admits(
-                    bucket_len, self.config.max_batch_size, guard_msa):
+                    bucket_len, self.config.max_batch_size, guard_msa,
+                    carry_recyclables=self._use_step_loop()):
                 self._raise_unless_running(entry)
                 if not self._serve_too_large_from_cache(entry):
                     self._too_large_shed(entry)
@@ -647,10 +752,19 @@ class Scheduler:
     # -- cache / coalescing ----------------------------------------------
 
     def _cache_key_for(self, request: FoldRequest) -> str:
+        # a result-affecting recycle policy (converge_tol > 0 can serve
+        # an early-exited fold) keys under distinct extras; tol-0 /
+        # policy-off schedulers keep the bare key and stay
+        # cache-compatible with each other and with offline
+        # fold_and_write callers — an early-exited result must NEVER be
+        # served to a caller demanding fixed full recycles (ISSUE 9)
+        extras = None
+        if self.recycle_policy is not None:
+            extras = self.recycle_policy.key_extras()
         return fold_key(request.seq, request.msa,
                         msa_depth=self.config.msa_depth,
                         num_recycles=self.config.num_recycles,
-                        model_tag=self.model_tag)
+                        model_tag=self.model_tag, extras=extras)
 
     def _serve_from_cache_or_coalesce(self, entry: _Entry) -> bool:
         """submit() fast path: True when the entry was fully handled
@@ -692,9 +806,47 @@ class Scheduler:
         # concurrent duplicates all pass the check and overshoot the
         # limit. (Lock order _cond -> registry lock; no path takes them
         # in the other order.)
+        def _trace_parked(leader):
+            # runs under the registry lock: settlement cannot have
+            # resolved (and emitted) this trace yet, so the leader
+            # link is guaranteed to make it into the record
+            if leader is not None:
+                entry.trace.link(leader.trace.trace_id)
+            entry.trace.event("coalesced")
+            entry.trace.end("submit")
+            entry.trace.begin("parked")
+
         with self._cond:
             if (self._depth + self._inflight.waiting()
                     >= self.config.queue_limit):
+                # cache-aware admission (ISSUE 9): an in-flight
+                # duplicate costs ~0 — it parks behind the leader and
+                # never touches the accelerator — so a "full" queue may
+                # still admit it as a FOLLOWER, bounded by the
+                # parked-bytes budget on its request arrays. Only an
+                # EXISTING leader qualifies (attach_follower refuses
+                # otherwise): a novel key would enqueue exactly the
+                # real work the bound just refused.
+                budget = self.config.parked_bytes_budget
+                if budget > 0:
+                    nbytes = entry.request.seq.nbytes + (
+                        0 if entry.request.msa is None
+                        else entry.request.msa.nbytes)
+
+                    def _trace_parked_admit(leader):
+                        entry.trace.event("parked_admit", bytes=nbytes)
+                        _trace_parked(leader)
+
+                    if self._parked_admit_bytes + nbytes <= budget \
+                            and self._inflight.attach_follower(
+                                key, entry,
+                                on_follower=_trace_parked_admit):
+                        entry.parked_admit_bytes = nbytes
+                        self._parked_admit_bytes += nbytes
+                        self._n_parked_admits += 1
+                        self._c_parked_admits.inc()
+                        self.metrics.record_coalesced()
+                        return True
                 if self.config.full_policy == "reject":
                     self.metrics.record_rejected()
                     entry.trace.finish("rejected",
@@ -708,16 +860,6 @@ class Scheduler:
                 # (the fold still populates the store via store_key)
                 entry.store_key = key
                 return False
-            def _trace_parked(leader):
-                # runs under the registry lock: settlement cannot have
-                # resolved (and emitted) this trace yet, so the leader
-                # link is guaranteed to make it into the record
-                if leader is not None:
-                    entry.trace.link(leader.trace.trace_id)
-                entry.trace.event("coalesced")
-                entry.trace.end("submit")
-                entry.trace.begin("parked")
-
             is_leader, _ = self._inflight.attach_with_leader(
                 key, entry, on_follower=_trace_parked)
         if not is_leader:
@@ -997,6 +1139,11 @@ class Scheduler:
             promoted.cache_key = entry.cache_key
             promoted.trace.event("leader_promoted",
                                  from_trace=entry.trace.trace_id)
+            # a budget-admitted follower that becomes leader now
+            # occupies real queue depth, not parked-budget bytes
+            nbytes, promoted.parked_admit_bytes = \
+                promoted.parked_admit_bytes, 0
+            self._parked_admit_bytes -= nbytes
             promoted.trace.end("parked")
             promoted.trace.begin("queue")
             # parked -> queued conversion: waiting() shrank by one as
@@ -1009,6 +1156,19 @@ class Scheduler:
         self.metrics.record_enqueued(depth)
         return True
 
+    def _release_parked_admit(self, entry: _Entry):
+        """Return a budget-admitted follower's bytes to the parked
+        admission budget. Called from every path a follower leaves the
+        registry (settle fan-out, own-deadline eviction, promotion);
+        no-op for normally admitted entries."""
+        nbytes = entry.parked_admit_bytes
+        if not nbytes:
+            return
+        entry.parked_admit_bytes = 0
+        with self._cond:
+            self._parked_admit_bytes -= nbytes
+            self._cond.notify_all()
+
     def _settle_followers(self, entry: _Entry, response: FoldResponse):
         """Fan the leader's terminal response out to its followers.
         Called from EVERY path that resolves a leader ticket, success or
@@ -1016,6 +1176,8 @@ class Scheduler:
         if entry.cache_key is None:
             return
         followers: List[_Entry] = self._inflight.settle(entry.cache_key)
+        for f in followers:
+            self._release_parked_admit(f)
         if followers:
             # parked followers counted against queue_limit: their
             # release frees capacity block-mode submitters wait on
@@ -1079,6 +1241,10 @@ class Scheduler:
         if self.cache is not None:
             stats["cache"]["store"] = self.cache.snapshot()
             stats["cache"]["inflight"] = self._inflight.snapshot()
+            stats["cache"]["parked_admits"] = self._n_parked_admits
+            with self._cond:
+                stats["cache"]["parked_admit_bytes"] = \
+                    self._parked_admit_bytes
         if self.router is not None:
             stats["router"] = self.router.snapshot()
         if self.retry is not None:
@@ -1104,6 +1270,14 @@ class Scheduler:
                                  allocator=self._allocator.snapshot(),
                                  inflight_batches=inflight,
                                  folds=folds)
+        if self.recycle_policy is not None:
+            stats["recycle"] = dict(
+                self.recycle_policy.snapshot(),
+                step_mode=self._use_step_loop(),
+                recycles_executed=self._n_recycles_exec,
+                recycles_skipped=self._n_recycles_skipped,
+                preemptions=self._n_preemptions,
+                retired_early=self._n_retired_early)
         with self._cond:
             stats["running"] = self._running
             stats["draining"] = self._draining
@@ -1141,6 +1315,32 @@ class Scheduler:
                     entry = self._incoming.popleft()
                     self._pending.setdefault(entry.bucket_len,
                                              []).append(entry)
+                if self.recycle_policy is not None \
+                        and self.recycle_policy.preempt \
+                        and self._allocator is not None:
+                    # the ONLY reader is the leased preemption path,
+                    # so the scan is skipped entirely when no pool
+                    # thread could ever consult it. Eligibility is
+                    # _urgent_eligible — the same predicate the
+                    # preemption take uses, so the worker never
+                    # advertises a deadline the take would refuse.
+                    # The tightest entry's slice size rides along so
+                    # a leased loop can tell whether yielding even
+                    # COULD place it.
+                    now_p = time.monotonic()
+                    tightest, t_bucket = None, None
+                    for b_len, pend in self._pending.items():
+                        for e in pend:
+                            if not self._urgent_eligible(e, now_p):
+                                continue
+                            if tightest is None or e.deadline < tightest:
+                                tightest, t_bucket = e.deadline, b_len
+                    self._pending_tightest = tightest
+                    self._pending_tightest_chips = (
+                        None if tightest is None
+                        or self.mesh_policy is None
+                        else chips_of(
+                            self.mesh_policy.shape_for(t_bucket)))
                 stopping = not self._running
                 drain = self._drain
             if stopping and not drain:
@@ -1209,6 +1409,8 @@ class Scheduler:
             lambda f: f.deadline is not None and now > f.deadline)
         if not expired:
             return
+        for f in expired:
+            self._release_parked_admit(f)
         with self._cond:
             self._cond.notify_all()   # waiting() shrank: wake blocked
         for f in expired:             # submitters before resolving
@@ -1363,6 +1565,9 @@ class Scheduler:
 
     def _execute(self, bucket_len: int, entries: List[_Entry],
                  lease: Optional[SliceLease] = None):
+        if self._use_step_loop():
+            self._execute_recycle(bucket_len, entries, lease)
+            return
         cfg = self.config
         t0 = time.monotonic()
         if self.tracer.enabled:
@@ -1477,6 +1682,391 @@ class Scheduler:
             # additionally survives a misbehaving metrics subclass —
             # observability must never take down serving)
             pass
+
+    # -- step-mode recycle loop (ISSUE 9) --------------------------------
+
+    def _execute_recycle(self, bucket_len: int, entries: List[_Entry],
+                         lease: Optional[SliceLease] = None):
+        """Run one formed batch with the SCHEDULER owning the recycle
+        loop: embed+first-pass executable, then one single-recycle step
+        executable per iteration. Between steps: converged elements
+        retire early (their tickets resolve NOW; on single-device
+        carries the survivor batch is re-packed to a dense row prefix,
+        on multi-chip leases rows retire in place via the position->row
+        map; a fully-converged batch skips its remaining recycles),
+        tighter-deadline pending work preempts the gap, and progressive
+        results stream to tickets.
+        With converge_tol=0 every element runs all `num_recycles` steps
+        and — because the step program IS the scan body — the served
+        numerics are identical to the opaque `lax.scan` path."""
+        cfg = self.config
+        policy = self.recycle_policy
+        t0 = time.monotonic()
+        if self.tracer.enabled:
+            for e in entries:
+                e.trace.end("queue", bucket_len=bucket_len)
+                e.trace.end("retry")   # closes a retry-wait span; no-op
+        for e in entries:              # on a first execution
+            e.attempts += 1
+        devices = lease.devices if lease is not None else None
+        mesh_shape = lease.shape if lease is not None else None
+        num_recycles = cfg.num_recycles
+        active = list(entries)         # still folding, position-ordered
+        rows = list(range(len(entries)))   # position -> batch row
+        # physical repacking gathers the carried state on the batch
+        # axis; on a MULTI-chip lease that is an eager op over a
+        # mesh-sharded O(L^2) carry outside the step executable's
+        # sharding discipline — retire rows logically there instead
+        # (the rows map above) and compact only where the carry lives
+        # on a single device
+        can_repack = devices is None or len(devices) == 1
+        any_nonfinite = False
+        r = 0
+        # entries already left the queue: any unresolved exception here
+        # would orphan tickets — same guard discipline as _execute
+        try:
+            batch_trace = (MultiTrace([e.trace for e in active])
+                           if self.tracer.enabled else NULL_TRACE)
+            with batch_trace.span("batch_form", bucket_len=bucket_len,
+                                  n_real=len(entries)):
+                batch, waste = self.buckets.assemble(
+                    [e.request for e in entries], bucket_len,
+                    cfg.max_batch_size, msa_depth=cfg.msa_depth)
+            state = self._run_step_guarded(
+                lambda: self.executor.run_init(
+                    batch, trace=batch_trace, devices=devices,
+                    mesh_shape=mesh_shape))
+            # the per-step device-to-host fetch exists for convergence
+            # deltas and streaming; a preemption-only policy needs
+            # neither, so it pays one fetch at the end like the opaque
+            # path instead of copying the padded batch every recycle
+            fetch_steps = policy.converge_tol > 0 or policy.stream
+            coords_np = conf_np = None
+            if fetch_steps:
+                coords_np = np.asarray(state.coords)
+                conf_np = np.asarray(state.confidence)
+                self._stream_progress(active, rows, coords_np, conf_np,
+                                      0)
+            while active and r < num_recycles:
+                if policy.preempt:
+                    lease = self._maybe_preempt(active, lease, r)
+                r += 1
+                prev_coords, prev_conf = coords_np, conf_np
+                step_trace = (MultiTrace([e.trace for e in active])
+                              if self.tracer.enabled else NULL_TRACE)
+                state = self._run_step_guarded(
+                    lambda st=state, rr=r, tr=step_trace:
+                    self.executor.run_step(
+                        batch, st, rr, trace=tr, devices=devices,
+                        mesh_shape=mesh_shape))
+                self._n_recycles_exec += 1
+                self._c_recycles.inc()
+                if fetch_steps:
+                    coords_np = np.asarray(state.coords)
+                    conf_np = np.asarray(state.confidence)
+                    self._stream_progress(active, rows, coords_np,
+                                          conf_np, r)
+                if r >= num_recycles:
+                    break          # final state; everyone retires below
+                if policy.converge_tol <= 0 or r < policy.min_recycles:
+                    continue
+                deltas = element_deltas(
+                    prev_coords, prev_conf, coords_np, conf_np,
+                    [e.request.length for e in active], rows=rows)
+                retired = [i for i, d in enumerate(deltas)
+                           if d <= policy.converge_tol]
+                if not retired:
+                    continue
+                now = time.monotonic()
+                for i in retired:
+                    e = active[i]
+                    self._n_retired_early += 1
+                    e.trace.event("recycle_converged", recycle=r,
+                                  delta=deltas[i])
+                    if not self._retire_entry(e, bucket_len,
+                                              coords_np[rows[i]],
+                                              conf_np[rows[i]],
+                                              r, now):
+                        any_nonfinite = True
+                survivors = [i for i in range(len(active))
+                             if i not in set(retired)]
+                if not survivors:
+                    skipped = steps_saved(num_recycles, r)
+                    self._n_recycles_skipped += skipped
+                    self._c_recycles_skipped.inc(skipped)
+                    active = []
+                    break
+                if can_repack:
+                    # re-pack the survivor batch: survivors become a
+                    # dense row prefix of both the carried state and
+                    # the batch tensors (and the executor's placement
+                    # cache is dropped with the old batch dict)
+                    keep = [rows[i] for i in survivors]
+                    state, idx_list = repack_rows(state, keep,
+                                                  cfg.max_batch_size)
+                    batch = repack_batch(batch, idx_list)
+                    sel = np.asarray(keep)
+                    coords_np, conf_np = coords_np[sel], conf_np[sel]
+                    rows = list(range(len(survivors)))
+                else:
+                    # multi-chip carry: retire rows in place, only the
+                    # position -> row map shrinks
+                    rows = [rows[i] for i in survivors]
+                active = [active[i] for i in survivors]
+            if active and coords_np is None:
+                coords_np = np.asarray(state.coords)
+                conf_np = np.asarray(state.confidence)
+            now = time.monotonic()
+            for i, e in enumerate(active):
+                if not self._retire_entry(e, bucket_len,
+                                          coords_np[rows[i]],
+                                          conf_np[rows[i]], r, now):
+                    any_nonfinite = True
+        except Exception as exc:  # resolve/retry, never kill the caller
+            survivors = [e for e in entries if not e.ticket.done()]
+            if not survivors:
+                return            # everyone already retired
+            if self._handle_batch_failure(bucket_len, survivors, exc,
+                                          t0):
+                return            # retried, bisected, or quarantined
+            self.metrics.record_error(len(survivors))
+            for e in survivors:
+                self._resolve_entry(e, FoldResponse(
+                    request_id=e.request.request_id, status="error",
+                    bucket_len=bucket_len, error=repr(exc),
+                    attempts=e.attempts))
+            return
+        if self._breaker is not None:
+            # same device-health semantics as the opaque path: a batch
+            # with non-finite rows is suspect, a clean one is proof
+            (self._breaker.record_failure if any_nonfinite
+             else self._breaker.record_success)()
+        if lease is not None:
+            self._c_mesh_folds.inc(mesh=lease.label)
+        with self._cond:
+            if lease is not None:
+                self._mesh_batches[lease.label] = \
+                    self._mesh_batches.get(lease.label, 0) + 1
+                self._mesh_served[lease.label] = \
+                    self._mesh_served.get(lease.label, 0) + len(entries)
+            depth = self._depth
+        try:
+            self.metrics.record_batch(
+                bucket_len, cfg.max_batch_size, len(entries),
+                sum(e.request.length for e in entries), waste,
+                time.monotonic() - t0, depth,
+                cache_store=(None if self.cache is None
+                             else self.cache.snapshot()))
+        except Exception:
+            pass              # observability never takes down serving
+
+    def _retire_entry(self, e: _Entry, bucket_len: int, coords_row,
+                      conf_row, recycles: int, now: float) -> bool:
+        """Terminal "ok" resolution for one step-loop element at
+        `recycles` executed iterations (early-converged or final).
+        Returns False when the output failed non-finite validation
+        (the entry then went through _resolve_nonfinite instead)."""
+        n = e.request.length
+        if self.retry is not None and not (
+                np.isfinite(coords_row[:n]).all()
+                and np.isfinite(conf_row[:n]).all()):
+            self._resolve_nonfinite(e, bucket_len)
+            return False
+        coords = coords_row[:n].copy()
+        confidence = conf_row[:n].copy()
+        if self.recycle_policy.stream:
+            # the update that retired the element: same arrays its
+            # terminal response carries, flagged converged
+            try:
+                e.ticket._publish_progress(FoldProgress(
+                    e.request.request_id, recycles, coords.copy(),
+                    confidence.copy(), converged=True))
+            except Exception:
+                pass
+        latency = now - e.enqueued_at
+        self.metrics.record_served(bucket_len, latency)
+        self._resolve_entry(e, FoldResponse(
+            request_id=e.request.request_id, status="ok",
+            coords=coords, confidence=confidence,
+            bucket_len=bucket_len, latency_s=latency,
+            attempts=e.attempts, recycles=recycles))
+        return True
+
+    def _stream_progress(self, active: List[_Entry],
+                         rows: List[int], coords_np, conf_np,
+                         recycle: int):
+        """Publish one per-recycle progressive update to every active
+        element's ticket (RecyclePolicy(stream=True) only). `rows`
+        maps each active position to its batch row."""
+        if not self.recycle_policy.stream:
+            return
+        validate = self.retry is not None
+        for i, e in enumerate(active):
+            n = e.request.length
+            try:
+                coords = coords_np[rows[i], :n]
+                conf = conf_np[rows[i], :n]
+                if validate and not (np.isfinite(coords).all()
+                                     and np.isfinite(conf).all()):
+                    # the terminal path refuses to serve non-finite
+                    # output as "ok"; a progressive update must not
+                    # leak the same garbage to a streaming client
+                    continue
+                e.ticket._publish_progress(FoldProgress(
+                    e.request.request_id, recycle,
+                    coords.copy(), conf.copy()))
+            except Exception:
+                pass          # a broken observer never stalls the loop
+
+    def _run_step_guarded(self, call):
+        """One init/step executor call under the optional per-batch
+        watchdog — each recycle step is its own watchdog window, which
+        is exactly the granularity the step loop buys."""
+        watchdog_s = None if self.retry is None else self.retry.watchdog_s
+        if watchdog_s is None:
+            return call()
+        return run_with_watchdog(call, watchdog_s)
+
+    def _maybe_preempt(self, active: List[_Entry],
+                       lease: Optional[SliceLease], gap: int):
+        """Between-recycles preemption window. Inline (no lease): this
+        IS the worker thread, so it forms and executes tighter-deadline
+        pending batches directly — the deadline fold lands between the
+        long batch's recycles instead of behind its last one. On a
+        leased slice (dispatch-pool thread): when tighter-deadline work
+        is pending and the device pool is saturated, release the slice
+        for one gap so the worker can place the urgent batch, then
+        blocking-re-acquire the SAME span (the carried state and the
+        compiled executables are bound to those exact devices).
+        A preemptor never preempts (per-thread guard) and each gap
+        admits AT MOST ONE urgent batch, so preemption is bounded in
+        both depth and breadth — sustained deadline traffic interleaves
+        gap by gap instead of starving the running batch. Returns the
+        (possibly re-acquired) lease.
+
+        Known limits (ROADMAP): the yield frees SCHEDULING capacity,
+        not device memory — the suspended loop's carried state stays
+        resident, so an urgent batch on the freed chips is a
+        concurrent HBM peak the admission guard does not price (size
+        headroom accordingly on real hardware until memory-aware
+        preemption admission lands); and a leased yield for an urgent
+        entry still inside its max_wait window can go unplaced for
+        that window (bounded by max_wait_ms — the worker's batch
+        formation does not jump the window the way the inline take
+        does)."""
+        if getattr(self._preempting, "flag", False):
+            return lease
+        # an open circuit breaker pauses batch formation; a preemption
+        # gap must honor the same pause, not hammer the suspect
+        # executor with urgent batches during its recovery window
+        if self._breaker is not None and not self._breaker.allow_execute():
+            return lease
+        deadlines = [e.deadline for e in active if e.deadline is not None]
+        tighter_than = min(deadlines) if deadlines else None
+        if lease is None:
+            # ONE urgent batch per gap (same bound as the leased path's
+            # one-gap yield): each recycle step opens another gap, so a
+            # burst of deadline traffic interleaves with the running
+            # batch instead of starving it outright — sustained urgent
+            # arrivals must not pin a half-executed batch at one gap
+            # while its callers' result timeouts expire
+            cand = self._take_urgent(tighter_than)
+            if cand is None:
+                return lease
+            bucket2, take2 = cand
+            self._n_preemptions += 1
+            self._c_preemptions.inc()
+            for e in active:
+                e.trace.event("preempted", gap=gap,
+                              by_bucket=bucket2)
+            for e in take2:
+                e.trace.event("preempting", gap=gap)
+            self._preempting.flag = True
+            try:
+                self._execute(bucket2, take2)
+            finally:
+                self._preempting.flag = False
+            return lease
+        with self._cond:
+            urgent = self._pending_tightest
+            needed = self._pending_tightest_chips
+        if urgent is None or (tighter_than is not None
+                              and urgent >= tighter_than):
+            return lease
+        if self._allocator.can_allocate((1, 1)):
+            return lease      # free chips exist; nothing is starved
+        if needed is not None:
+            free = (self._allocator.total_devices
+                    - self._allocator.busy_devices)
+            if free + chips_of(lease.shape) < needed:
+                # yielding our slice still cannot place the urgent
+                # batch (it needs a wider slice than would free):
+                # don't pay the yield latency or count a preemption
+                # that admits nothing
+                return lease
+        self._n_preemptions += 1
+        self._c_preemptions.inc()
+        for e in active:
+            e.trace.event("preempted", gap=gap)
+        self._release_lease(lease)
+        # one gap's window for the worker to place the urgent batch
+        time.sleep(max(self.config.poll_ms / 1000.0 * 2, 0.01))
+        lease = self._allocator.acquire_span(lease)
+        self._set_busy_gauge()
+        return lease
+
+    @staticmethod
+    def _urgent_eligible(e: _Entry, now: float) -> bool:
+        """THE preemption-eligibility predicate: carries a live
+        (unexpired) deadline, is not backoff-gated, and is not part of
+        a bisection isolation group (cohort discipline wins). One copy,
+        shared by the urgent take and the worker's tightest-deadline
+        advertisement so they can never drift."""
+        return (e.deadline is not None and e.deadline > now
+                and e.group is None and e.not_before <= now)
+
+    def _take_urgent(self, tighter_than: Optional[float]):
+        """Worker-thread only (the inline preemption path): pick the
+        pending bucket holding the tightest not-yet-expired deadline
+        beating `tighter_than` (any deadline qualifies when the running
+        batch has none) and take up to max_batch_size of its entries,
+        tightest deadlines first. Bisection isolation groups never ride
+        a preemption batch — their cohort discipline wins."""
+        now = time.monotonic()
+        with self._cond:
+            while self._incoming:
+                entry = self._incoming.popleft()
+                self._pending.setdefault(entry.bucket_len,
+                                         []).append(entry)
+        best = None
+        for bucket_len, pend in self._pending.items():
+            for e in pend:
+                if not self._urgent_eligible(e, now):
+                    continue
+                if tighter_than is not None and e.deadline >= tighter_than:
+                    continue
+                if best is None or e.deadline < best[0]:
+                    best = (e.deadline, bucket_len)
+        if best is None:
+            return None
+        _, bucket_len = best
+        # batch fill excludes expired deadlines too: a dead request
+        # must resolve "shed" via the worker's sweep, never ride a
+        # preemption batch to an after-deadline "ok" (deadline-free
+        # fill entries are fine — they just serve sooner)
+        pend = [e for e in self._pending[bucket_len]
+                if e.group is None and e.not_before <= now
+                and not (e.deadline is not None and e.deadline <= now)]
+        take = sorted(pend, key=lambda e: (e.deadline is None,
+                                           e.deadline or 0.0,
+                                           -e.request.priority,
+                                           e.enqueued_at))
+        take = take[:self.config.max_batch_size]
+        taken = {id(e) for e in take}
+        self._pending[bucket_len] = [e for e in self._pending[bucket_len]
+                                     if id(e) not in taken]
+        self._resolve_removed(take)
+        return bucket_len, take
 
     # -- resilience: worker side -----------------------------------------
 
@@ -1648,6 +2238,11 @@ class Scheduler:
         except Exception:
             return               # a failed rebuild keeps the old one —
         #                          better a suspect executor than none
+        # a swapped-in executor may not speak step mode (custom
+        # executor_factory): recompute so the recycle loop degrades to
+        # the opaque path instead of AttributeError-ing mid-batch
+        self._step_capable = hasattr(self.executor, "run_init") \
+            and hasattr(self.executor, "run_step")
         self._n_rebuilds += 1
         self._c_rebuilds.inc()
 

@@ -1,0 +1,679 @@
+"""Recycle-aware iteration-level scheduling tests (ISSUE 9): step-loop
+vs `lax.scan` exact numerics, the executor's init/step ExecKey
+variants, scheduler early-exit/repack/streaming, preemption ordering,
+the recycle_policy=None scrubbed-stats identity guard, the
+converge-tol cache-key split, cache-aware parked admission, the
+recycle-carry HBM pricing, MeshPolicy.parse, the ProcFleet mesh-policy
+config plumbing, and the front door's progressive long-poll."""
+
+import functools
+import json
+import threading
+import time
+from types import SimpleNamespace
+from urllib import request as urlrequest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu import Alphafold2
+from alphafold2_tpu.cache import FoldCache
+from alphafold2_tpu.data.synthetic import synthetic_requests
+from alphafold2_tpu.obs.registry import MetricsRegistry
+from alphafold2_tpu.predict import fold, fold_init, fold_step
+from alphafold2_tpu.serve import (BucketPolicy, FoldExecutor,
+                                  FoldMemoryModel, FoldRequest,
+                                  MeshPolicy, QueueFullError,
+                                  RecyclePolicy, Scheduler,
+                                  SchedulerConfig, ServeMetrics)
+from alphafold2_tpu.serve.recycle import element_deltas
+
+MSA_DEPTH = 3
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = Alphafold2(dim=32, depth=1, heads=2, dim_head=16,
+                       predict_coords=True, structure_module_depth=1)
+    n = 16
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, n), jnp.int32),
+        msa=jnp.zeros((1, MSA_DEPTH, n), jnp.int32),
+        mask=jnp.ones((1, n), bool),
+        msa_mask=jnp.ones((1, MSA_DEPTH, n), bool))
+    return model, params
+
+
+def _inputs(n=16, b=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return (jnp.asarray(rng.integers(0, 20, (b, n)), jnp.int32),
+            jnp.asarray(rng.integers(0, 20, (b, MSA_DEPTH, n)),
+                        jnp.int32),
+            jnp.ones((b, n), bool),
+            jnp.ones((b, MSA_DEPTH, n), bool))
+
+
+def requests_of(lengths, key=1, **kwargs):
+    reqs = synthetic_requests(jax.random.PRNGKey(key), num=len(lengths),
+                              lengths=lengths, msa_depth=MSA_DEPTH)
+    for r in reqs:
+        for k, v in kwargs.items():
+            setattr(r, k, v)
+    return reqs
+
+
+def _scheduler(model_and_params, recycle_policy=None, num_recycles=2,
+               buckets=(16,), **kw):
+    kw.setdefault("metrics", ServeMetrics(registry=MetricsRegistry()))
+    kw.setdefault("registry", MetricsRegistry())
+    ex = FoldExecutor(*model_and_params, max_entries=8)
+    return Scheduler(
+        ex, BucketPolicy(buckets),
+        SchedulerConfig(max_batch_size=2, max_wait_ms=10.0,
+                        num_recycles=num_recycles, msa_depth=MSA_DEPTH),
+        recycle_policy=recycle_policy, **kw)
+
+
+class TestStepNumerics:
+    def test_step_loop_matches_scan_exact(self, model_and_params):
+        """The ISSUE 9 exactness contract, recycles 0-3: init + R
+        manual steps produce coords/confidence/distogram numerically
+        IDENTICAL to fold()'s compile-once lax.scan — the step body is
+        the scan body, so who owns the loop cannot change what it
+        computes."""
+        model, params = model_and_params
+        seq, msa, mask, msa_mask = _inputs()
+        init_fn = jax.jit(lambda p, s, m, k, mm: fold_init(
+            model, p, s, msa=m, mask=k, msa_mask=mm))
+        step_fn = jax.jit(lambda p, s, rec, m, k, mm: fold_step(
+            model, p, s, rec, msa=m, mask=k, msa_mask=mm))
+        for num_recycles in range(4):
+            ref = jax.jit(functools.partial(
+                fold, model, num_recycles=num_recycles))(
+                params, seq, msa=msa, mask=mask, msa_mask=msa_mask)
+            state = init_fn(params, seq, msa, mask, msa_mask)
+            for _ in range(num_recycles):
+                state = step_fn(params, seq, state.recyclables, msa,
+                                mask, msa_mask)
+            np.testing.assert_array_equal(np.asarray(ref.coords),
+                                          np.asarray(state.coords))
+            np.testing.assert_array_equal(np.asarray(ref.confidence),
+                                          np.asarray(state.confidence))
+            np.testing.assert_array_equal(np.asarray(ref.distogram),
+                                          np.asarray(state.distogram))
+
+    def test_executor_step_variants(self, model_and_params):
+        """init/step are distinct ExecKey variants; step keys pin the
+        recycles element to 0 so ONE step executable serves every
+        configured depth."""
+        ex = FoldExecutor(*model_and_params, max_entries=8)
+        policy = BucketPolicy((16,))
+        batch, _ = policy.assemble(requests_of((8, 12)), 16, 2)
+        state = ex.run_init(batch)
+        ex.run_step(batch, state, 1)
+        variants = {k[6] for k in ex.stats()["keys"]}
+        assert variants == {"init", "step"}
+        assert ex.key_for(batch, 5, variant="step")[3] == 0
+        assert ex.key_for(batch, 5, variant="step") == \
+            ex.key_for(batch, 2, variant="step")
+        # opaque fold keys keep their recycle element and stay distinct
+        assert ex.key_for(batch, 5)[3] == 5
+        assert ex.key_for(batch, 5)[6] == "fold"
+        # warm step reuse: a second init+step pair is all hits
+        before = ex.misses
+        st2 = ex.run_init(batch)
+        ex.run_step(batch, st2, 1)
+        assert ex.misses == before
+
+    def test_warmup_step_mode(self, model_and_params):
+        ex = FoldExecutor(*model_and_params, max_entries=8)
+        fresh = ex.warmup([(16, 2, MSA_DEPTH, 3)], step_mode=True)
+        assert fresh == 2                     # init + step pair
+        variants = {k[6] for k in ex.stats()["keys"]}
+        assert variants == {"init", "step"}
+
+    def test_element_deltas_masks_padding(self):
+        prev_c = np.zeros((2, 4, 3), np.float32)
+        cur_c = np.zeros((2, 4, 3), np.float32)
+        cur_c[0, 3] = 100.0                   # padding residue only
+        cur_c[1, 0] = 1.0                     # real residue moved
+        prev_f = np.zeros((2, 4), np.float32)
+        cur_f = np.zeros((2, 4), np.float32)
+        d = element_deltas(prev_c, prev_f, cur_c, cur_f, [3, 2])
+        assert d[0] == 0.0                    # pad movement ignored
+        assert d[1] > 0.0
+
+
+class TestSchedulerStepLoop:
+    def _run(self, model_and_params, recycle_policy, num_recycles=2,
+             lengths=(12, 12, 12, 12)):
+        sched = _scheduler(model_and_params, recycle_policy,
+                           num_recycles=num_recycles)
+        reqs = requests_of(lengths, key=3)
+        with sched:
+            tickets = [sched.submit(FoldRequest(seq=r.seq, msa=r.msa))
+                       for r in reqs]
+            out = [t.result(timeout=300) for t in tickets]
+        return sched, tickets, out
+
+    def test_tol0_byte_identical_to_opaque(self, model_and_params):
+        """converge_tol=0 runs every configured recycle through the
+        step loop and must serve EXACTLY the opaque lax.scan results
+        end to end (the whole-serving-path version of the exactness
+        test above)."""
+        _, _, base = self._run(model_and_params, None)
+        _, _, stepped = self._run(model_and_params,
+                                  RecyclePolicy(converge_tol=0.0))
+        for a, b in zip(base, stepped):
+            assert a.ok and b.ok, (a.status, b.status, b.error)
+            np.testing.assert_array_equal(a.coords, b.coords)
+            np.testing.assert_array_equal(a.confidence, b.confidence)
+            assert a.recycles is None
+            assert b.recycles == 2
+
+    def test_early_exit_skips_recycles(self, model_and_params):
+        sched, _, out = self._run(
+            model_and_params,
+            RecyclePolicy(converge_tol=1e9), num_recycles=3)
+        assert all(r.ok and r.recycles == 1 for r in out)
+        rec = sched.serve_stats()["recycle"]
+        assert rec["recycles_skipped"] > 0
+        assert rec["retired_early"] == len(out)
+        # batch-level steps executed < the opaque equivalent
+        assert rec["recycles_executed"] < \
+            sched.serve_stats()["batches"] * 3
+
+    def test_min_recycles_floor(self, model_and_params):
+        sched, _, out = self._run(
+            model_and_params,
+            RecyclePolicy(converge_tol=1e9, min_recycles=2),
+            num_recycles=3)
+        assert all(r.ok and r.recycles == 2 for r in out)
+
+    def test_repack_survivor_batch(self, model_and_params):
+        """A mixed batch where only some elements converge: survivors
+        are re-packed and still serve the same results the opaque path
+        produces for the full recycle count. Convergence is injected
+        per-element via a tol between the two elements' actual
+        deltas — measured first, so the test tracks the model instead
+        of hardcoding magic numbers."""
+        model, params = model_and_params
+        reqs = requests_of((12, 10), key=5)
+        # measure both elements' recycle-1 deltas at the SERVING shape
+        # (one bucket-16 batch-2 init+step pair — the same compiled
+        # programs every scheduler below uses) to pick a tol that
+        # retires exactly the smaller-delta element
+        ex = FoldExecutor(model, params, max_entries=8)
+        batch, _ = BucketPolicy((16,)).assemble(reqs, 16, 2)
+        st0 = ex.run_init(batch)
+        st1 = ex.run_step(batch, st0, 1)
+        deltas = element_deltas(
+            np.asarray(st0.coords), np.asarray(st0.confidence),
+            np.asarray(st1.coords), np.asarray(st1.confidence),
+            [r.length for r in reqs])
+        lo, hi = sorted(deltas)
+        if not lo < hi:
+            pytest.skip("degenerate model: equal per-element deltas")
+        tol = (lo + hi) / 2.0
+        sched = _scheduler(model_and_params,
+                           RecyclePolicy(converge_tol=tol),
+                           num_recycles=3)
+        with sched:
+            tickets = [sched.submit(FoldRequest(seq=r.seq, msa=r.msa))
+                       for r in reqs]
+            out = [t.result(timeout=300) for t in tickets]
+        by_delta = dict(zip(deltas, out))
+        assert by_delta[lo].recycles == 1          # retired first
+        # the survivor outlived recycle 1 (it may still converge at a
+        # later step — deltas shrink as recycling converges)
+        hi_recycles = by_delta[hi].recycles
+        assert hi_recycles is not None and hi_recycles > 1
+        assert sched.serve_stats()["recycle"]["retired_early"] >= 1
+        # the SURVIVOR was re-packed to row 0 and kept folding: its
+        # result must be exactly the full step loop's at the same
+        # recycle count (rows are independent through the model, so
+        # row position cannot change row-wise math)
+        base_sched = _scheduler(model_and_params,
+                                RecyclePolicy(converge_tol=0.0),
+                                num_recycles=hi_recycles)
+        with base_sched:
+            base = [base_sched.submit(
+                FoldRequest(seq=r.seq, msa=r.msa)).result(timeout=300)
+                for r in reqs]
+        np.testing.assert_array_equal(by_delta[hi].coords,
+                                      base[deltas.index(hi)].coords)
+
+    def test_progressive_stream(self, model_and_params):
+        sched = _scheduler(model_and_params,
+                           RecyclePolicy(converge_tol=0.0, stream=True),
+                           num_recycles=2)
+        req = requests_of((12,), key=7)[0]
+        seen = []
+        with sched:
+            ticket = sched.submit(FoldRequest(seq=req.seq, msa=req.msa))
+            ticket.add_progress_callback(lambda p: seen.append(p))
+            resp = ticket.result(timeout=300)
+        assert resp.ok
+        updates = ticket.progress()
+        assert [p.recycle for p in updates] == [0, 1, 2, 2]
+        assert updates[-1].converged
+        np.testing.assert_array_equal(updates[-1].coords, resp.coords)
+        np.testing.assert_array_equal(updates[-1].confidence,
+                                      resp.confidence)
+        assert len(seen) == len(updates)    # callback saw every update
+        for p in updates:
+            assert p.coords.shape == (req.length, 3)
+
+    def test_recycle_policy_none_stats_byte_identical(
+            self, model_and_params):
+        """The off switch: recycle_policy=None must leave scrubbed
+        serve_stats() byte-identical to a scheduler that has never
+        heard of recycle scheduling (same scrub discipline as the mesh
+        and transport equivalence tests)."""
+        def scrub(obj):
+            if isinstance(obj, dict):
+                return {k: scrub(v) for k, v in sorted(obj.items())
+                        if k != "traces" and not k.endswith("_s")}
+            if isinstance(obj, list):
+                return [scrub(v) for v in obj]
+            return obj
+
+        def run_one(**kw):
+            sched = _scheduler(model_and_params, num_recycles=1, **kw)
+            reqs = requests_of((12, 8), key=9)
+            with sched:
+                for r in reqs:
+                    assert sched.submit(
+                        FoldRequest(seq=r.seq, msa=r.msa)).result(
+                            timeout=300).ok
+            return scrub(sched.serve_stats())
+
+        explicit_off = run_one(recycle_policy=None)
+        never_heard = run_one()
+        assert json.dumps(explicit_off, sort_keys=True, default=str) \
+            == json.dumps(never_heard, sort_keys=True, default=str)
+        assert "recycle" not in never_heard
+
+
+class TestCacheKeySplit:
+    def test_converge_tol_splits_fold_key(self, model_and_params):
+        """ISSUE 9 satellite fix: an early-exited result must never be
+        served to a caller demanding fixed full recycles — a
+        result-affecting policy keys under its own extras; tol-0 and
+        policy-off keys stay shared (and offline-compatible)."""
+        req = FoldRequest(seq=np.arange(12) % 20,
+                          msa=(np.arange(36) % 20).reshape(3, 12))
+        off = _scheduler(model_and_params, None)
+        tol0 = _scheduler(model_and_params,
+                          RecyclePolicy(converge_tol=0.0))
+        tol = _scheduler(model_and_params,
+                         RecyclePolicy(converge_tol=0.5))
+        tol2 = _scheduler(model_and_params,
+                          RecyclePolicy(converge_tol=0.25))
+        assert off._cache_key_for(req) == tol0._cache_key_for(req)
+        assert off._cache_key_for(req) != tol._cache_key_for(req)
+        assert tol._cache_key_for(req) != tol2._cache_key_for(req)
+
+    def test_early_exit_result_not_served_to_full_recycle_caller(
+            self, model_and_params):
+        """End to end: a store populated by an early-exit scheduler
+        misses for a policy-off scheduler sharing the same cache."""
+        cache = FoldCache(registry=MetricsRegistry())
+        early = _scheduler(model_and_params,
+                           RecyclePolicy(converge_tol=1e9),
+                           num_recycles=2, cache=cache, model_tag="v1")
+        req = requests_of((12,), key=11)[0]
+        with early:
+            resp = early.submit(
+                FoldRequest(seq=req.seq, msa=req.msa)).result(timeout=300)
+        assert resp.ok and resp.recycles == 1
+        strict = _scheduler(model_and_params, None, num_recycles=2,
+                            cache=cache, model_tag="v1")
+        with strict:
+            again = strict.submit(
+                FoldRequest(seq=req.seq, msa=req.msa)).result(timeout=300)
+        assert again.ok
+        assert again.source == "fold"      # NOT a cache hit
+        assert again.recycles is None
+
+
+class TestParkedAdmission:
+    def _sched(self, model_and_params, budget):
+        ex = FoldExecutor(*model_and_params, max_entries=4)
+        # worker can't form a batch (huge max_wait + max_batch), so the
+        # leader parks in pending and holds queue depth at the limit
+        return Scheduler(
+            ex, BucketPolicy((16,)),
+            SchedulerConfig(max_batch_size=8, max_wait_ms=60_000.0,
+                            queue_limit=1, full_policy="reject",
+                            num_recycles=0, msa_depth=MSA_DEPTH,
+                            parked_bytes_budget=budget),
+            metrics=ServeMetrics(registry=MetricsRegistry()),
+            registry=MetricsRegistry(),
+            cache=FoldCache(registry=MetricsRegistry()),
+            model_tag="v1")
+
+    def test_duplicate_admitted_past_full_queue(self, model_and_params):
+        sched = self._sched(model_and_params, budget=1 << 20)
+        req = requests_of((8,), key=13)[0]
+        sched.start()
+        leader = sched.submit(FoldRequest(seq=req.seq, msa=req.msa))
+        # duplicate of the in-flight leader: admitted as follower even
+        # though the queue is at its limit
+        dup = sched.submit(FoldRequest(seq=req.seq.copy(),
+                                       msa=req.msa.copy()))
+        # novel content still honors the bound
+        novel = requests_of((10,), key=14)[0]
+        with pytest.raises(QueueFullError):
+            sched.submit(FoldRequest(seq=novel.seq, msa=novel.msa))
+        stats = sched.serve_stats()
+        assert stats["cache"]["parked_admits"] == 1
+        assert stats["cache"]["parked_admit_bytes"] > 0
+        sched.stop(drain=True)          # folds the leader, settles dup
+        assert leader.result(timeout=120).ok
+        dresp = dup.result(timeout=120)
+        assert dresp.ok and dresp.source == "coalesced"
+        # budget bytes released on settle
+        assert sched.serve_stats()["cache"]["parked_admit_bytes"] == 0
+
+    def test_budget_exhausted_rejects(self, model_and_params):
+        sched = self._sched(model_and_params, budget=4)   # < any seq
+        req = requests_of((8,), key=13)[0]
+        sched.start()
+        leader = sched.submit(FoldRequest(seq=req.seq, msa=req.msa))
+        with pytest.raises(QueueFullError):
+            sched.submit(FoldRequest(seq=req.seq.copy(),
+                                     msa=req.msa.copy()))
+        assert sched.serve_stats()["cache"]["parked_admits"] == 0
+        sched.stop(drain=True)
+        assert leader.result(timeout=120).ok
+
+    def test_off_by_default(self, model_and_params):
+        sched = self._sched(model_and_params, budget=0)
+        req = requests_of((8,), key=13)[0]
+        sched.start()
+        leader = sched.submit(FoldRequest(seq=req.seq, msa=req.msa))
+        with pytest.raises(QueueFullError):
+            sched.submit(FoldRequest(seq=req.seq.copy(),
+                                     msa=req.msa.copy()))
+        sched.stop(drain=True)
+        assert leader.result(timeout=120).ok
+
+
+class _StepStub:
+    """Step-capable executor stub with event choreography: the FIRST
+    run_init of the long bucket blocks until the test has submitted
+    the deadline request, so the preemption gap deterministically has
+    urgent pending work."""
+
+    def __init__(self, block_bucket_len):
+        self.block_bucket_len = block_bucket_len
+        self.started = threading.Event()
+        self.release = threading.Event()
+        self._blocked_once = False
+        self.calls = []
+        self._lock = threading.Lock()
+
+    def _state(self, batch):
+        b, n = batch["seq"].shape
+        return SimpleNamespace(
+            coords=np.zeros((b, n, 3), np.float32),
+            confidence=np.zeros((b, n), np.float32),
+            recyclables=None)
+
+    def run_init(self, batch, trace=None, devices=None,
+                 mesh_shape=None):
+        n = batch["seq"].shape[1]
+        with self._lock:
+            self.calls.append(("init", n))
+            first_block = (n == self.block_bucket_len
+                           and not self._blocked_once)
+            if first_block:
+                self._blocked_once = True
+        if first_block:
+            self.started.set()
+            assert self.release.wait(timeout=60)
+        return self._state(batch)
+
+    def run_step(self, batch, state, recycle_index, trace=None,
+                 devices=None, mesh_shape=None):
+        with self._lock:
+            self.calls.append(("step", batch["seq"].shape[1],
+                               recycle_index))
+        time.sleep(0.01)      # a visible per-recycle cost
+        return self._state(batch)
+
+    def run(self, batch, num_recycles, **kw):       # opaque fallback
+        st = self._state(batch)
+        return SimpleNamespace(coords=st.coords,
+                               confidence=st.confidence)
+
+    def stats(self):
+        return {"calls": len(self.calls)}
+
+
+class TestPreemption:
+    def test_deadline_fold_lands_between_recycles(self):
+        """ISSUE 9 preemption ordering: a tight-deadline short fold
+        submitted while a long batch is mid-loop executes BETWEEN the
+        long batch's recycles and resolves first."""
+        stub = _StepStub(block_bucket_len=64)
+        sched = Scheduler(
+            stub, BucketPolicy((32, 64)),
+            SchedulerConfig(max_batch_size=2, max_wait_ms=5.0,
+                            num_recycles=2, msa_depth=0),
+            metrics=ServeMetrics(registry=MetricsRegistry()),
+            registry=MetricsRegistry(),
+            recycle_policy=RecyclePolicy(converge_tol=0.0,
+                                         preempt=True))
+        done_order = []
+        rng = np.random.default_rng(0)
+        long_req = FoldRequest(seq=rng.integers(0, 20, 40))
+        short_req = FoldRequest(seq=rng.integers(0, 20, 12),
+                                deadline_s=30.0)
+        sched.start()
+        try:
+            t_long = sched.submit(long_req)
+            t_long.add_done_callback(
+                lambda r: done_order.append("long"))
+            assert stub.started.wait(timeout=60)
+            # the long batch is inside its first pass; the deadline
+            # fold arrives NOW and must not wait out recycles 1-2
+            t_short = sched.submit(short_req)
+            t_short.add_done_callback(
+                lambda r: done_order.append("short"))
+            stub.release.set()
+            r_short = t_short.result(timeout=60)
+            r_long = t_long.result(timeout=60)
+        finally:
+            sched.stop(drain=True)
+        assert r_short.ok and r_long.ok
+        assert done_order == ["short", "long"]
+        assert sched.serve_stats()["recycle"]["preemptions"] >= 1
+        # the short batch's init ran between the long batch's steps
+        long_steps = [i for i, c in enumerate(stub.calls)
+                      if c[0] == "step" and c[1] == 64]
+        short_init = [i for i, c in enumerate(stub.calls)
+                      if c[0] == "init" and c[1] == 32]
+        assert short_init and long_steps
+        assert short_init[0] < long_steps[-1]
+
+    def test_no_preempt_flag_respected(self):
+        stub = _StepStub(block_bucket_len=64)
+        sched = Scheduler(
+            stub, BucketPolicy((32, 64)),
+            SchedulerConfig(max_batch_size=2, max_wait_ms=5.0,
+                            num_recycles=2, msa_depth=0),
+            metrics=ServeMetrics(registry=MetricsRegistry()),
+            registry=MetricsRegistry(),
+            recycle_policy=RecyclePolicy(converge_tol=0.0,
+                                         preempt=False))
+        rng = np.random.default_rng(0)
+        sched.start()
+        try:
+            t_long = sched.submit(FoldRequest(seq=rng.integers(0, 20, 40)))
+            assert stub.started.wait(timeout=60)
+            t_short = sched.submit(FoldRequest(seq=rng.integers(0, 20, 12),
+                                               deadline_s=30.0))
+            stub.release.set()
+            assert t_long.result(timeout=60).ok
+            assert t_short.result(timeout=60).ok
+        finally:
+            sched.stop(drain=True)
+        assert sched.serve_stats()["recycle"]["preemptions"] == 0
+
+
+class TestCarryPricing:
+    def test_carry_adds_bytes_and_shards_like_pair(self):
+        mem = FoldMemoryModel(param_bytes=0, dim=64, heads=4)
+        plain = mem.fold_bytes(256, 2, 3)
+        carry = mem.fold_bytes(256, 2, 3, carry_recyclables=True)
+        assert carry > plain
+        # the carried pairwise term shards over the slice
+        carry4 = mem.fold_bytes(256, 2, 3, chips=4,
+                                carry_recyclables=True)
+        plain4 = mem.fold_bytes(256, 2, 3, chips=4)
+        assert carry4 - plain4 < carry - plain
+
+    def test_admits_flips_under_carry(self):
+        mem = FoldMemoryModel(param_bytes=0, dim=64, heads=4)
+        L, B, M = 256, 2, 3
+        base = mem.fold_bytes(L, B, M)
+        with_carry = mem.fold_bytes(L, B, M, carry_recyclables=True)
+        mem.hbm_bytes_per_device = (base + with_carry) // 2
+        pol = MeshPolicy({L: 1}, devices=[0], memory=mem)
+        assert pol.admits(L, B, M)
+        assert not pol.admits(L, B, M, carry_recyclables=True)
+
+    def test_from_model_sizes_slices_for_carry(self, model_and_params):
+        """`--mesh-policy auto` + step mode must SIZE for the carry it
+        will later price at admission: a bucket whose opaque fold just
+        fits n chips gets the bigger slice instead of being auto-sized
+        into a guaranteed "too_large"."""
+        model, params = model_and_params
+        from alphafold2_tpu.serve.meshpolicy import FoldMemoryModel \
+            as FMM
+        mem = FMM.from_model(model, params)
+        L, B = 512, 2
+        plain = mem.fold_bytes(L, B, MSA_DEPTH, chips=1)
+        carry = mem.fold_bytes(L, B, MSA_DEPTH, chips=1,
+                               carry_recyclables=True)
+        hbm_gb = ((plain + carry) / 2) / (1 << 30)
+        kw = dict(max_batch=B, msa_depth=MSA_DEPTH, hbm_gb=hbm_gb,
+                  devices=list(range(8)))
+        base_pol = MeshPolicy.from_model(
+            model, params, BucketPolicy((L,)), **kw)
+        carry_pol = MeshPolicy.from_model(
+            model, params, BucketPolicy((L,)),
+            carry_recyclables=True, **kw)
+        assert base_pol.chips_for(L) == 1       # opaque fold fits solo
+        assert carry_pol.chips_for(L) > 1       # carry needs the shard
+        # and what it sized, it admits
+        assert carry_pol.admits(L, B, MSA_DEPTH,
+                                carry_recyclables=True)
+
+
+class TestMeshPolicyParse:
+    def test_parse_forms(self):
+        assert MeshPolicy.parse("") is None
+        pol = MeshPolicy.parse("32=1,64=4", devices=list(range(8)))
+        assert pol.shape_for(32) == (1, 1)
+        assert pol.shape_for(64) == (2, 2)
+        with pytest.raises(ValueError, match="bad --mesh-policy"):
+            MeshPolicy.parse("32:1", devices=[0])
+        with pytest.raises(ValueError, match="auto needs"):
+            MeshPolicy.parse("auto")
+
+    def test_procfleet_config_carries_mesh_policy(self, tmp_path):
+        """ISSUE 9 satellite (PR-7 ROADMAP item): ProcFleet threads the
+        per-replica mesh policy spec into every replica config, which
+        replica_main parses at boot. Config-level test — no process
+        spawn."""
+        from alphafold2_tpu.fleet.procfleet import ProcFleet
+
+        fleet = ProcFleet(2, str(tmp_path), mesh_policy="32=1,64=4",
+                          mesh_hbm_gb=8.0)
+        for h in fleet.replicas:
+            cfg = json.load(open(h.config_path))
+            assert cfg["mesh_policy"] == "32=1,64=4"
+            assert cfg["mesh_hbm_gb"] == 8.0
+
+
+class TestFrontDoorProgress:
+    def test_progress_long_poll(self):
+        """The existing long-poll exposes progressive results: before
+        terminal, `?progress=1` returns 206 + the latest per-recycle
+        coords with X-Recycle; the terminal 200 still follows."""
+        from alphafold2_tpu.fleet.frontdoor import FrontDoorServer
+        from alphafold2_tpu.fleet.rpc import encode_request
+        from alphafold2_tpu.serve.request import (FoldProgress,
+                                                  FoldResponse,
+                                                  FoldTicket)
+
+        tickets = {}
+
+        class FakeScheduler:
+            def submit(self, request):
+                t = FoldTicket(request.request_id)
+                tickets[request.request_id] = t
+                return t
+
+        fd = FrontDoorServer(FakeScheduler(), replica_id="t")
+        with fd:
+            req = FoldRequest(seq=np.arange(8) % 20)
+            body = encode_request(req)
+            post = urlrequest.Request(
+                fd.url + "/v1/submit", data=body,
+                headers={"X-Request-Id": req.request_id,
+                         "Content-Type": "application/octet-stream"},
+                method="POST")
+            with urlrequest.urlopen(post, timeout=10) as resp:
+                ticket_id = json.loads(resp.read())["ticket"]
+            ticket = tickets[req.request_id]
+            url = (f"{fd.url}/v1/result/{ticket_id}"
+                   f"?wait_s=0&progress=1")
+            # no progress yet: plain 204
+            with urlrequest.urlopen(url, timeout=10) as resp:
+                assert resp.status == 204
+            coords = np.arange(24, dtype=np.float32).reshape(8, 3)
+            conf = np.linspace(0, 1, 8).astype(np.float32)
+            ticket._publish_progress(FoldProgress(
+                req.request_id, recycle=1, coords=coords,
+                confidence=conf))
+            with urlrequest.urlopen(url, timeout=10) as resp:
+                assert resp.status == 206
+                assert resp.headers["X-Recycle"] == "1"
+                assert resp.headers["X-Status"] == "running"
+                import io
+                with np.load(io.BytesIO(resp.read())) as z:
+                    np.testing.assert_array_equal(z["coords"], coords)
+            # terminal pickup unchanged, with recycles on the wire
+            ticket._resolve(FoldResponse(
+                request_id=req.request_id, status="ok", coords=coords,
+                confidence=conf, bucket_len=8, recycles=1))
+            with urlrequest.urlopen(
+                    fd.url + f"/v1/result/{ticket_id}?wait_s=5",
+                    timeout=10) as resp:
+                assert resp.status == 200
+                assert resp.headers["X-Recycles"] == "1"
+
+    def test_rpc_roundtrip_recycles(self):
+        from alphafold2_tpu.fleet.rpc import (decode_response,
+                                              encode_response)
+        from alphafold2_tpu.serve.request import FoldResponse
+
+        resp = FoldResponse(
+            request_id="r", status="ok",
+            coords=np.zeros((4, 3), np.float32),
+            confidence=np.ones(4, np.float32), bucket_len=8,
+            recycles=2)
+        body, headers = encode_response(resp)
+        back = decode_response(body, headers)
+        assert back.recycles == 2
+        # a response without the field decodes to None (pre-ISSUE-9
+        # peers)
+        resp2 = FoldResponse(request_id="r", status="shed")
+        body2, headers2 = encode_response(resp2)
+        assert decode_response(body2, headers2).recycles is None

@@ -49,13 +49,17 @@ from alphafold2_tpu.utils.profiling import percentile  # noqa: E402
 # drain, from drain start to its terminal state) with ISSUE 6;
 # shard (mesh serving: params/input placement onto the batch's device
 # slice) with ISSUE 7 — fold spans additionally carry a `mesh` attr
-# ("1x1", "2x4") the per-mesh latency section below groups by.
+# ("1x1", "2x4") the per-mesh latency section below groups by;
+# recycle (one single-recycle step execution of the scheduler-owned
+# recycle loop, tagged with its iteration index) with ISSUE 9 — the
+# init pass stays a `fold` span so the accelerator-time rule below
+# holds unchanged for step-scheduled requests.
 # --check's orphan-span rules apply to all of them unchanged, which is
 # how the chaos smokes prove recovery cost is fully accounted.
 STAGE_ORDER = ("submit", "forward", "rpc", "queue", "parked", "retry",
                "drain", "batch_form", "shard", "compile", "fold",
-               "watchdog", "writeback", "peer_fetch", "cache_lookup",
-               "write")
+               "recycle", "watchdog", "writeback", "peer_fetch",
+               "cache_lookup", "write")
 
 # span/trace boundary slack: start_s, dur_s, and duration_s are each
 # INDEPENDENTLY rounded to 1e-6 when emitted, so a span auto-closed at

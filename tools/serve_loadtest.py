@@ -153,6 +153,29 @@ def parse_args(argv=None):
                     help="per-device HBM budget the 'auto' mesh policy "
                          "and the too-large admission guard price "
                          "against")
+    ap.add_argument("--recycle-sched", action="store_true",
+                    help="iteration-level scheduling "
+                         "(serve.RecyclePolicy): the scheduler owns "
+                         "the recycle loop — early-exit converged "
+                         "folds, preempt between recycles for "
+                         "deadline traffic. With --deadline-s, only "
+                         "the SHORTEST request length carries the "
+                         "deadline (the tight traffic class); the "
+                         "report then splits p50/p99 by class and "
+                         "counts recycles saved")
+    ap.add_argument("--converge-tol", type=float, default=0.0,
+                    help="per-element convergence threshold for "
+                         "early exit (0 = off: full recycles, "
+                         "numerics identical to the opaque fold)")
+    ap.add_argument("--min-recycles", type=int, default=0,
+                    help="recycles every element must run before "
+                         "early exit may fire")
+    ap.add_argument("--stream", action="store_true",
+                    help="publish per-recycle progressive results to "
+                         "each ticket; the report counts updates")
+    ap.add_argument("--no-preempt", action="store_true",
+                    help="disable between-recycle preemption "
+                         "(isolates the early-exit effect)")
     ap.add_argument("--dim", type=int, default=32)
     ap.add_argument("--depth", type=int, default=1)
     ap.add_argument("--metrics-path", default="/tmp/serve_loadtest.jsonl")
@@ -224,24 +247,36 @@ def _build_resilience(args):
     return plan, retry
 
 
-def _build_mesh_policy(args, model, params, policy, jax):
-    """serve.MeshPolicy (or None) from --mesh-policy. 'auto' derives
-    per-bucket slices analytically; 'BUCKET=CHIPS,...' pins them.
-    Shapes wider than the device pool clamp cleanly (MeshPolicy does),
-    so the same invocation works on 1-device and 8-device hosts."""
-    if not args.mesh_policy:
-        return None
+def _build_mesh_policy(args, model, params, policy, jax,
+                       devices=None):
+    """serve.MeshPolicy (or None) from --mesh-policy, via the shared
+    `MeshPolicy.parse` every --mesh-policy surface uses (this CLI,
+    ProcFleet configs, replica_main). 'auto' derives per-bucket slices
+    analytically; 'BUCKET=CHIPS,...' pins them. Shapes wider than the
+    device pool clamp cleanly, so the same invocation works on
+    1-device and 8-device hosts. `devices` restricts the policy to a
+    subset pool (per-replica pinning in fleet mode)."""
     from alphafold2_tpu.serve import MeshPolicy
 
-    if args.mesh_policy == "auto":
-        return MeshPolicy.from_model(
-            model, params, policy, max_batch=args.max_batch,
-            msa_depth=args.msa_depth, hbm_gb=args.mesh_hbm_gb)
-    shapes = {}
-    for kv in args.mesh_policy.split(","):
-        bucket, chips = kv.split("=")
-        shapes[int(bucket)] = int(chips)
-    return MeshPolicy(shapes)
+    return MeshPolicy.parse(
+        args.mesh_policy, model=model, params=params, buckets=policy,
+        max_batch=args.max_batch, msa_depth=args.msa_depth,
+        hbm_gb=args.mesh_hbm_gb, devices=devices,
+        # auto-sized slices must price what will actually run: the
+        # step loop's carried Recyclables under --recycle-sched
+        carry_recyclables=bool(getattr(args, "recycle_sched", False)))
+
+
+def _build_recycle_policy(args):
+    """serve.RecyclePolicy (or None) from --recycle-sched."""
+    if not args.recycle_sched:
+        return None
+    from alphafold2_tpu.serve import RecyclePolicy
+
+    return RecyclePolicy(converge_tol=args.converge_tol,
+                         min_recycles=args.min_recycles,
+                         preempt=not args.no_preempt,
+                         stream=args.stream)
 
 
 def _poison_pool(args, jax):
@@ -367,8 +402,10 @@ def main(argv=None) -> int:
 
     plan, retry = _build_resilience(args)
     mesh_policy = _build_mesh_policy(args, model, params, policy, jax)
+    recycle_policy = _build_recycle_policy(args)
     # mesh serving mints one executable per (bucket, slice identity):
     # size the LRU so concurrent slices don't thrash each other out
+    # (the scheduler doubles it for the step-mode init+step pair)
     max_entries = policy.num_buckets * (
         len(jax.devices()) if mesh_policy is not None else 1)
     executor = serve.FoldExecutor(model, params,
@@ -393,7 +430,8 @@ def main(argv=None) -> int:
     scheduler = serve.Scheduler(executor, policy, config, metrics,
                                 cache=cache, model_tag="serve_loadtest",
                                 tracer=tracer, retry=retry,
-                                mesh_policy=mesh_policy)
+                                mesh_policy=mesh_policy,
+                                recycle_policy=recycle_policy)
 
     warmup_timer = StepTimer()
     with warmup_timer.measure():
@@ -429,6 +467,13 @@ def main(argv=None) -> int:
     poison_results = []
     lock = threading.Lock()
     counter = [0]
+    # --recycle-sched traffic classes: the shortest length is the
+    # TIGHT class (it alone carries --deadline-s and exercises
+    # preemption), everything else is bulk; per-class client-side
+    # latencies feed the report's p50/p99 split
+    short_len = min(lengths)
+    class_latencies = {"tight": [], "bulk": []}
+    progress_updates = [0]
 
     def run_submitter(stop_at, budget):
         while True:
@@ -441,19 +486,35 @@ def main(argv=None) -> int:
             idx = schedule[i % len(schedule)]
             is_poison = idx < 0
             req_proto = poisons[-idx - 1] if is_poison else pool[idx]
+            req_len = int(req_proto.seq.shape[0])
+            req_deadline = deadline_s
+            klass = "bulk"
+            if args.recycle_sched and deadline_s:
+                klass = "tight" if req_len <= short_len else "bulk"
+                req_deadline = deadline_s if klass == "tight" else None
             req = serve.FoldRequest(seq=req_proto.seq, msa=req_proto.msa,
-                                    deadline_s=deadline_s)
+                                    deadline_s=req_deadline)
+            t_submit = time.monotonic()
             try:
                 # FoldTicket.result(timeout=) is the caller-side hang
                 # fence: a wedged ticket fails THIS run loudly instead
                 # of blocking the harness forever
-                resp = scheduler.submit(req).result(timeout=600)
+                ticket = scheduler.submit(req)
+                if args.stream:
+                    def _on_progress(_p):
+                        with lock:
+                            progress_updates[0] += 1
+                    ticket.add_progress_callback(_on_progress)
+                resp = ticket.result(timeout=600)
             except Exception as exc:
                 with lock:
                     failures.append(repr(exc))
                 return  # a broken loop would spin; one strike ends it
             with lock:
                 statuses[resp.status] = statuses.get(resp.status, 0) + 1
+                if not is_poison and resp.ok:
+                    class_latencies[klass].append(
+                        time.monotonic() - t_submit)
             if is_poison:
                 # a poison request is EXPECTED to terminate "poisoned";
                 # the chaos smoke judges these separately
@@ -534,6 +595,27 @@ def main(argv=None) -> int:
         report["devices"] = len(jax.devices())
         report["mesh"] = snap.get("mesh")
         report["too_large"] = snap.get("too_large", 0)
+    # executor step-executions: the apples-to-apples cost unit across
+    # the opaque and step-scheduled paths (an opaque fold IS
+    # 1 + num_recycles fused steps) — serve_smoke.sh phase 8 compares
+    # this between a baseline and a --recycle-sched run
+    if recycle_policy is not None:
+        rec = snap["recycle"]
+        report["executor_steps"] = snap["batches"] \
+            + rec["recycles_executed"]
+        report["recycle"] = rec
+        report["recycles_saved"] = rec["recycles_skipped"]
+        from alphafold2_tpu.utils.profiling import percentile
+        report["latency_by_class"] = {
+            k: {"count": len(v),
+                "p50_s": round(percentile(v, 50), 4),
+                "p99_s": round(percentile(v, 99), 4)}
+            for k, v in class_latencies.items() if v}
+        if args.stream:
+            report["progress_updates"] = progress_updates[0]
+    else:
+        report["executor_steps"] = snap["batches"] \
+            * (1 + args.num_recycles)
     if args.prom_path:
         from alphafold2_tpu import obs
         obs.write_prometheus(args.prom_path)
@@ -592,11 +674,25 @@ def main(argv=None) -> int:
                       f"clamped to the {n_dev}-device pool; "
                       "sharded-execution assertions skipped",
                       file=sys.stderr)
+        if recycle_policy is not None and args.converge_tol > 0:
+            rec = snap["recycle"]
+            if rec["recycles_skipped"] == 0 and rec["retired_early"] == 0:
+                # a convergence-injected workload that never early-exits
+                # means the step scheduler is dead weight — fail loudly
+                print(f"SMOKE FAIL: --recycle-sched with converge-tol "
+                      f"{args.converge_tol} never early-exited "
+                      f"(recycle stats {rec})", file=sys.stderr)
+                return 1
         extra = (f", {cache_snap['hits']} cache hits, "
                  f"{cache_snap['coalesced']} coalesced"
                  if cache_on else "")
         if mesh_policy is not None:
             extra += f", mesh folds {(snap.get('mesh') or {}).get('folds')}"
+        if recycle_policy is not None:
+            extra += (f", {report['executor_steps']} executor steps "
+                      f"({snap['recycle']['recycles_skipped']} recycles "
+                      f"skipped, {snap['recycle']['preemptions']} "
+                      f"preemptions)")
         print(f"SMOKE OK: {snap['served']} folds, 0 shed/errors{extra}",
               file=sys.stderr)
     return 0
@@ -703,6 +799,20 @@ def _run_fleet(args) -> int:
     cache_kwargs = {}
     if args.cache_dir:
         cache_kwargs["disk_dir"] = args.cache_dir
+    # --mesh-policy in fleet mode: each in-process replica pins its own
+    # contiguous chunk of the shared device pool (separate hosts own
+    # their chips outright in production), so concurrent replicas never
+    # fight over a chip
+    mesh_policy_factory = None
+    if args.mesh_policy:
+        devices = jax.devices()
+        chunk = max(1, len(devices) // args.replicas)
+
+        def mesh_policy_factory(i):
+            sub = devices[i * chunk:(i + 1) * chunk] or devices[-chunk:]
+            return _build_mesh_policy(args, model, params, policy, jax,
+                                      devices=sub)
+
     fl = fleet.InProcessFleet(
         lambda: serve.FoldExecutor(model, params,
                                    max_entries=policy.num_buckets,
@@ -711,7 +821,9 @@ def _run_fleet(args) -> int:
         cache_kwargs=cache_kwargs, fleet=fleet_on, tracer=tracer,
         metrics_factory=lambda i: serve.ServeMetrics(
             f"{args.metrics_path}.r{i}"),
-        retry=retry, faults=plan)
+        retry=retry, faults=plan,
+        mesh_policy_factory=mesh_policy_factory,
+        recycle_policy=_build_recycle_policy(args))
 
     warmup_timer = StepTimer()
     with warmup_timer.measure():
@@ -969,7 +1081,14 @@ def _run_procs(args) -> int:
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         num_recycles=args.num_recycles,
         model={"dim": args.dim, "depth": args.depth,
-               "msa_depth": args.msa_depth})
+               "msa_depth": args.msa_depth},
+        mesh_policy=args.mesh_policy,
+        mesh_hbm_gb=args.mesh_hbm_gb,
+        recycle=(None if not args.recycle_sched else dict(
+            converge_tol=args.converge_tol,
+            min_recycles=args.min_recycles,
+            preempt=not args.no_preempt,
+            stream=args.stream)))
     print(f"procfleet: starting {n} replica processes under {run_dir}",
           file=sys.stderr)
     try:

@@ -48,6 +48,20 @@
 #      serves, and obs_report --check is clean over the merged
 #      driver + replica traces with rpc/drain spans present in the
 #      waterfall. The deployment-seam tripwire.
+#   8. iteration-level recycle scheduling (--recycle-sched,
+#      serve.RecyclePolicy): a skewed 3:1 short+long workload at
+#      num-recycles 2 run TWICE — the opaque-fold baseline, then the
+#      step-scheduled run with convergence injected (--converge-tol
+#      1e9: every element retires after recycle 1 — the max-win bound
+#      that exercises the full early-exit path honestly) + streaming +
+#      tight deadlines on the short class. FAILS unless the
+#      step-scheduled run's total executor step-executions are BELOW
+#      the baseline's on the identical schedule, recycles were
+#      actually skipped, every request still resolves ok with correct
+#      shapes (zero wrong-result serves — early-exit results key under
+#      their own cache extras, so nothing can cross-serve), and
+#      obs_report --check finds no orphan recycle spans. The
+#      iteration-level-scheduling tripwire.
 #   7. multi-chip mesh serving (--mesh-policy, serve.MeshPolicy) under
 #      XLA_FLAGS=--xla_force_host_platform_device_count=8: a mixed
 #      short+long workload where the long bucket is pinned to a 4-chip
@@ -80,7 +94,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 DURATION="${SMOKE_DURATION_S:-30}"
-PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7}"
+PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8}"
 
 phase_on() {
     case ",${PHASES}," in
@@ -314,4 +328,73 @@ timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
 timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
     python tools/obs_report.py /tmp/serve_smoke_mesh_traces.jsonl \
     --check --prom /tmp/serve_smoke_mesh.prom
+fi
+
+# phase 8: iteration-level recycle scheduling — the identical skewed
+# short+long workload at num-recycles 2, opaque baseline vs
+# step-scheduled with convergence injected; early exit must reduce
+# executor step-executions with zero wrong-result serves, and the new
+# recycle spans must be orphan-free in the waterfall
+if phase_on 8; then
+rm -f /tmp/serve_smoke_recycle_traces.jsonl
+
+recycle_phase() {  # $1 = report path, extra args follow
+    local out="$1"; shift
+    timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+        python tools/serve_loadtest.py \
+        --smoke \
+        --requests 48 \
+        --lengths 24,24,24,48 \
+        --buckets 32,64 \
+        --msa-depth 3 \
+        --max-batch 2 \
+        --concurrency 2 \
+        --deadline-s 120 \
+        --num-recycles 2 \
+        "$@" > "$out"
+    cat "$out"
+}
+
+recycle_phase /tmp/serve_smoke_recycle_base.json \
+    --metrics-path /tmp/serve_smoke_recycle_base.jsonl
+recycle_phase /tmp/serve_smoke_recycle.json \
+    --recycle-sched --converge-tol 1e9 --stream \
+    --metrics-path /tmp/serve_smoke_recycle.jsonl \
+    --trace-path /tmp/serve_smoke_recycle_traces.jsonl \
+    --prom-path /tmp/serve_smoke_recycle.prom
+
+timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/obs_report.py /tmp/serve_smoke_recycle_traces.jsonl \
+    --check --prom /tmp/serve_smoke_recycle.prom
+
+env -u PYTHONPATH python - <<'EOF'
+import json, sys
+base = json.load(open("/tmp/serve_smoke_recycle_base.json"))
+sched = json.load(open("/tmp/serve_smoke_recycle.json"))
+problems = []
+if sched["executor_steps"] >= base["executor_steps"]:
+    problems.append(f"step-scheduled executor steps "
+                    f"{sched['executor_steps']} >= opaque baseline "
+                    f"{base['executor_steps']}")
+if sched.get("recycles_saved", 0) <= 0:
+    problems.append("no recycles were skipped despite injected "
+                    "convergence")
+for rep in (base, sched):
+    bad = rep["shed"] + rep["errors"] + rep["rejected"] + \
+        len(rep["failures"])
+    if bad or rep["served"] == 0:
+        problems.append(f"{bad} bad outcomes / {rep['served']} served "
+                        f"in {'sched' if rep is sched else 'base'} run")
+if not sched.get("progress_updates", 0):
+    problems.append("--stream published no progressive updates")
+if problems:
+    print("RECYCLE SMOKE FAIL: " + "; ".join(problems), file=sys.stderr)
+    sys.exit(1)
+print(f"RECYCLE SMOKE OK: executor steps {sched['executor_steps']} < "
+      f"{base['executor_steps']} on the identical workload, "
+      f"{sched['recycles_saved']} recycles skipped, "
+      f"{sched['recycle']['preemptions']} preemptions, "
+      f"{sched.get('progress_updates', 0)} progressive updates, "
+      f"p99 by class {sched.get('latency_by_class')}", file=sys.stderr)
+EOF
 fi
