@@ -5,9 +5,14 @@ workload analysis), so the cheapest fold is the one never run. Three
 pieces, each usable alone:
 
 - keys:     fold_key — canonical digest of (seq, effective MSA, fold
-            config, model tag) via utils.hashing.stable_digest
+            config, model tag) via utils.hashing.stable_digest;
+            feature_key — the UPSTREAM digest of one raw input's
+            featurize work (no fold config: feature traffic dedups
+            independently of fold traffic)
 - store:    FoldCache — byte-budgeted memory LRU over an optional
             atomic-write on-disk .npz tier; corruption == miss
+- features: FeatureCache — the same architecture one stage upstream,
+            holding featurized inputs (serve.features.FeaturePool)
 - coalesce: InflightRegistry — duplicate submissions attach to the
             in-flight leader instead of folding twice
 
@@ -21,7 +26,12 @@ deduplication").
 """
 
 from alphafold2_tpu.cache.coalesce import InflightRegistry  # noqa: F401
-from alphafold2_tpu.cache.keys import KEY_SCHEMA, fold_key  # noqa: F401
+from alphafold2_tpu.cache.features import (FeatureCache,  # noqa: F401
+                                           FeaturizedInput,
+                                           decode_features,
+                                           encode_features)
+from alphafold2_tpu.cache.keys import (FEATURE_KEY_SCHEMA,  # noqa: F401
+                                       KEY_SCHEMA, feature_key, fold_key)
 from alphafold2_tpu.cache.store import (CachedFold, CacheStats,  # noqa: F401
                                         FoldCache, decode_fold,
                                         encode_fold)

@@ -1,0 +1,305 @@
+"""Feature store: the cache tier UPSTREAM of the fold cache.
+
+At serving scale the CPU-side feature work (tokenize, MSA prep,
+feature construction — in a real deployment, the MSA search itself) is
+the dominant cost (ParaFold), and it is pure in the raw input: the same
+sequence + raw MSA featurizes to the same arrays no matter which fold
+config, model tag, or recycle count consumes them. So features get
+their own content-addressed tier keyed by `cache.keys.feature_key` —
+one entry serves every downstream fold variant, and feature traffic
+dedups independently of fold traffic.
+
+Same architecture and trust model as the fold-result store
+(`cache/store.py`): byte-budgeted memory LRU over an optional
+atomic-write on-disk `.npz` tier; anything wrong with a disk entry is a
+MISS and the file is quarantined (`*.quarantined`), never raised into
+the serving path. No peer tier — features are cheap to recompute
+relative to a network hop for token arrays (revisit when real MSA
+search lands; the seam is `FeatureCache.get/put`, same as FoldCache's).
+
+`serve.features.FeaturePool` wires this into the serving path; it is
+equally usable standalone for offline featurize memoization.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+from alphafold2_tpu.obs.registry import MetricsRegistry, get_registry
+from alphafold2_tpu.obs.trace import NULL_TRACE
+
+_QUARANTINE_SUFFIX = ".quarantined"
+
+
+@dataclass
+class FeaturizedInput:
+    """One featurized raw job: the arrays `serve.FoldRequest` consumes.
+    Always exact-length (unpadded) copies — padding/bucketing stays the
+    fold scheduler's job."""
+
+    seq: np.ndarray                       # (n,) int32 tokens
+    msa: Optional[np.ndarray] = None      # (m, n) int32 tokens
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.seq.nbytes
+                   + (0 if self.msa is None else self.msa.nbytes))
+
+
+def encode_features(key: str, value: FeaturizedInput) -> bytes:
+    """One featurized input as self-identifying npz bytes — the disk
+    format, validated on read with the same `decode_features` every
+    tier shares (mirrors cache.store.encode_fold)."""
+    buf = io.BytesIO()
+    arrays = {"seq": np.asarray(value.seq, np.int32),
+              "key": np.frombuffer(key.encode("utf-8"), np.uint8)}
+    if value.msa is not None:
+        arrays["msa"] = np.asarray(value.msa, np.int32)
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def decode_features(key: str, data: bytes) -> FeaturizedInput:
+    """Parse + validate `encode_features` bytes. Raises on anything
+    wrong (unreadable, key mismatch, shape nonsense); callers translate
+    that into miss/quarantine semantics."""
+    with np.load(io.BytesIO(data)) as z:
+        stored_key = bytes(z["key"]).decode("utf-8")
+        value = FeaturizedInput(
+            seq=np.asarray(z["seq"], np.int32),
+            msa=(np.asarray(z["msa"], np.int32)
+                 if "msa" in z.files else None))
+    if (stored_key != key or value.seq.ndim != 1
+            or value.seq.shape[0] == 0
+            or (value.msa is not None
+                and (value.msa.ndim != 2
+                     or value.msa.shape[1] != value.seq.shape[0]))):
+        raise ValueError(f"feature entry {key} fails validation")
+    return value
+
+
+class _Entry:
+    __slots__ = ("value", "expires_at")
+
+    def __init__(self, value: FeaturizedInput,
+                 expires_at: Optional[float]):
+        self.value = value
+        self.expires_at = expires_at
+
+
+class FeatureCache:
+    """Content-addressed featurized-input cache (memory LRU + disk).
+
+    max_bytes / max_entries bound the memory tier; the disk tier is
+    bounded by TTL (and the directory's owner). ttl_s=None disables
+    expiry. `clock` is injectable for tests. Outcome counters mirror
+    into the process registry as `feature_cache_events_total{event=}` —
+    a distinct series from the fold store's `fold_cache_events_total`,
+    because the two tiers' hit ratios answer different capacity
+    questions (feature-pool sizing vs accelerator sizing).
+    """
+
+    def __init__(self, max_bytes: int = 64 << 20, max_entries: int = 8192,
+                 ttl_s: Optional[float] = None,
+                 disk_dir: Optional[str] = None,
+                 clock: Callable[[], float] = time.time,
+                 registry: Optional[MetricsRegistry] = None):
+        if max_bytes < 0 or max_entries < 0:
+            raise ValueError("max_bytes and max_entries must be >= 0")
+        self.max_bytes = int(max_bytes)
+        self.max_entries = int(max_entries)
+        self.ttl_s = ttl_s
+        self.disk_dir = disk_dir
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._mem: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.puts = 0
+        self.evictions = 0
+        self.expirations = 0
+        self.disk_hits = 0
+        self.disk_errors = 0
+        self._m_events = (registry or get_registry()).counter(
+            "feature_cache_events_total",
+            "feature-store outcomes across all FeatureCache instances",
+            ("event",))
+        if disk_dir:
+            os.makedirs(disk_dir, exist_ok=True)
+
+    def _bump(self, field: str, n: int = 1):
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+        self._m_events.inc(n, event=field)
+
+    # -- memory tier -----------------------------------------------------
+
+    def _mem_get(self, key: str) -> Optional[FeaturizedInput]:
+        now = self._clock()
+        with self._lock:
+            entry = self._mem.get(key)
+            if entry is None:
+                return None
+            if entry.expires_at is not None and now >= entry.expires_at:
+                del self._mem[key]
+                self._bytes -= entry.value.nbytes
+                self.expirations += 1
+                return None
+            self._mem.move_to_end(key)
+            return entry.value
+
+    def _mem_put(self, key: str, value: FeaturizedInput,
+                 expires_at: Optional[float] = None):
+        """expires_at overrides the fresh-write TTL — disk promotions
+        pass the ORIGINAL write time's expiry (same tier-bounce rule as
+        FoldCache._mem_put)."""
+        if self.max_entries == 0 or self.max_bytes == 0:
+            return
+        if expires_at is None:
+            expires_at = (None if self.ttl_s is None
+                          else self._clock() + self.ttl_s)
+        with self._lock:
+            old = self._mem.pop(key, None)
+            if old is not None:
+                self._bytes -= old.value.nbytes
+            self._mem[key] = _Entry(value, expires_at)
+            self._bytes += value.nbytes
+            while self._mem and (len(self._mem) > self.max_entries
+                                 or self._bytes > self.max_bytes):
+                _, evicted = self._mem.popitem(last=False)
+                self._bytes -= evicted.value.nbytes
+                self.evictions += 1
+
+    # -- disk tier -------------------------------------------------------
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.disk_dir, key[:2], f"{key}.npz")
+
+    def _quarantine(self, path: str, key: str, trace=NULL_TRACE):
+        self._bump("disk_errors")
+        trace.event("feature_quarantine")
+        with self._lock:
+            entry = self._mem.pop(key, None)
+            if entry is not None:
+                self._bytes -= entry.value.nbytes
+        try:
+            os.replace(path, path + _QUARANTINE_SUFFIX)
+        except OSError:
+            pass                       # racing quarantiners: either wins
+
+    def _disk_get(self, key: str, trace=NULL_TRACE):
+        """Returns (value, expires_at) or None."""
+        path = self._path(key)
+        try:
+            if not os.path.exists(path):
+                return None
+            expires_at = None
+            if self.ttl_s is not None:
+                expires_at = os.path.getmtime(path) + self.ttl_s
+                if self._clock() >= expires_at:
+                    self._bump("expirations")
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+                    return None
+        except OSError:
+            return None
+        try:
+            with open(path, "rb") as fh:
+                data = fh.read()
+            value = decode_features(key, data)
+        except Exception:              # unreadable/garbage/wrong entry
+            self._quarantine(path, key, trace)
+            return None
+        return value, expires_at
+
+    def _disk_put(self, key: str, value: FeaturizedInput):
+        path = self._path(key)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(tmp, "wb") as fh:
+                fh.write(encode_features(key, value))
+            os.replace(tmp, path)      # atomic: readers see old or new
+        except Exception:
+            self._bump("disk_errors")
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    # -- public API ------------------------------------------------------
+
+    def get(self, key: str, trace=NULL_TRACE) -> Optional[FeaturizedInput]:
+        """Lookup; never raises. memory -> disk, disk hits promoted."""
+        value = self._mem_get(key)
+        tier = "memory"
+        if value is None and self.disk_dir:
+            hit = self._disk_get(key, trace)
+            if hit is not None:
+                value, expires_at = hit
+                tier = "disk"
+                self._bump("disk_hits")
+                self._mem_put(key, value, expires_at=expires_at)
+        if value is None:
+            self._bump("misses")
+            trace.event("feature_miss")
+            return None
+        self._bump("hits")
+        trace.event("feature_hit", tier=tier)
+        return value
+
+    def put(self, key: str, seq, msa=None) -> FeaturizedInput:
+        """Store one featurized input (copies taken; never raises past
+        the disk-error counter)."""
+        value = FeaturizedInput(
+            seq=np.array(seq, np.int32, copy=True),
+            msa=None if msa is None else np.array(msa, np.int32,
+                                                  copy=True))
+        self._bump("puts")
+        self._mem_put(key, value)
+        if self.disk_dir:
+            self._disk_put(key, value)
+        return value
+
+    # -- views -----------------------------------------------------------
+
+    @property
+    def bytes_resident(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._mem)
+
+    @property
+    def hit_ratio(self) -> float:
+        with self._lock:
+            total = self.hits + self.misses
+            return self.hits / total if total else 0.0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {f: getattr(self, f)
+                   for f in ("hits", "misses", "puts", "evictions",
+                             "expirations", "disk_hits", "disk_errors")}
+            out["entries_resident"] = len(self._mem)
+            out["bytes_resident"] = self._bytes
+        total = out["hits"] + out["misses"]
+        out["hit_ratio"] = out["hits"] / total if total else 0.0
+        out["max_bytes"] = self.max_bytes
+        out["max_entries"] = self.max_entries
+        out["ttl_s"] = self.ttl_s
+        out["disk_dir"] = self.disk_dir
+        return out

@@ -34,6 +34,65 @@ from alphafold2_tpu.utils.hashing import stable_digest
 # instead of deserializing into the wrong meaning
 KEY_SCHEMA = "fold-v1"
 
+# feature-tier analog (cache/features.py): bump when the featurized
+# representation changes shape/meaning so stale entries miss cleanly
+FEATURE_KEY_SCHEMA = "feat-v1"
+
+
+def feature_key(seq, msa=None, *, config_digest: str = "") -> str:
+    """Digest identifying one RAW input's featurized form — keyed
+    UPSTREAM of `fold_key`: two raw submissions with the same sequence
+    and raw MSA are the same featurize work regardless of fold config
+    (num_recycles, model_tag, msa_depth all live downstream in
+    `fold_key`), so feature traffic dedups independently of fold
+    traffic.
+
+    seq: an AA string (canonicalized to upper case — the tokenizer
+    upcases, so "mkv" and "MKV" are the same work) or an already-
+    tokenized 1-D int array. msa: None, a sequence of aligned AA
+    strings, or a 2-D int token array. String and token forms key
+    DIFFERENTLY on purpose: the digest covers the raw content the
+    featurizer will read, and pre-tokenized input skips the tokenize
+    step (the downstream fold_key over the resulting tokens still
+    unifies them for fold-level dedup).
+
+    config_digest: the featurizer configuration's own digest
+    (serve.features.featurizer_config_digest) — a changed tokenizer
+    alphabet or featurize version must MISS cleanly, never serve a
+    stale representation. Raises TypeError on un-hashable content;
+    callers then skip caching.
+    """
+    if isinstance(seq, str):
+        seq_part = seq.strip().upper()
+        if not seq_part:
+            raise ValueError("feature_key seq string is empty")
+    else:
+        seq_part = np.asarray(seq, dtype=np.int32)
+        if seq_part.ndim != 1:
+            raise ValueError(
+                f"feature_key seq must be 1-D, got {seq_part.shape}")
+    msa_part = None
+    if msa is not None:
+        if isinstance(msa, np.ndarray) or (
+                hasattr(msa, "ndim") and not isinstance(msa, (list, tuple))):
+            msa_part = np.asarray(msa, dtype=np.int32)
+            if msa_part.ndim != 2:
+                raise ValueError(
+                    f"feature_key msa array must be 2-D, got "
+                    f"{msa_part.shape}")
+        else:
+            rows = list(msa)
+            if rows and all(isinstance(r, str) for r in rows):
+                msa_part = tuple(r.strip().upper() for r in rows)
+            else:
+                msa_part = np.asarray(msa, dtype=np.int32)
+                if msa_part.ndim != 2:
+                    raise ValueError(
+                        f"feature_key msa must be 2-D tokens or aligned "
+                        f"strings, got shape {msa_part.shape}")
+    return stable_digest(FEATURE_KEY_SCHEMA, config_digest, seq_part,
+                         msa_part)
+
 
 def fold_key(
     seq,

@@ -68,8 +68,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 from urllib import parse as urlparse
 
-from alphafold2_tpu.fleet.rpc import (decode_request, encode_response,
-                                      _HDR_TAG)
+from alphafold2_tpu.fleet.rpc import (decode_raw_request, decode_request,
+                                      encode_response, _HDR_TAG)
 from alphafold2_tpu.obs.registry import MetricsRegistry, get_registry
 
 
@@ -282,13 +282,29 @@ class FrontDoorServer:
             self._m_rpc.inc(route="submit", outcome="stale_tag")
             return h._json(409, {"error": "model tag mismatch",
                                  "tag": self.rollout.tag})
+        # two body formats, told apart by Content-Type: npz = tokenized
+        # FoldRequest (the classic path, and what forwarded hops carry),
+        # application/json = a RAW job (ISSUE 10) — sequence string (or
+        # token list) + raw MSA, featurized REPLICA-SIDE through
+        # scheduler.submit_raw (the feature pool when attached, inline
+        # otherwise), so web clients never need a tokenizer
+        ctype = (h.headers.get("Content-Type") or "").split(";")[0]
+        raw_body = ctype.strip().lower() == "application/json"
+        if raw_body and not callable(getattr(self.scheduler,
+                                             "submit_raw", None)):
+            self._m_rpc.inc(route="submit", outcome="bad_request")
+            return h._json(400, {"error": "raw submissions unsupported "
+                                          "by this replica"})
         try:
-            request = decode_request(h._body(), h.headers)
+            request = (decode_raw_request(h._body(), h.headers)
+                       if raw_body
+                       else decode_request(h._body(), h.headers))
         except ValueError as exc:
             self._m_rpc.inc(route="submit", outcome="bad_request")
             return h._json(400, {"error": str(exc)})
         try:
-            ticket = self.scheduler.submit(request)
+            ticket = (self.scheduler.submit_raw(request) if raw_body
+                      else self.scheduler.submit(request))
         except DrainingError:
             self._m_rpc.inc(route="submit", outcome="draining")
             return h._json(503, {"error": "draining"})

@@ -76,6 +76,12 @@ class InProcessFleet:
     recycle_policy: optional serve.recycle.RecyclePolicy applied to
         EVERY replica's scheduler (step-mode recycle scheduling:
         early-exit, preemption, progressive results; off when None).
+    feature_pool_factory: optional per-replica serve.FeaturePool
+        factory (index -> FeaturePool or None) enabling the two-stage
+        feature pipeline (ISSUE 10): raw jobs submitted via
+        `submit_raw` route by FEATURE key to their ring owner, which
+        featurizes replica-side (each replica owns its pool + feature
+        cache, as separate processes would). Off when None.
     mesh_policy_factory: optional per-replica serve.MeshPolicy factory
         (index -> MeshPolicy or None) for mesh-aware replicas. A
         FACTORY, not a shared policy: in-process replicas share one
@@ -101,7 +107,9 @@ class InProcessFleet:
                  faults=None,
                  mesh_policy_factory: Optional[
                      Callable[[int], object]] = None,
-                 recycle_policy=None):
+                 recycle_policy=None,
+                 feature_pool_factory: Optional[
+                     Callable[[int], object]] = None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self.fleet_enabled = bool(fleet)
@@ -151,13 +159,18 @@ class InProcessFleet:
                 registry=registry, router=router, retry=rep_retry,
                 mesh_policy=(mesh_policy_factory(i)
                              if mesh_policy_factory else None),
-                recycle_policy=recycle_policy)
+                recycle_policy=recycle_policy,
+                feature_pool=(feature_pool_factory(i)
+                              if feature_pool_factory else None))
             # the forwarding transport wraps the peer scheduler's
             # submit (LocalTransport — in-process, zero-copy); set
             # after construction so the registry row is complete
-            # before any router can pick this owner
+            # before any router can pick this owner. submit_raw rides
+            # the same seam so feature-key routing can hand RAW jobs
+            # to their owner for replica-side featurization
             info = self.registry.get(rid)
-            info.transport = LocalTransport(scheduler.submit)
+            info.transport = LocalTransport(scheduler.submit,
+                                            scheduler.submit_raw)
             if peer_server is not None:
                 # unified health: the peer probe payload carries the
                 # same breaker/queue/drain truth the front door serves
@@ -188,6 +201,13 @@ class InProcessFleet:
 
     def stop(self, drain: bool = True):
         for r in self.replicas:
+            # feature pools first: their workers submit into the
+            # schedulers, and a drained pool cannot race a stopping
+            # queue
+            pool = getattr(r.scheduler, "feature_pool", None)
+            if pool is not None:
+                pool.stop()
+        for r in self.replicas:
             r.scheduler.stop(drain=drain)
         for r in self.replicas:
             if r.peer_server is not None:
@@ -214,6 +234,17 @@ class InProcessFleet:
                 replica = self._rr
                 self._rr = (self._rr + 1) % len(self.replicas)
         return self.replicas[replica].scheduler.submit(request)
+
+    def submit_raw(self, raw, replica: Optional[int] = None):
+        """Submit one RAW job through one replica's front door (same
+        round-robin model as submit). The receiving replica featurizes
+        — or, with feature pools wired, routes the raw job by feature
+        key to its ring owner first (ISSUE 10)."""
+        if replica is None:
+            with self._lock:
+                replica = self._rr
+                self._rr = (self._rr + 1) % len(self.replicas)
+        return self.replicas[replica].scheduler.submit_raw(raw)
 
     # -- fleet ops -------------------------------------------------------
 

@@ -120,7 +120,8 @@ class ProcFleet:
                  host: str = "127.0.0.1",
                  mesh_policy: str = "",
                  mesh_hbm_gb: float = 16.0,
-                 recycle: Optional[dict] = None):
+                 recycle: Optional[dict] = None,
+                 feature_pool: Optional[dict] = None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self.run_dir = os.path.abspath(run_dir)
@@ -163,6 +164,13 @@ class ProcFleet:
                 # optional step-mode recycle scheduling knobs
                 # (serve.RecyclePolicy kwargs); None = opaque folds
                 recycle=(None if recycle is None else dict(recycle)),
+                # optional feature pipeline (ISSUE 10): e.g.
+                # {"workers": 2, "latency_ms": 0} builds a per-replica
+                # serve.FeaturePool + disk-tiered FeatureCache, so raw
+                # (JSON) front-door submissions featurize off the hot
+                # path; None = inline featurize (today's behavior)
+                feature_pool=(None if feature_pool is None
+                              else dict(feature_pool)),
                 retry=bool(retry),
                 peers=[p for p in peer_rows
                        if p["replica_id"] != row["replica_id"]])
@@ -509,6 +517,18 @@ def replica_main(config: dict) -> int:
     recycle_cfg = config.get("recycle")
     recycle_policy = (None if not recycle_cfg
                       else serve.RecyclePolicy(**recycle_cfg))
+    # optional feature pipeline from the fleet config: the pool's
+    # feature cache gets its own disk tier NEXT TO the fold cache (same
+    # crash-recovery story — a restarted replica re-reads its features)
+    feat_cfg = config.get("feature_pool")
+    feature_pool = None
+    if feat_cfg:
+        from alphafold2_tpu.cache import FeatureCache
+        feature_pool = serve.FeaturePool(
+            workers=int(feat_cfg.get("workers", 2)),
+            cache=FeatureCache(disk_dir=os.path.join(
+                config["cache_dir"], "features")),
+            latency_s=float(feat_cfg.get("latency_ms", 0.0)) / 1000.0)
     # per-replica mesh policy from the fleet config (PR-7 ROADMAP item:
     # each replica pins its own chip SUBSET): the config's
     # mesh_device_share = [i, n] hands this replica the i-th 1/n chunk
@@ -540,7 +560,8 @@ def replica_main(config: dict) -> int:
         cache=cache, model_tag=rollout.tag, tracer=tracer,
         router=router, retry=retry,
         quarantine_path=os.path.join(state_dir, "quarantine.jsonl"),
-        mesh_policy=mesh_policy, recycle_policy=recycle_policy)
+        mesh_policy=mesh_policy, recycle_policy=recycle_policy,
+        feature_pool=feature_pool)
     rollout.subscribe(
         lambda tag, epoch: setattr(scheduler, "model_tag", tag))
 
@@ -579,6 +600,10 @@ def replica_main(config: dict) -> int:
     # graceful drain: refuse new work, finish what we owe, let parked
     # results be picked up, then exit 0 — the SIGTERM contract a
     # rolling restart relies on
+    if feature_pool is not None:
+        # featurize workers submit into the scheduler: drain them
+        # first so the scheduler's drain sees every owed fold
+        feature_pool.stop()
     complete = scheduler.drain()
     grace_deadline = time.monotonic() + 10.0
     while (frontdoor.snapshot()["parked_tickets"] > 0

@@ -167,6 +167,21 @@ class ConsistentHashRouter:
         self._m_forwards.inc(peer=owner_id)
         return ticket
 
+    def forward_raw(self, owner_id: str, raw, trace=NULL_TRACE):
+        """Hand a RAW job (serve.features.RawFoldRequest) to its
+        FEATURE-key owner, which featurizes replica-side and folds
+        (ISSUE 10). Raises when the owner vanished, has no transport,
+        the transport has no raw path (legacy wiring), or submit is
+        refused — the caller (serve.features.FeaturePool) then
+        featurizes locally. The ring is key-agnostic, so the same hash
+        walk that places fold keys places feature keys."""
+        transport = transport_of(self.registry.get(owner_id))
+        if transport is None or not hasattr(transport, "submit_raw"):
+            raise RuntimeError(f"replica {owner_id!r} not raw-forwardable")
+        ticket = transport.submit_raw(raw, trace=trace)
+        self._m_forwards.inc(peer=owner_id)
+        return ticket
+
     def note_fallback(self, reason: str):
         """Record a routed-remote request that folded locally anyway
         (owner down mid-forward, transport error, remote backpressure)."""

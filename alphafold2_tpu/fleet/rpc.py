@@ -120,6 +120,79 @@ def decode_request(body: bytes, headers) -> FoldRequest:
         **kwargs)
 
 
+def encode_raw_request(raw) -> tuple:
+    """(body, headers) for one RAW submission (serve.features.
+    RawFoldRequest): a JSON body — raw sequences are strings, which is
+    exactly what JSON is for — with the same QoS headers as the token
+    path plus Content-Type application/json, which is how the front
+    door tells the two apart. Token-array inputs travel as int lists
+    (the body stays self-contained; featurization happens replica-side
+    either way)."""
+    seq = raw.seq
+    payload = {"seq": seq if isinstance(seq, str)
+               else np.asarray(seq, np.int32).tolist()}
+    if raw.msa is not None:
+        msa = raw.msa
+        if not isinstance(msa, np.ndarray) and len(msa) > 0 \
+                and all(isinstance(r, str) for r in msa):
+            payload["msa"] = list(msa)
+        else:
+            payload["msa"] = np.asarray(msa, np.int32).tolist()
+    body = json.dumps(payload).encode("utf-8")
+    headers = {_HDR_REQUEST_ID: raw.request_id,
+               _HDR_PRIORITY: str(int(raw.priority)),
+               _HDR_FORWARDED: "1" if raw.forwarded else "0",
+               "Content-Type": "application/json"}
+    if raw.deadline_s is not None:
+        headers[_HDR_DEADLINE] = repr(float(raw.deadline_s))
+    return body, headers
+
+
+def decode_raw_request(body: bytes, headers):
+    """Parse + validate a raw (JSON) submit body into a
+    serve.features.RawFoldRequest. Raises ValueError on anything wrong;
+    the server turns that into a 400, never a featurize of garbage."""
+    from alphafold2_tpu.serve.features import RawFoldRequest
+
+    try:
+        payload = json.loads(body.decode("utf-8"))
+        seq = payload["seq"]
+        # every malformed-content failure must surface as ValueError —
+        # np.asarray raises TypeError on null/dict payloads, and a
+        # TypeError escaping here turns a bad CLIENT payload into a
+        # 500 that failover layers would retry across the whole fleet
+        if not isinstance(seq, str):
+            seq = np.asarray(seq, np.int32)
+            if seq.ndim != 1 or seq.shape[0] == 0:
+                raise ValueError(
+                    f"raw seq must be a string or non-empty 1-D token "
+                    f"list, got shape {seq.shape}")
+        msa = payload.get("msa")
+        if msa is not None and not (
+                isinstance(msa, list) and msa
+                and all(isinstance(r, str) for r in msa)):
+            msa = np.asarray(msa, np.int32)
+            if msa.ndim != 2:
+                raise ValueError(
+                    f"raw msa must be aligned strings or a 2-D token "
+                    f"list, got shape {msa.shape}")
+    except ValueError:
+        raise
+    except Exception as exc:
+        raise ValueError(f"unreadable raw request body: {exc!r}")
+    deadline = headers.get(_HDR_DEADLINE)
+    kwargs = {}
+    rid = headers.get(_HDR_REQUEST_ID)
+    if rid:
+        kwargs["request_id"] = rid
+    return RawFoldRequest(
+        seq=seq, msa=msa,
+        priority=int(headers.get(_HDR_PRIORITY, "0") or 0),
+        deadline_s=None if deadline is None else float(deadline),
+        forwarded=headers.get(_HDR_FORWARDED, "0") == "1",
+        **kwargs)
+
+
 def encode_arrays(coords=None, confidence=None) -> bytes:
     """The ONE coords/confidence npz framing every result body uses —
     terminal responses here and the front door's progressive 206
@@ -201,11 +274,21 @@ class LocalTransport:
     what `ReplicaInfo.submit` gave the router before transports
     existed."""
 
-    def __init__(self, submit):
+    def __init__(self, submit, submit_raw=None):
         self._submit = submit
+        # optional raw-path seam (the peer Scheduler.submit_raw bound
+        # method): feature-key routing forwards RAW jobs through it so
+        # the OWNER featurizes. Absent on legacy wirings — the router's
+        # forward_raw then raises and the pool featurizes locally.
+        self._submit_raw = submit_raw
 
     def submit(self, request: FoldRequest, trace=NULL_TRACE) -> FoldTicket:
         return self._submit(request)
+
+    def submit_raw(self, raw, trace=NULL_TRACE) -> FoldTicket:
+        if self._submit_raw is None:
+            raise RuntimeError("transport has no raw submit path")
+        return self._submit_raw(raw)
 
     def healthz(self) -> Optional[dict]:
         return None              # in-process: the registry IS the truth
@@ -290,6 +373,36 @@ class HttpTransport:
             self._m_rpc.inc(route="submit", outcome="error")
             raise
         self._m_rpc.inc(route="submit", outcome="ok")
+        return self._polled_ticket(remote_ticket, request)
+
+    def submit_raw(self, raw, trace=NULL_TRACE) -> FoldTicket:
+        """One RAW forwarding hop (feature-key routing, ISSUE 10): the
+        owner featurizes replica-side and folds. Same failure contract
+        as submit() — submit-time trouble raises (caller featurizes
+        locally), post-submit trouble resolves with the transport
+        marker (the feature pool then fails over to local
+        featurization)."""
+        body, headers = encode_raw_request(raw)
+        tag = self._tag()
+        if tag:
+            headers[_HDR_TAG] = tag
+        try:
+            with trace.span("rpc", peer=self.base_url,
+                            route="submit_raw"):
+                with self._post("/v1/submit", body, headers) as resp:
+                    payload = json.loads(resp.read().decode("utf-8"))
+            remote_ticket = payload["ticket"]
+        except Exception:
+            self._m_rpc.inc(route="submit_raw", outcome="error")
+            raise
+        self._m_rpc.inc(route="submit_raw", outcome="ok")
+        return self._polled_ticket(remote_ticket, raw)
+
+    def _polled_ticket(self, remote_ticket: str, request) -> FoldTicket:
+        """Local ticket resolved by a daemon long-poll thread — the one
+        pickup path both the token and raw submit hops share. `request`
+        only needs a request_id (FoldRequest and RawFoldRequest both
+        qualify)."""
         ticket = FoldTicket(request.request_id)
         # result(timeout=) expiry on the caller's side sends the owner a
         # best-effort cancel so the parked result is dropped, not leaked

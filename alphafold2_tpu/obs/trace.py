@@ -173,9 +173,18 @@ class Trace:
 
     def _append_span(self, name, t0, t1, attrs):
         """Caller holds self._lock."""
+        dur = max(t1 - t0, 0.0)
+        # a REAL (positive) interval must never round to zero: spans
+        # are emitted at microsecond resolution, and a sub-microsecond
+        # fold (a stub executor, a trivially small batch) rounding to
+        # 0.0 trips obs_report --check's "accelerator-served request
+        # with no non-zero fold span" rule — the pre-existing
+        # zero-duration-span flake (ISSUE 10). Clamp to one emission
+        # quantum; a genuinely empty interval (t1 == t0) stays 0.0.
         span = {"name": name,
                 "start_s": round(t0 - self._t0, 6),
-                "dur_s": round(max(t1 - t0, 0.0), 6)}
+                "dur_s": round(dur, 6) if dur >= 5e-7
+                else (1e-6 if dur > 0.0 else 0.0)}
         if attrs:
             span["attrs"] = attrs
         self._spans.append(span)

@@ -3,6 +3,11 @@
 The serving stack, bottom-up:
 
 - request:   FoldRequest/FoldResponse/FoldTicket — ragged in, exact out
+- features:  FeaturePool/PipelineScheduler — the two-stage pipeline
+             front: RAW jobs (strings + raw MSA) featurize on a CPU
+             worker pool with their own cache tier (cache.FeatureCache,
+             feature_key upstream of fold_key) + in-flight coalescing,
+             then feed the fold queue (README "Feature pipeline")
 - bucketing: BucketPolicy — ragged lengths onto a closed shape set
 - executor:  FoldExecutor — LRU cache of compiled fold executables
 - scheduler: Scheduler — dynamic batching, deadlines, backpressure,
@@ -52,12 +57,17 @@ Minimal use (see README "Serving"):
         response = ticket.result(timeout=120)
 """
 
-from alphafold2_tpu.cache import FoldCache, fold_key  # noqa: F401
+from alphafold2_tpu.cache import (FeatureCache, FoldCache,  # noqa: F401
+                                  feature_key, fold_key)
 from alphafold2_tpu.obs import (MetricsRegistry, Tracer,  # noqa: F401
                                 get_registry, prometheus_text)
 from alphafold2_tpu.serve.bucketing import BucketPolicy, default_policy  # noqa: F401
 from alphafold2_tpu.serve.executor import FoldExecutor  # noqa: F401
 from alphafold2_tpu.serve.faults import FaultInjected, FaultPlan  # noqa: F401
+from alphafold2_tpu.serve.features import (FeaturePool,  # noqa: F401
+                                           PipelineScheduler,
+                                           RawFoldRequest, featurize_raw,
+                                           featurizer_config_digest)
 from alphafold2_tpu.serve.meshpolicy import (DeviceSliceAllocator,  # noqa: F401
                                              FoldMemoryModel, MeshPolicy,
                                              SliceLease)

@@ -164,10 +164,23 @@ class FoldMemoryModel:
         dist = B * L * L * self.distogram_buckets * 4
         total = self.param_bytes + dist + pair / chips + msa / i
         if carry_recyclables:
-            carry_pair = B * L * L * self.dim * self.dtype_bytes / chips
-            carry_rest = B * L * (self.dim + 3) * self.dtype_bytes
-            total += self.recycle_carry_live * (carry_pair + carry_rest)
+            total += self.carry_bytes(L, B, chips=chips)
         return int(total)
+
+    def carry_bytes(self, bucket_len: int, batch_size: int,
+                    chips: int = 1) -> int:
+        """Per-device bytes of the step loop's carried `Recyclables`
+        ALONE (pairwise repr sharded over the slice + unsharded single
+        row/coords, double-buffered per `recycle_carry_live`). This is
+        what a SUSPENDED step loop keeps HBM-resident across a
+        preemption yield — the concurrent-peak term the memory-aware
+        preemption admission prices on top of the urgent batch's
+        `fold_bytes` (ISSUE 10)."""
+        L, B = int(bucket_len), int(batch_size)
+        chips = max(int(chips), 1)
+        carry_pair = B * L * L * self.dim * self.dtype_bytes / chips
+        carry_rest = B * L * (self.dim + 3) * self.dtype_bytes
+        return int(self.recycle_carry_live * (carry_pair + carry_rest))
 
     def fits(self, bucket_len: int, batch_size: int, msa_depth: int,
              chips: int = 1,

@@ -53,6 +53,12 @@ class ServeMetrics:
         self.too_large = 0          # rejected: exceeds largest mesh slice
         self.batches = 0
         self.queue_depth = 0
+        # cumulative wall seconds the executor spent inside batch
+        # executions (sum of batch latencies). 1 - busy/wall is the
+        # executor idle fraction — the number the feature pipeline
+        # exists to drive down (ISSUE 10: the accelerator must never
+        # idle waiting on features); serve_loadtest reports it
+        self.exec_busy_s = 0.0
         # result-cache outcomes at submit (all zero when caching is off)
         self.cache_hits = 0         # served straight from the store
         self.cache_misses = 0       # key looked up, not found
@@ -185,6 +191,7 @@ class ServeMetrics:
         with self._lock:
             self.batches += 1
             self.queue_depth = queue_depth
+            self.exec_busy_s += float(batch_latency_s)
             self._real_tokens += real_tokens
             self._padded_tokens += batch_size * bucket_len
             lat = self._bucket_hist(bucket_len)
@@ -255,6 +262,7 @@ class ServeMetrics:
                 "too_large": self.too_large,
                 "batches": self.batches,
                 "queue_depth": self.queue_depth,
+                "exec_busy_s": self.exec_busy_s,
                 "padding_waste": waste,
                 "latency_by_bucket": per_bucket,
                 "cache": self._cache_view(),

@@ -310,8 +310,13 @@ class Scheduler:
                  executor_factory: Optional[Callable[[], object]] = None,
                  quarantine_path: Optional[str] = None,
                  mesh_policy: Optional[MeshPolicy] = None,
-                 recycle_policy: Optional[RecyclePolicy] = None):
+                 recycle_policy: Optional[RecyclePolicy] = None,
+                 feature_pool=None):
         self.executor = executor
+        # two-stage pipeline front (serve.features.FeaturePool — OFF
+        # when None, the default, which keeps submit_raw featurizing
+        # inline and serve_stats() byte-for-byte today's)
+        self.feature_pool = feature_pool
         self.buckets = buckets
         self.config = config or SchedulerConfig()
         self.metrics = metrics or ServeMetrics()
@@ -376,6 +381,9 @@ class Scheduler:
         self._n_recycles_exec = 0       # batch-level step executions
         self._n_recycles_skipped = 0    # batch-level steps early-exited
         self._n_preemptions = 0
+        self._n_preempt_hbm_refusals = 0   # leased yields refused: the
+        #   urgent batch + the suspended loop's resident carry would
+        #   exceed per-device HBM (memory-aware preemption admission)
         self._n_retired_early = 0       # elements resolved before the
         self._n_parked_admits = 0       # last configured recycle
         # "a preemptor never preempts": per-thread reentrancy guard for
@@ -393,6 +401,11 @@ class Scheduler:
                 "serve_preemptions_total",
                 "batches preempted between recycles by tighter-deadline "
                 "pending work")
+            self._c_preempt_hbm_refusals = reg.counter(
+                "serve_preempt_hbm_refusals_total",
+                "leased preemption yields refused because the urgent "
+                "batch plus the suspended loop's HBM-resident carry "
+                "would exceed the per-device budget")
             # step mode needs TWO executables per (bucket, slice) —
             # init + step; grow the LRU so warmup's pair is not
             # self-evicting (the mesh block below multiplies its own
@@ -411,6 +424,8 @@ class Scheduler:
         # each loop pass (pool threads read it under _cond)
         self._pending_tightest: Optional[float] = None
         self._pending_tightest_chips: Optional[int] = None
+        self._pending_tightest_bucket: Optional[int] = None
+        self._pending_tightest_msa: Optional[int] = None
         self.mesh_policy = mesh_policy
         self._allocator = None
         self._mesh_pool: Optional[ThreadPoolExecutor] = None
@@ -638,10 +653,17 @@ class Scheduler:
                 entry.trace.finish("error", error="submit before start")
                 raise RuntimeError("Scheduler.submit() before start()")
 
-    def submit(self, request: FoldRequest) -> FoldTicket:
+    def submit(self, request: FoldRequest,
+               trace=None) -> FoldTicket:
+        """trace: an already-started obs.Trace to continue instead of
+        minting a fresh one — the feature pool passes the raw job's
+        trace so its `featurize` span and the fold stages land in ONE
+        record. None (the default, every pre-pipeline caller) is
+        byte-for-byte the old behavior."""
         bucket_len = self.buckets.bucket_for(request.length)  # fail fast
         entry = _Entry(request, bucket_len)
-        entry.trace = self.tracer.start_trace(request.request_id)
+        entry.trace = (trace if trace is not None
+                       else self.tracer.start_trace(request.request_id))
         entry.trace.begin("submit")
         # draining beats everything, cache hits included: a replica
         # being rolled must shrink to empty, and its caller must take
@@ -748,6 +770,27 @@ class Scheduler:
             raise
         self.metrics.record_enqueued(depth)
         return entry.ticket
+
+    def submit_raw(self, raw) -> FoldTicket:
+        """Accept one RAW job (serve.features.RawFoldRequest: an AA
+        string or untokenized array plus raw MSA). With a
+        `feature_pool` attached, featurization runs off the hot path on
+        the pool's workers — feature cache, in-flight featurize
+        coalescing, feature-key routing and the `featurize` trace span
+        all apply (the two-stage pipeline, ISSUE 10). Without one
+        (the default), featurize runs inline right here and the result
+        goes through the ordinary submit() — exactly what callers
+        hand-rolled before this method existed, so the off switch is
+        byte-for-byte today's behavior. Returns the same FoldTicket
+        either way."""
+        from alphafold2_tpu.serve.features import featurize_raw
+        if self.feature_pool is not None:
+            return self.feature_pool.submit_raw(raw, self)
+        feats = featurize_raw(raw)
+        return self.submit(FoldRequest(
+            seq=feats.seq, msa=feats.msa, request_id=raw.request_id,
+            priority=raw.priority, deadline_s=raw.deadline_s,
+            forwarded=raw.forwarded))
 
     # -- cache / coalescing ----------------------------------------------
 
@@ -1277,7 +1320,10 @@ class Scheduler:
                 recycles_executed=self._n_recycles_exec,
                 recycles_skipped=self._n_recycles_skipped,
                 preemptions=self._n_preemptions,
+                preempt_hbm_refusals=self._n_preempt_hbm_refusals,
                 retired_early=self._n_retired_early)
+        if self.feature_pool is not None:
+            stats["featurize"] = self.feature_pool.snapshot()
         with self._cond:
             stats["running"] = self._running
             stats["draining"] = self._draining
@@ -1328,14 +1374,25 @@ class Scheduler:
                     # a leased loop can tell whether yielding even
                     # COULD place it.
                     now_p = time.monotonic()
-                    tightest, t_bucket = None, None
+                    tightest, t_bucket, t_entry = None, None, None
                     for b_len, pend in self._pending.items():
                         for e in pend:
                             if not self._urgent_eligible(e, now_p):
                                 continue
                             if tightest is None or e.deadline < tightest:
-                                tightest, t_bucket = e.deadline, b_len
+                                tightest, t_bucket, t_entry = \
+                                    e.deadline, b_len, e
                     self._pending_tightest = tightest
+                    self._pending_tightest_bucket = (
+                        None if tightest is None else t_bucket)
+                    # the entry's OWN MSA depth rides along: with an
+                    # unpinned config (msa_depth=None) the HBM pricing
+                    # of a preemption yield must cover what this batch
+                    # will actually carry, not a zero-depth lowball
+                    self._pending_tightest_msa = (
+                        None if t_entry is None
+                        or t_entry.request.msa is None
+                        else int(t_entry.request.msa.shape[0]))
                     self._pending_tightest_chips = (
                         None if tightest is None
                         or self.mesh_policy is None
@@ -1749,7 +1806,8 @@ class Scheduler:
                                       0)
             while active and r < num_recycles:
                 if policy.preempt:
-                    lease = self._maybe_preempt(active, lease, r)
+                    lease = self._maybe_preempt(active, lease, r,
+                                                bucket_len)
                 r += 1
                 prev_coords, prev_conf = coords_np, conf_np
                 step_trace = (MultiTrace([e.trace for e in active])
@@ -1928,7 +1986,8 @@ class Scheduler:
         return run_with_watchdog(call, watchdog_s)
 
     def _maybe_preempt(self, active: List[_Entry],
-                       lease: Optional[SliceLease], gap: int):
+                       lease: Optional[SliceLease], gap: int,
+                       bucket_len: Optional[int] = None):
         """Between-recycles preemption window. Inline (no lease): this
         IS the worker thread, so it forms and executes tighter-deadline
         pending batches directly — the deadline fold lands between the
@@ -1944,16 +2003,18 @@ class Scheduler:
         gap by gap instead of starving the running batch. Returns the
         (possibly re-acquired) lease.
 
-        Known limits (ROADMAP): the yield frees SCHEDULING capacity,
-        not device memory — the suspended loop's carried state stays
-        resident, so an urgent batch on the freed chips is a
-        concurrent HBM peak the admission guard does not price (size
-        headroom accordingly on real hardware until memory-aware
-        preemption admission lands); and a leased yield for an urgent
-        entry still inside its max_wait window can go unplaced for
-        that window (bounded by max_wait_ms — the worker's batch
-        formation does not jump the window the way the inline take
-        does)."""
+        The yield frees SCHEDULING capacity, not device memory — the
+        suspended loop's carried state stays HBM-resident, so an
+        urgent batch on the freed chips is a concurrent per-device
+        peak. `_preempt_hbm_admits` (memory-aware preemption
+        admission, ISSUE 10) prices urgent footprint + suspended carry
+        against the budget and REFUSES the yield when they cannot
+        co-reside (`serve_preempt_hbm_refusals_total`) — near-limit
+        flagship configs keep their headroom automatically. Known
+        limit: a leased yield for an urgent entry still inside its
+        max_wait window can go unplaced for that window (bounded by
+        max_wait_ms — the worker's batch formation does not jump the
+        window the way the inline take does)."""
         if getattr(self._preempting, "flag", False):
             return lease
         # an open circuit breaker pauses batch formation; a preemption
@@ -1990,6 +2051,8 @@ class Scheduler:
         with self._cond:
             urgent = self._pending_tightest
             needed = self._pending_tightest_chips
+            urgent_bucket = self._pending_tightest_bucket
+            urgent_msa = self._pending_tightest_msa
         if urgent is None or (tighter_than is not None
                               and urgent >= tighter_than):
             return lease
@@ -2004,6 +2067,21 @@ class Scheduler:
                 # don't pay the yield latency or count a preemption
                 # that admits nothing
                 return lease
+        if not self._preempt_hbm_admits(bucket_len, urgent_bucket,
+                                        urgent_msa):
+            # memory-aware preemption admission (ISSUE 10, closing the
+            # PR-9 known limit): the yield frees SCHEDULING capacity,
+            # not HBM — this loop's carried Recyclables stay resident
+            # on these exact devices while the urgent batch runs, so
+            # the pair is a concurrent per-device peak. When urgent
+            # footprint + suspended carry exceeds the budget, refuse
+            # the yield: the urgent batch waits out the remaining
+            # recycles instead of OOMing both workloads.
+            self._n_preempt_hbm_refusals += 1
+            self._c_preempt_hbm_refusals.inc()
+            for e in active:
+                e.trace.event("preempt_hbm_refused", gap=gap)
+            return lease
         self._n_preemptions += 1
         self._c_preemptions.inc()
         for e in active:
@@ -2014,6 +2092,42 @@ class Scheduler:
         lease = self._allocator.acquire_span(lease)
         self._set_busy_gauge()
         return lease
+
+    def _preempt_hbm_admits(self, running_bucket: Optional[int],
+                            urgent_bucket: Optional[int],
+                            urgent_msa: Optional[int] = None) -> bool:
+        """Memory-aware preemption admission: may the urgent bucket's
+        batch run on devices still holding this suspended loop's
+        carried state? Prices the urgent batch's full analytic
+        footprint (step-mode, since the preempting batch runs under the
+        same policy) PLUS the suspended carry's per-device bytes
+        (`FoldMemoryModel.carry_bytes`) against the per-device budget.
+        Conservative: assumes the urgent slice overlaps this lease's
+        devices (the freed chips are exactly where the worker will
+        place it under saturation — the only condition a yield fires
+        in). True when no memory model is configured (the guard is
+        opt-in, like the too_large guard it extends)."""
+        mp = self.mesh_policy
+        if mp is None or mp.memory is None or urgent_bucket is None \
+                or running_bucket is None:
+            return True
+        cfg = self.config
+        # MSA pricing mirrors the submit-time guard: a pinned
+        # config.msa_depth wins; unpinned (None) prices the urgent
+        # entry's OWN depth (advertised by the worker alongside its
+        # bucket) — pricing zero there would lowball deep-MSA traffic
+        # into exactly the concurrent-peak OOM this guard prevents
+        guard_msa = cfg.msa_depth
+        if guard_msa is None:
+            guard_msa = urgent_msa or 0
+        urgent_bytes = mp.memory.fold_bytes(
+            urgent_bucket, cfg.max_batch_size, guard_msa,
+            shape=mp.shape_for(urgent_bucket),
+            carry_recyclables=self._use_step_loop())
+        carry = mp.memory.carry_bytes(
+            running_bucket, cfg.max_batch_size,
+            chips=mp.chips_for(running_bucket))
+        return urgent_bytes + carry <= mp.memory.hbm_bytes_per_device
 
     @staticmethod
     def _urgent_eligible(e: _Entry, now: float) -> bool:

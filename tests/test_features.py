@@ -1,0 +1,650 @@
+"""Feature-pipeline disaggregation tests (ISSUE 10): the feature key
+(stability, config-digest misses), the FeatureCache tier (LRU, disk
+roundtrip, quarantine), the FeaturePool (dedup/coalescing fan-out,
+cache-hit-skips-featurize, deadline shed, error fan-out,
+raw-vs-pretokenized end-to-end equality), the off-by-default scrubbed
+serve_stats() identity, the raw front-door/fleet seams, and the
+memory-aware preemption admission satellite.
+
+Scheduler-level tests run against a stub executor (no model, no XLA),
+same pattern as tests/test_obs.py — featurization is pure host-side
+numpy, so nothing here needs the real fold.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from alphafold2_tpu import obs
+from alphafold2_tpu.cache import (FeatureCache, FeaturizedInput,
+                                  decode_features, encode_features,
+                                  feature_key)
+from alphafold2_tpu.data.featurize import detokenize, tokenize
+from alphafold2_tpu.obs.trace import NULL_TRACE
+from alphafold2_tpu.serve import (BucketPolicy, FeaturePool, FoldRequest,
+                                  PipelineScheduler, RawFoldRequest,
+                                  Scheduler, SchedulerConfig,
+                                  ServeMetrics, featurize_raw,
+                                  featurizer_config_digest)
+
+
+class _StubResult:
+    def __init__(self, coords, confidence):
+        self.coords = coords
+        self.confidence = confidence
+
+
+class _StubExecutor:
+    """Executor-shaped stand-in whose output is a pure function of the
+    batch content — so two serving paths fed identical tokens must
+    produce byte-identical responses."""
+
+    def __init__(self, delay_s=0.0):
+        self.delay_s = delay_s
+
+    def run(self, batch, num_recycles, trace=NULL_TRACE):
+        with trace.span("fold"):
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            seq = np.asarray(batch["seq"], np.float32)
+            coords = np.repeat(seq[..., None], 3, axis=-1)
+            confidence = (seq % 7 + 1.0) / 8.0
+            return _StubResult(coords, confidence)
+
+    def stats(self):
+        return {"hits": 0, "misses": 0, "evictions": 0, "resident": 0,
+                "max_entries": 1, "keys": []}
+
+
+def _scheduler(pool=None, tracer=None, registry=None, **cfg):
+    reg = registry or obs.MetricsRegistry()
+    config = SchedulerConfig(**{"max_batch_size": 2, "max_wait_ms": 10.0,
+                                "num_recycles": 0, **cfg})
+    return Scheduler(_StubExecutor(), BucketPolicy((16,)), config,
+                     ServeMetrics(registry=reg), registry=reg,
+                     tracer=tracer, feature_pool=pool)
+
+
+SEQ = "MKVLAARNDC"
+MSA = ["MKVLAARNDC", "MKVLA-RNDC", "MKVRAARND-"]
+
+
+@pytest.mark.quick
+class TestFeatureKey:
+    def test_stable_and_case_canonical(self):
+        k1 = feature_key(SEQ, MSA)
+        assert k1 == feature_key(SEQ, MSA)
+        assert k1 == feature_key(SEQ.lower(), MSA)
+        assert k1 == feature_key(f"  {SEQ} ", MSA)
+
+    def test_content_splits_key(self):
+        base = feature_key(SEQ, MSA)
+        assert feature_key(SEQ) != base
+        assert feature_key(SEQ[:-1], [r[:-1] for r in MSA]) != base
+        assert feature_key(SEQ, MSA[:2]) != base
+
+    def test_config_digest_misses_cleanly(self):
+        """A featurizer config change must split the key: a cache
+        written under the old digest can never serve the new mapping."""
+        k_now = feature_key(SEQ, MSA,
+                            config_digest=featurizer_config_digest())
+        k_other = feature_key(SEQ, MSA, config_digest="other-config")
+        assert k_now != k_other
+        assert feature_key(SEQ, MSA) != k_now   # "" default differs too
+
+    def test_token_and_string_forms_key_separately(self):
+        # the digest covers the raw content the featurizer reads; the
+        # downstream fold_key over the RESULTING tokens unifies them
+        assert feature_key(tokenize(SEQ)) != feature_key(SEQ)
+        t = feature_key(tokenize(SEQ))
+        assert t == feature_key(tokenize(SEQ))
+
+    def test_invalid_inputs_raise(self):
+        with pytest.raises(ValueError):
+            feature_key("")
+        with pytest.raises(ValueError):
+            feature_key(np.zeros((2, 3), np.int32))
+
+
+@pytest.mark.quick
+class TestFeaturizeRaw:
+    def test_string_and_tokens_agree(self):
+        a = featurize_raw(RawFoldRequest(SEQ, msa=MSA))
+        b = featurize_raw(RawFoldRequest(
+            tokenize(SEQ), msa=np.stack([tokenize(r) for r in MSA])))
+        np.testing.assert_array_equal(a.seq, b.seq)
+        np.testing.assert_array_equal(a.msa, b.msa)
+
+    def test_detokenize_roundtrip(self):
+        tokens = np.arange(21, dtype=np.int32)    # every token id
+        np.testing.assert_array_equal(
+            featurize_raw(RawFoldRequest(detokenize(tokens))).seq,
+            tokens)
+
+    def test_misaligned_msa_raises(self):
+        with pytest.raises(ValueError, match="aligned length"):
+            featurize_raw(RawFoldRequest(SEQ, msa=["MKV"]))
+        with pytest.raises(ValueError):
+            featurize_raw(RawFoldRequest(SEQ, msa=np.zeros((2, 3),
+                                                           np.int32)))
+
+
+@pytest.mark.quick
+class TestFeatureCache:
+    def test_roundtrip_and_validation(self):
+        key = feature_key(SEQ, MSA)
+        value = featurize_raw(RawFoldRequest(SEQ, msa=MSA))
+        data = encode_features(key, value)
+        back = decode_features(key, data)
+        np.testing.assert_array_equal(back.seq, value.seq)
+        np.testing.assert_array_equal(back.msa, value.msa)
+        with pytest.raises(Exception):
+            decode_features("other-key", data)
+        with pytest.raises(Exception):
+            decode_features(key, data[:40])
+
+    def test_memory_lru_eviction_bytes_accounting(self):
+        reg = obs.MetricsRegistry()
+        cache = FeatureCache(max_entries=2, registry=reg)
+        for i in range(3):
+            cache.put(f"k{i}", np.full(4, i, np.int32))
+        assert len(cache) == 2
+        assert cache.get("k0") is None       # LRU evicted
+        assert cache.evictions == 1
+        assert cache.bytes_resident == 2 * 16
+
+    def test_disk_tier_roundtrip_and_promotion(self, tmp_path):
+        reg = obs.MetricsRegistry()
+        d = str(tmp_path / "feat")
+        a = FeatureCache(disk_dir=d, registry=reg)
+        key = feature_key(SEQ, MSA)
+        feats = featurize_raw(RawFoldRequest(SEQ, msa=MSA))
+        a.put(key, feats.seq, feats.msa)
+        # a fresh instance over the same dir: disk hit, promoted to mem
+        b = FeatureCache(disk_dir=d, registry=reg)
+        got = b.get(key)
+        assert got is not None
+        np.testing.assert_array_equal(got.seq, feats.seq)
+        assert b.disk_hits == 1
+        assert b.get(key) is not None        # now memory-resident
+        assert b.hits == 2
+
+    def test_corrupt_disk_entry_quarantined(self, tmp_path):
+        import os
+        reg = obs.MetricsRegistry()
+        d = str(tmp_path / "feat")
+        cache = FeatureCache(disk_dir=d, registry=reg)
+        key = feature_key(SEQ)
+        cache.put(key, tokenize(SEQ))
+        path = cache._path(key)
+        with open(path, "wb") as fh:
+            fh.write(b"garbage")
+        fresh = FeatureCache(disk_dir=d, registry=reg)
+        assert fresh.get(key) is None
+        assert fresh.disk_errors == 1
+        assert not os.path.exists(path)
+        assert os.path.exists(path + ".quarantined")
+
+
+class TestFeaturePool:
+    def test_dedup_coalescing_fan_out(self):
+        """N identical raw jobs in flight: ONE featurize execution,
+        N-1 coalesced, every ticket resolves ok with exact arrays."""
+        reg = obs.MetricsRegistry()
+        calls = []
+
+        def counting(raw):
+            calls.append(raw.request_id)
+            time.sleep(0.1)
+            return featurize_raw(raw)
+
+        pool = FeaturePool(workers=2, cache=FeatureCache(registry=reg),
+                           featurize_fn=counting, registry=reg)
+        sched = _scheduler(pool, registry=reg)
+        with PipelineScheduler(sched, pool) as pipe:
+            tickets = [pipe.submit_raw(RawFoldRequest(SEQ, msa=MSA))
+                       for _ in range(4)]
+            resps = [t.result(timeout=30) for t in tickets]
+        assert all(r.ok for r in resps)
+        assert len(calls) == 1                 # zero duplicate featurize
+        snap = pool.snapshot()
+        assert snap["executions"] == 1
+        assert snap["coalesced"] == 3
+        for r in resps:
+            assert r.coords.shape == (len(SEQ), 3)
+
+    def test_cache_hit_skips_featurize(self):
+        reg = obs.MetricsRegistry()
+        calls = []
+
+        def counting(raw):
+            calls.append(1)
+            return featurize_raw(raw)
+
+        pool = FeaturePool(workers=1, cache=FeatureCache(registry=reg),
+                           featurize_fn=counting, registry=reg)
+        sched = _scheduler(pool, registry=reg)
+        with PipelineScheduler(sched, pool) as pipe:
+            assert pipe.submit_raw(
+                RawFoldRequest(SEQ, msa=MSA)).result(timeout=30).ok
+            assert pipe.submit_raw(
+                RawFoldRequest(SEQ, msa=MSA)).result(timeout=30).ok
+        assert len(calls) == 1
+        snap = pool.snapshot()
+        assert snap["cache_hits"] == 1
+        assert snap["executions"] == 1
+        assert reg.counter(
+            "serve_featurize_cache_hits_total").value() == 1
+
+    def test_raw_vs_pretokenized_end_to_end_equality(self):
+        """The pipeline is a pure re-plumbing: a raw submission must
+        serve byte-identical coords/confidence to the classic
+        tokenized submit of the same content."""
+        reg = obs.MetricsRegistry()
+        pool = FeaturePool(workers=2, cache=FeatureCache(registry=reg),
+                           registry=reg)
+        sched = _scheduler(pool, registry=reg)
+        with PipelineScheduler(sched, pool) as pipe:
+            raw_resp = pipe.submit_raw(
+                RawFoldRequest(SEQ, msa=MSA)).result(timeout=30)
+        sched2 = _scheduler()
+        with sched2:
+            tok_resp = sched2.submit(FoldRequest(
+                seq=tokenize(SEQ),
+                msa=np.stack([tokenize(r) for r in MSA]))).result(
+                    timeout=30)
+        assert raw_resp.ok and tok_resp.ok
+        np.testing.assert_array_equal(raw_resp.coords, tok_resp.coords)
+        np.testing.assert_array_equal(raw_resp.confidence,
+                                      tok_resp.confidence)
+
+    def test_feature_deadline_shed(self):
+        """A raw job whose deadline dies while features cook is shed
+        WITHOUT touching the fold queue."""
+        reg = obs.MetricsRegistry()
+        pool = FeaturePool(workers=1, latency_s=0.2, registry=reg)
+        sched = _scheduler(pool, registry=reg)
+        with PipelineScheduler(sched, pool) as pipe:
+            resp = pipe.submit_raw(RawFoldRequest(
+                SEQ, deadline_s=0.02)).result(timeout=30)
+        assert resp.status == "shed"
+        assert "feature_deadline_exceeded" in resp.error
+        assert pool.snapshot()["shed"] == 1
+        assert sched.serve_stats()["enqueued"] == 0
+
+    def test_featurize_error_fans_out_to_coalesced(self):
+        """A failing featurize resolves the leader AND every coalesced
+        waiter as error — nobody hangs."""
+        reg = obs.MetricsRegistry()
+
+        def boom(raw):
+            time.sleep(0.05)
+            raise RuntimeError("featurize boom")
+
+        pool = FeaturePool(workers=1, featurize_fn=boom, registry=reg)
+        sched = _scheduler(pool, registry=reg)
+        with PipelineScheduler(sched, pool) as pipe:
+            tickets = [pipe.submit_raw(RawFoldRequest(SEQ))
+                       for _ in range(3)]
+            resps = [t.result(timeout=30) for t in tickets]
+        assert all(r.status == "error" for r in resps)
+        assert all("featurize boom" in r.error for r in resps)
+        assert pool.snapshot()["errors"] == 3
+        assert reg.counter("serve_featurize_errors_total").value() == 3
+
+    def test_progress_chains_through_pipeline(self):
+        """Progressive updates published on the inner fold ticket
+        reach the raw caller's ticket."""
+        reg = obs.MetricsRegistry()
+        pool = FeaturePool(workers=1, registry=reg)
+        sched = _scheduler(pool, registry=reg)
+        seen = []
+        with PipelineScheduler(sched, pool) as pipe:
+            ticket = pipe.submit_raw(RawFoldRequest(SEQ))
+            ticket.add_progress_callback(lambda p: seen.append(p))
+            assert ticket.result(timeout=30).ok
+        # the stub fold publishes no progress; exercise the chain
+        # directly: outer tickets must expose the inner publication
+        assert ticket.progress() == seen
+
+    def test_preseeded_cache_serves_without_execution(self):
+        """Claim-then-check ordering: a key already in the cache (a
+        prior process, a racing leader that finished first) serves at
+        zero executions, and the transient leadership claim is
+        released for the next key."""
+        reg = obs.MetricsRegistry()
+        cache = FeatureCache(registry=reg)
+        feats = featurize_raw(RawFoldRequest(SEQ, msa=MSA))
+        cache.put(feature_key(SEQ, MSA,
+                              config_digest=featurizer_config_digest()),
+                  feats.seq, feats.msa)
+        pool = FeaturePool(workers=1, cache=cache, registry=reg)
+        sched = _scheduler(pool, registry=reg)
+        with PipelineScheduler(sched, pool) as pipe:
+            assert pipe.submit_raw(
+                RawFoldRequest(SEQ, msa=MSA)).result(timeout=30).ok
+        snap = pool.snapshot()
+        assert snap["executions"] == 0 and snap["cache_hits"] == 1
+        with pool._lock:
+            assert not pool._inflight        # claim fully released
+
+    def test_overlength_raw_job_resolves_and_traces(self, tmp_path):
+        """A raw job whose featurized length exceeds the largest
+        bucket fails at the fold submit's fail-fast — the ticket must
+        still resolve AND its trace must still emit (no silent
+        disappearance from obs)."""
+        reg = obs.MetricsRegistry()
+        tracer = obs.Tracer(jsonl_path=str(tmp_path / "t.jsonl"))
+        pool = FeaturePool(workers=1, registry=reg)
+        sched = _scheduler(pool, tracer=tracer, registry=reg)
+        with PipelineScheduler(sched, pool) as pipe:
+            resp = pipe.submit_raw(
+                RawFoldRequest("M" * 64)).result(timeout=30)
+        assert resp.status == "error"
+        assert "rejected after featurize" in resp.error
+        tracer.close()
+        (rec,) = [json.loads(line)
+                  for line in open(tmp_path / "t.jsonl")]
+        assert rec["status"] == "error"
+        assert "featurize" in [s["name"] for s in rec["spans"]]
+
+    def test_featurize_span_in_trace(self, tmp_path):
+        reg = obs.MetricsRegistry()
+        tracer = obs.Tracer(jsonl_path=str(tmp_path / "t.jsonl"))
+        pool = FeaturePool(workers=1, cache=FeatureCache(registry=reg),
+                           registry=reg)
+        sched = _scheduler(pool, tracer=tracer, registry=reg)
+        with PipelineScheduler(sched, pool) as pipe:
+            assert pipe.submit_raw(
+                RawFoldRequest(SEQ, msa=MSA)).result(timeout=30).ok
+        tracer.close()
+        recs = [json.loads(line)
+                for line in open(tmp_path / "t.jsonl")]
+        (rec,) = recs
+        names = [s["name"] for s in rec["spans"]]
+        assert names[0] == "featurize"
+        assert "submit" in names and "fold" in names
+        assert rec["status"] == "ok"
+
+    def test_queue_depth_gauge(self):
+        reg = obs.MetricsRegistry()
+        release = threading.Event()
+
+        def gated(raw):
+            release.wait(10)
+            return featurize_raw(raw)
+
+        pool = FeaturePool(workers=1, featurize_fn=gated, registry=reg)
+        sched = _scheduler(pool, registry=reg)
+        with PipelineScheduler(sched, pool) as pipe:
+            tickets = [pipe.submit_raw(RawFoldRequest(detokenize(
+                np.full(8, i, np.int32)))) for i in range(3)]
+            deadline = time.monotonic() + 5
+            while reg.gauge("serve_featurize_queue_depth").value() < 3 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert reg.gauge(
+                "serve_featurize_queue_depth").value() == 3
+            release.set()
+            for t in tickets:
+                assert t.result(timeout=30).ok
+        assert reg.gauge("serve_featurize_queue_depth").value() == 0
+
+
+class TestOffByDefault:
+    def test_submit_raw_without_pool_inline(self):
+        """No pool: submit_raw featurizes inline and behaves exactly
+        like tokenize + submit."""
+        sched = _scheduler()
+        with sched:
+            resp = sched.submit_raw(
+                RawFoldRequest(SEQ, msa=MSA)).result(timeout=30)
+        assert resp.ok and resp.source == "fold"
+        assert "featurize" not in sched.serve_stats()
+
+    def test_scrubbed_serve_stats_identity(self):
+        """The off switch: feature_pool=None must leave serve_stats()
+        byte-identical between a submit_raw workload and the classic
+        tokenized-submit workload of the same content (scrubbed of
+        wall-clock fields, same rule as the mesh/transport identity
+        tests)."""
+        def scrub(obj):
+            if isinstance(obj, dict):
+                return {k: scrub(v) for k, v in sorted(obj.items())
+                        if k != "traces" and not k.endswith("_s")}
+            if isinstance(obj, list):
+                return [scrub(v) for v in obj]
+            return obj
+
+        def run_one(use_raw):
+            sched = _scheduler()
+            with sched:
+                for s in (SEQ, SEQ[:8], SEQ[:6]):
+                    if use_raw:
+                        t = sched.submit_raw(RawFoldRequest(s))
+                    else:
+                        t = sched.submit(FoldRequest(seq=tokenize(s)))
+                    assert t.result(timeout=30).ok
+            return scrub(sched.serve_stats())
+
+        a = run_one(True)
+        b = run_one(False)
+        assert json.dumps(a, sort_keys=True, default=str) \
+            == json.dumps(b, sort_keys=True, default=str)
+        assert "featurize" not in a
+
+
+class TestFleetRawPath:
+    def test_rpc_raw_roundtrip(self):
+        from alphafold2_tpu.fleet.rpc import (decode_raw_request,
+                                              encode_raw_request)
+        raw = RawFoldRequest(SEQ, msa=MSA, priority=2, deadline_s=1.5)
+        body, headers = encode_raw_request(raw)
+        assert headers["Content-Type"] == "application/json"
+        back = decode_raw_request(body, headers)
+        assert back.seq == SEQ and list(back.msa) == MSA
+        assert back.priority == 2 and back.deadline_s == 1.5
+        assert back.request_id == raw.request_id
+        # token form travels as int lists
+        raw_t = RawFoldRequest(tokenize(SEQ))
+        body, headers = encode_raw_request(raw_t)
+        back = decode_raw_request(body, headers)
+        np.testing.assert_array_equal(np.asarray(back.seq),
+                                      tokenize(SEQ))
+
+    def test_malformed_raw_body_is_value_error(self):
+        """Every malformed-content failure must be ValueError (the
+        front door's 400), never TypeError (a 500 failover layers
+        would retry fleet-wide)."""
+        from alphafold2_tpu.fleet.rpc import decode_raw_request
+        for body in (b'{"seq": null}', b'{"seq": {"a": 1}}',
+                     b'{"seq": "MKV", "msa": 3}', b'not json', b'{}'):
+            with pytest.raises(ValueError):
+                decode_raw_request(body, {})
+
+    def test_frontdoor_accepts_raw_json_body(self):
+        """POST /v1/submit with a JSON body featurizes replica-side
+        and serves the fold over the normal long-poll."""
+        from alphafold2_tpu.fleet.frontdoor import FrontDoorServer
+        from alphafold2_tpu.fleet.rpc import HttpTransport
+
+        reg = obs.MetricsRegistry()
+        sched = _scheduler(registry=reg)
+        sched.start()
+        server = FrontDoorServer(sched, replica_id="r0", metrics=reg)
+        try:
+            with server:
+                transport = HttpTransport(server.url, metrics=reg)
+                ticket = transport.submit_raw(
+                    RawFoldRequest(SEQ, msa=MSA))
+                resp = ticket.result(timeout=30)
+        finally:
+            sched.stop()
+        assert resp.ok, (resp.status, resp.error)
+        assert resp.coords.shape == (len(SEQ), 3)
+        # byte-equal to the in-process tokenized fold of the same content
+        sched2 = _scheduler()
+        with sched2:
+            local = sched2.submit(FoldRequest(
+                seq=tokenize(SEQ),
+                msa=np.stack([tokenize(r) for r in MSA]))).result(
+                    timeout=30)
+        np.testing.assert_array_equal(resp.coords, local.coords)
+
+    def test_fleet_routes_raw_by_feature_key(self):
+        """InProcessFleet with feature pools: every unique raw key
+        featurizes exactly once FLEET-WIDE (the owner does it), and
+        cross-replica raw jobs take the forward hop."""
+        from alphafold2_tpu import fleet
+
+        reg = obs.MetricsRegistry()
+        fl = fleet.InProcessFleet(
+            lambda: _StubExecutor(), BucketPolicy((16,)),
+            SchedulerConfig(max_batch_size=2, max_wait_ms=10.0,
+                            num_recycles=0),
+            n_replicas=2, model_tag="t", registry=reg,
+            feature_pool_factory=lambda i: FeaturePool(
+                workers=1, cache=FeatureCache(registry=reg),
+                registry=reg))
+        seqs = [detokenize(np.asarray(
+            np.random.default_rng(s).integers(0, 21, 10), np.int32))
+            for s in range(4)]
+        with fl:
+            tickets = [fl.submit_raw(RawFoldRequest(s), replica=i % 2)
+                       for i, s in enumerate(seqs * 3)]
+            for t in tickets:
+                r = t.result(timeout=30)
+                assert r.ok, (r.status, r.error)
+        pools = [r.scheduler.feature_pool for r in fl.replicas]
+        assert sum(p.executions for p in pools) == len(seqs)
+        assert sum(p.forwarded for p in pools) > 0
+
+
+class TestMemoryAwarePreemption:
+    """ISSUE 10 satellite: the leased-yield admission guard prices the
+    suspended loop's HBM-resident carry."""
+
+    def _mesh_scheduler(self, hbm_gb, recycle=True):
+        from alphafold2_tpu.serve import (FoldMemoryModel, MeshPolicy,
+                                          RecyclePolicy)
+
+        memory = FoldMemoryModel(param_bytes=0, dim=64, heads=8,
+                                 hbm_bytes_per_device=int(
+                                     hbm_gb * (1 << 30)))
+        policy = MeshPolicy({16: 1}, devices=[object() for _ in range(2)],
+                            memory=memory)
+        reg = obs.MetricsRegistry()
+        sched = Scheduler(
+            _StubExecutor(), BucketPolicy((16,)),
+            SchedulerConfig(max_batch_size=4, max_wait_ms=10.0,
+                            num_recycles=2, msa_depth=0),
+            ServeMetrics(registry=reg), registry=reg,
+            mesh_policy=policy,
+            recycle_policy=(RecyclePolicy(preempt=True) if recycle
+                            else None))
+        return sched, reg
+
+    def test_carry_bytes_term(self):
+        from alphafold2_tpu.serve import FoldMemoryModel
+
+        m = FoldMemoryModel(param_bytes=0, dim=32)
+        assert m.carry_bytes(64, 2) > 0
+        # pairwise term shards over the slice
+        assert m.carry_bytes(64, 2, chips=4) < m.carry_bytes(64, 2)
+        # fold_bytes(carry_recyclables=True) is exactly base + carry
+        assert m.fold_bytes(64, 2, 0, carry_recyclables=True) \
+            == m.fold_bytes(64, 2, 0) + m.carry_bytes(64, 2)
+
+    def test_admits_with_headroom_refuses_without(self):
+        sched_big, _ = self._mesh_scheduler(hbm_gb=64.0)
+        assert sched_big._preempt_hbm_admits(16, 16)
+        # tiny budget: urgent footprint + suspended carry cannot
+        # co-reside on one device
+        sched_small, _ = self._mesh_scheduler(hbm_gb=0.0005)
+        assert not sched_small._preempt_hbm_admits(16, 16)
+        # no urgent bucket / no memory model -> vacuously admitted
+        assert sched_small._preempt_hbm_admits(16, None)
+        sched_small.mesh_policy.memory = None
+        assert sched_small._preempt_hbm_admits(16, 16)
+
+    def test_unpinned_msa_depth_prices_urgent_entry_depth(self):
+        """With config.msa_depth=None the admission must price the
+        urgent entry's OWN advertised MSA depth, not zero — a deep-MSA
+        urgent batch that only fits without its MSA term must be
+        refused."""
+        from alphafold2_tpu.serve import (FoldMemoryModel, MeshPolicy,
+                                          RecyclePolicy)
+
+        memory = FoldMemoryModel(param_bytes=0, dim=64, heads=8)
+        policy = MeshPolicy({16: 1},
+                            devices=[object() for _ in range(2)],
+                            memory=memory)
+        reg = obs.MetricsRegistry()
+        sched = Scheduler(
+            _StubExecutor(), BucketPolicy((16,)),
+            SchedulerConfig(max_batch_size=4, max_wait_ms=10.0,
+                            num_recycles=2, msa_depth=None),
+            ServeMetrics(registry=reg), registry=reg,
+            mesh_policy=policy,
+            recycle_policy=RecyclePolicy(preempt=True))
+        base = memory.fold_bytes(16, 4, 0, carry_recyclables=True) \
+            + memory.carry_bytes(16, 4)
+        deep = memory.fold_bytes(16, 4, 4096, carry_recyclables=True) \
+            + memory.carry_bytes(16, 4)
+        assert deep > base
+        memory.hbm_bytes_per_device = (base + deep) // 2
+        assert sched._preempt_hbm_admits(16, 16, urgent_msa=0)
+        assert sched._preempt_hbm_admits(16, 16, urgent_msa=None)
+        assert not sched._preempt_hbm_admits(16, 16, urgent_msa=4096)
+
+    def test_leased_yield_refused_and_counted(self):
+        """Saturated pool + tight urgent deadline, but no HBM headroom:
+        _maybe_preempt must keep the lease, count the refusal, and
+        never release/re-acquire."""
+        from alphafold2_tpu.serve.scheduler import _Entry
+
+        sched, reg = self._mesh_scheduler(hbm_gb=0.0005)
+        alloc = sched._allocator
+        lease = alloc.acquire((1, 1))
+        other = alloc.acquire((1, 1))     # pool saturated
+        assert not alloc.can_allocate((1, 1))
+        with sched._cond:
+            sched._pending_tightest = time.monotonic() + 0.5
+            sched._pending_tightest_chips = 1
+            sched._pending_tightest_bucket = 16
+        entry = _Entry(FoldRequest(seq=np.zeros(8, np.int32)), 16)
+        out = sched._maybe_preempt([entry], lease, gap=1, bucket_len=16)
+        assert out is lease               # kept, not yielded
+        assert sched._n_preempt_hbm_refusals == 1
+        assert sched._n_preemptions == 0
+        assert reg.counter(
+            "serve_preempt_hbm_refusals_total").value() == 1
+        stats = sched.serve_stats()
+        assert stats["recycle"]["preempt_hbm_refusals"] == 1
+        alloc.release(lease)
+        alloc.release(other)
+
+    def test_leased_yield_proceeds_with_headroom(self):
+        """Same saturation, big budget: the yield fires (preemption
+        counted, slice released for the gap then re-acquired)."""
+        from alphafold2_tpu.serve.scheduler import _Entry
+
+        sched, reg = self._mesh_scheduler(hbm_gb=64.0)
+        alloc = sched._allocator
+        lease = alloc.acquire((1, 1))
+        other = alloc.acquire((1, 1))
+        with sched._cond:
+            sched._pending_tightest = time.monotonic() + 0.5
+            sched._pending_tightest_chips = 1
+            sched._pending_tightest_bucket = 16
+        entry = _Entry(FoldRequest(seq=np.zeros(8, np.int32)), 16)
+        out = sched._maybe_preempt([entry], lease, gap=1, bucket_len=16)
+        assert out is not lease           # re-acquired lease object
+        assert out.start == lease.start   # ... over the SAME span
+        assert sched._n_preemptions == 1
+        assert sched._n_preempt_hbm_refusals == 0
+        alloc.release(out)
+        alloc.release(other)

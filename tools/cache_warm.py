@@ -18,6 +18,13 @@ Frequency file: JSONL, one record per unique sequence —
 loadtest's traffic model) to F and exits — the self-contained demo /
 test path.
 
+`--fleet ID=DIR,...` warms FLEET-SCOPE (ISSUE 10 satellite): every key
+routes through the serving fleet's own `ConsistentHashRouter` and is
+folded into its OWNER replica's cache dir, so each warm entry lands
+exactly where forwarded requests and peer-cache fetches will look for
+it. Run once against every replica's mounted cache dir instead of once
+per replica; the report carries `warmed_per_replica`.
+
 Key-regime note (predict.fold_and_write docstring has the contract):
 entries are keyed with msa_depth=None semantics, so they cross-hit a
 serving scheduler configured with `msa_depth=None`, any other
@@ -64,6 +71,15 @@ def parse_args(argv=None):
                     help="on-disk cache tier to warm (strongly "
                          "recommended: a memory-only warm dies with "
                          "this process)")
+    ap.add_argument("--fleet", default="",
+                    help="FLEET-SCOPE warming: 'ID=DIR,ID=DIR,...' "
+                         "replica cache directories. Each key is "
+                         "routed through the same ConsistentHashRouter "
+                         "the serving fleet uses and warmed into its "
+                         "OWNER replica's cache dir — so warm entries "
+                         "land exactly where forwarded/peer traffic "
+                         "will look for them, instead of all in one "
+                         "replica's tier. Overrides --cache-dir.")
     ap.add_argument("--model-tag", default="",
                     help="model identity for the cache keys; MUST match "
                          "the serving fleet's tag or the warm is "
@@ -169,23 +185,66 @@ def main(argv=None) -> int:
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, n0), jnp.int32), **init_kwargs)
 
-    cache = FoldCache(disk_dir=args.cache_dir or None)
+    # --fleet: one cache per replica dir + the serving fleet's own
+    # consistent-hash routing, so each key is warmed into its OWNER
+    # replica's tier (ROADMAP fleet-scope warming: a warm that piles
+    # everything into one replica's dir only helps that replica's
+    # local traffic — forwarded and peer-fetched traffic looks on the
+    # ring owner)
+    router = None
+    caches = {}
+    if args.fleet:
+        from alphafold2_tpu.cache import fold_key
+        from alphafold2_tpu.fleet.registry import ReplicaRegistry
+        from alphafold2_tpu.fleet.router import ConsistentHashRouter
+
+        registry = ReplicaRegistry(model_tag=args.model_tag)
+        for kv in args.fleet.split(","):
+            try:
+                rid, cdir = kv.split("=", 1)
+            except ValueError:
+                print(f"cache_warm: bad --fleet entry {kv!r} "
+                      f"(want ID=DIR)", file=sys.stderr)
+                return 2
+            registry.register(rid.strip())
+            caches[rid.strip()] = FoldCache(disk_dir=cdir.strip() or None)
+        router = ConsistentHashRouter(registry,
+                                      next(iter(caches)))
+        cache = None
+    else:
+        cache = FoldCache(disk_dir=args.cache_dir or None)
     os.makedirs(args.out_dir, exist_ok=True)
+
+    def _resident_bytes():
+        if cache is not None:
+            return cache.bytes_resident
+        return sum(c.bytes_resident for c in caches.values())
 
     t0 = time.monotonic()
     warmed, warmed_freq, skipped = 0, 0, 0
+    per_replica = {rid: 0 for rid in caches}
     head = entries[:args.top] if args.top > 0 else entries
     for rank, (count, seq, msa) in enumerate(head):
-        if args.budget_bytes and cache.bytes_resident >= args.budget_bytes:
+        if args.budget_bytes and _resident_bytes() >= args.budget_bytes:
             break
-        hits_before = cache.stats.hits
+        target = cache
+        if router is not None:
+            # the SAME key fold_and_write will compute below (no mask,
+            # trivial msa_mask, no extras): its ring owner's cache is
+            # where serving-time peer fetches and forwards will look
+            key = fold_key(seq, msa, num_recycles=args.num_recycles,
+                           model_tag=args.model_tag)
+            owner = router.owner_for(key) or next(iter(caches))
+            target = caches[owner]
+            per_replica[owner] += 1
+        hits_before = target.stats.hits
         kwargs = {} if msa is None else {"msa": msa[None]}
         predict.fold_and_write(
             model, params, seq[None],
             os.path.join(args.out_dir, f"warm_{rank}.pdb"),
-            cache=cache, model_tag=args.model_tag,
+            cache=target, model_tag=args.model_tag,
             num_recycles=args.num_recycles, **kwargs)
-        if cache.stats.hits > hits_before:
+        if target.stats.hits > hits_before:
             skipped += 1               # already warm: fold was elided
         else:
             warmed += 1
@@ -193,8 +252,10 @@ def main(argv=None) -> int:
     elapsed = time.monotonic() - t0
 
     disk_bytes = 0
-    if args.cache_dir:
-        for root, _, files in os.walk(args.cache_dir):
+    disk_dirs = ([args.cache_dir] if args.cache_dir and cache is not None
+                 else [c.disk_dir for c in caches.values() if c.disk_dir])
+    for d in disk_dirs:
+        for root, _, files in os.walk(d):
             disk_bytes += sum(
                 os.path.getsize(os.path.join(root, f))
                 for f in files if f.endswith(".npz"))
@@ -204,9 +265,12 @@ def main(argv=None) -> int:
         "unique_in_profile": len(entries),
         "warmed": warmed,
         "skipped_already_cached": skipped,
-        "bytes_resident": cache.bytes_resident,
+        "bytes_resident": _resident_bytes(),
         "disk_bytes": disk_bytes,
         "cache_dir": args.cache_dir,
+        "fleet": (None if router is None else {
+            "replicas": list(caches),
+            "warmed_per_replica": per_replica}),
         "model_tag": args.model_tag,
         # frequency mass covered by the (now-warm) head: the hit ratio
         # this warm predicts for traffic matching the profile

@@ -53,13 +53,17 @@ from alphafold2_tpu.utils.profiling import percentile  # noqa: E402
 # recycle (one single-recycle step execution of the scheduler-owned
 # recycle loop, tagged with its iteration index) with ISSUE 9 — the
 # init pass stays a `fold` span so the accelerator-time rule below
-# holds unchanged for step-scheduled requests.
+# holds unchanged for step-scheduled requests;
+# featurize (the CPU feature-pipeline stage of a RAW submission:
+# feature-cache lookup, in-flight coalesce wait, pool queue + the
+# tokenize/MSA-prep work itself) with ISSUE 10 — it precedes submit in
+# the pipeline, so it leads the waterfall.
 # --check's orphan-span rules apply to all of them unchanged, which is
 # how the chaos smokes prove recovery cost is fully accounted.
-STAGE_ORDER = ("submit", "forward", "rpc", "queue", "parked", "retry",
-               "drain", "batch_form", "shard", "compile", "fold",
-               "recycle", "watchdog", "writeback", "peer_fetch",
-               "cache_lookup", "write")
+STAGE_ORDER = ("featurize", "submit", "forward", "rpc", "queue",
+               "parked", "retry", "drain", "batch_form", "shard",
+               "compile", "fold", "recycle", "watchdog", "writeback",
+               "peer_fetch", "cache_lookup", "write")
 
 # span/trace boundary slack: start_s, dur_s, and duration_s are each
 # INDEPENDENTLY rounded to 1e-6 when emitted, so a span auto-closed at

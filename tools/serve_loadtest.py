@@ -176,6 +176,24 @@ def parse_args(argv=None):
     ap.add_argument("--no-preempt", action="store_true",
                     help="disable between-recycle preemption "
                          "(isolates the early-exit effect)")
+    ap.add_argument("--feature-latency-ms", type=float, default=0.0,
+                    help="FEATURE-PIPELINE mode (ISSUE 10): synthetic "
+                         "featurize latency per execution, standing in "
+                         "for real MSA-search cost. > 0 switches to "
+                         "the raw-submission driver: requests enter as "
+                         "AA strings + raw MSA and featurize "
+                         "replica-side")
+    ap.add_argument("--feature-pool", type=int, default=0,
+                    help="featurize worker threads (serve.FeaturePool "
+                         "+ feature cache + coalescing). 0 = the "
+                         "SERIALIZED baseline: featurize inline on the "
+                         "submit path, no feature cache — exactly what "
+                         "callers paid before the pipeline split")
+    ap.add_argument("--feature-dup-rate", type=float, default=0.0,
+                    help="fraction of raw submissions repeating an "
+                         "earlier raw sequence (Zipf skew), "
+                         "exercising the feature cache + featurize "
+                         "coalescing independently of fold dedup")
     ap.add_argument("--dim", type=int, default=32)
     ap.add_argument("--depth", type=int, default=1)
     ap.add_argument("--metrics-path", default="/tmp/serve_loadtest.jsonl")
@@ -382,6 +400,8 @@ def main(argv=None) -> int:
         return _run_procs(args)
     if args.replicas > 1:
         return _run_fleet(args)
+    if args.feature_latency_ms > 0 or args.feature_pool > 0:
+        return _run_features(args)
 
     import jax
     import jax.numpy as jnp
@@ -760,6 +780,250 @@ def _check_chaos_smoke(args, snap, failures, poison_results,
           f"faults, {snap['retried']} retries, "
           f"{inj.get('bisections', 0)} bisections, "
           f"{snap['poisoned']} poisoned, 0 innocent casualties",
+          file=sys.stderr)
+    return 0
+
+
+def _run_features(args) -> int:
+    """--feature-latency-ms / --feature-pool: the two-stage feature
+    pipeline vs the serialized featurize-in-submit baseline (ISSUE 10).
+
+    Requests enter RAW (AA strings + raw MSA rows) in two open-loop
+    waves — submit a wave without waiting per-request, then wait it
+    out, then the next (wave 2's duplicates of wave-1 keys exercise
+    the feature CACHE; in-wave duplicates exercise featurize
+    COALESCING). `--feature-pool 0` is the baseline: each submitter
+    thread pays the synthetic featurize latency inline before
+    submitting, exactly the pre-pipeline cost model. `--feature-pool
+    N` runs a serve.FeaturePool of N workers + FeatureCache, so
+    featurization overlaps the executor and scales independently of
+    the submit path (ParaFold's separately-scaled pools).
+
+    One JSON line (`"metric": "serve_loadtest_features"`): folds/hour,
+    executor idle fraction (1 - exec_busy/wall — the number the
+    pipeline exists to drive down), featurize p50/p99, feature cache
+    hit ratio, featurize executions vs unique keys. With --smoke:
+    FAILS on any non-ok outcome, on any duplicate featurize execution
+    for a coalesced/cached key (executions must equal unique keys
+    featurized), and — with duplicate traffic — on a dead feature
+    cache (hit ratio 0)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from alphafold2_tpu import serve
+    from alphafold2_tpu.cache import FeatureCache, feature_key
+    from alphafold2_tpu.data.featurize import detokenize
+    from alphafold2_tpu.data.synthetic import synthetic_requests
+    from alphafold2_tpu.utils.profiling import StepTimer
+
+    lengths = tuple(int(x) for x in args.lengths.split(",") if x)
+    if args.buckets:
+        policy = serve.BucketPolicy(
+            int(x) for x in args.buckets.split(",") if x)
+    else:
+        policy = serve.BucketPolicy.powers_of_two(
+            min(lengths), max(max(lengths), min(lengths)))
+    model, params = _build_tiny_model(args, jax, jnp, policy)
+
+    latency_s = args.feature_latency_ms / 1000.0
+    pipelined = args.feature_pool > 0
+    pool_obj = None
+    if pipelined:
+        pool_obj = serve.FeaturePool(
+            workers=args.feature_pool,
+            cache=FeatureCache(),
+            latency_s=latency_s)
+    tracer = None
+    if args.trace_path:
+        from alphafold2_tpu import obs
+        tracer = obs.Tracer(jsonl_path=args.trace_path,
+                            slow_k=args.trace_slow_k)
+    executor = serve.FoldExecutor(model, params,
+                                  max_entries=policy.num_buckets,
+                                  model_tag="serve_loadtest")
+    metrics = serve.ServeMetrics(args.metrics_path)
+    config = serve.SchedulerConfig(
+        max_batch_size=args.max_batch, max_wait_ms=args.max_wait_ms,
+        num_recycles=args.num_recycles, msa_depth=args.msa_depth)
+    scheduler = serve.Scheduler(executor, policy, config, metrics,
+                                model_tag="serve_loadtest",
+                                tracer=tracer, feature_pool=pool_obj)
+
+    warmup_timer = StepTimer()
+    with warmup_timer.measure():
+        compiles = scheduler.warmup()
+    scheduler.start()
+
+    # raw prototypes: detokenize back to AA strings (tokenize is an
+    # exact inverse over the synthetic token range), so the run
+    # exercises the real string -> tokens path
+    proto_pool = synthetic_requests(
+        jax.random.PRNGKey(1), num=max(args.requests, 64),
+        lengths=lengths, msa_depth=args.msa_depth)
+    raw_pool = []
+    for p in proto_pool:
+        msa_rows = (None if p.msa is None
+                    else [detokenize(row) for row in np.asarray(p.msa)])
+        raw_pool.append((detokenize(np.asarray(p.seq)), msa_rows))
+
+    import copy
+    sched_args = copy.copy(args)
+    sched_args.dup_rate = args.feature_dup_rate
+    sched_args.duration_s = 0.0
+    schedule = _zipf_schedule(sched_args, len(raw_pool))
+
+    failures = []
+    statuses = {}
+    lock = threading.Lock()
+    fold_digest = serve.featurizer_config_digest()
+    unique_keys = {feature_key(raw_pool[j][0], raw_pool[j][1],
+                               config_digest=fold_digest)
+                   for j in set(schedule)}
+
+    def submit_one(i):
+        seq_str, msa_rows = raw_pool[schedule[i]]
+        raw = serve.RawFoldRequest(seq=seq_str, msa=msa_rows)
+        if not pipelined and latency_s > 0:
+            time.sleep(latency_s)    # serialized featurize-in-submit
+        return raw, scheduler.submit_raw(raw)
+
+    def run_wave(indices):
+        tickets = []
+        t_lock = threading.Lock()
+        it = iter(indices)
+
+        def worker():
+            while True:
+                with t_lock:
+                    i = next(it, None)
+                if i is None:
+                    return
+                try:
+                    raw, ticket = submit_one(i)
+                except Exception as exc:
+                    with lock:
+                        failures.append(repr(exc))
+                    continue
+                with t_lock:
+                    tickets.append((raw, ticket))
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(max(args.concurrency, 1))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for raw, ticket in tickets:
+            try:
+                resp = ticket.result(timeout=600)
+            except Exception as exc:
+                with lock:
+                    failures.append(repr(exc))
+                continue
+            with lock:
+                statuses[resp.status] = statuses.get(resp.status, 0) + 1
+            if not resp.ok:
+                with lock:
+                    failures.append(f"{resp.status}: {resp.error}")
+            elif resp.coords.shape != (raw.length, 3) or \
+                    not np.isfinite(resp.coords).all():
+                with lock:
+                    failures.append(
+                        f"bad coords {resp.coords.shape} for "
+                        f"n={raw.length}")
+
+    t0 = time.monotonic()
+    half = max(1, args.requests // 2)
+    run_wave(range(half))
+    run_wave(range(half, args.requests))
+    serving_wall = time.monotonic() - t0
+    if pool_obj is not None:
+        pool_obj.stop()
+    scheduler.stop()
+
+    snap = scheduler.serve_stats()
+    busy = snap.get("exec_busy_s", 0.0)
+    idle_fraction = max(0.0, 1.0 - busy / serving_wall) \
+        if serving_wall > 0 else 0.0
+    feat = snap.get("featurize")
+    report = {
+        "metric": "serve_loadtest_features",
+        "platform": args.platform,
+        "mode": "pipelined" if pipelined else "serialized",
+        "feature_latency_ms": args.feature_latency_ms,
+        "feature_pool": args.feature_pool,
+        "feature_dup_rate": args.feature_dup_rate,
+        "requests": args.requests,
+        "unique_raw_keys": len(unique_keys),
+        "served": snap["served"],
+        "batches": snap["batches"],
+        "folds_per_hour": round(
+            snap["served"] / serving_wall * 3600.0, 1)
+        if serving_wall else 0.0,
+        "serving_wall_s": round(serving_wall, 3),
+        "warmup_s": round(warmup_timer.mean * warmup_timer.count, 3),
+        "compiles": compiles,
+        "executor_busy_s": round(busy, 3),
+        "executor_idle_fraction": round(idle_fraction, 4),
+        "statuses": statuses,
+        "shed": snap["shed"],
+        "errors": snap["errors"],
+        "rejected": snap["rejected"],
+        "failures": failures[:8],
+    }
+    if feat is not None:
+        cache_snap = feat.get("cache", {})
+        report["featurize"] = {
+            "executions": feat["executions"],
+            "submissions": feat["submissions"],
+            "coalesced": feat["coalesced"],
+            "cache_hits": feat["cache_hits"],
+            "errors": feat["errors"],
+            "p50_s": round(feat["featurize_p50_s"], 4),
+            "p99_s": round(feat["featurize_p99_s"], 4),
+            "hit_ratio": round(cache_snap.get("hit_ratio", 0.0), 4),
+        }
+    if tracer is not None:
+        tracer.close()
+        report["trace_path"] = args.trace_path
+        report["traces_completed"] = tracer.completed
+    if args.prom_path:
+        from alphafold2_tpu import obs
+        obs.write_prometheus(args.prom_path)
+        report["prom_path"] = args.prom_path
+    metrics.close()
+    print(json.dumps(report))
+
+    if not args.smoke:
+        return 0
+    problems = []
+    bad = snap["shed"] + snap["errors"] + snap["rejected"] + len(failures)
+    if bad or snap["served"] == 0:
+        problems.append(f"{bad} bad outcomes, {snap['served']} served")
+    if pipelined and feat is not None:
+        # zero duplicate featurize work: every unique key featurizes
+        # exactly once — duplicates either coalesced in flight or hit
+        # the cache, never re-executed
+        if feat["executions"] != len(unique_keys):
+            problems.append(
+                f"{feat['executions']} featurize executions != "
+                f"{len(unique_keys)} unique raw keys (duplicate "
+                f"featurize work)")
+        if args.feature_dup_rate > 0 and feat["cache_hits"] == 0:
+            problems.append("duplicate raw traffic with 0 feature "
+                            "cache hits")
+    if problems:
+        print("SMOKE FAIL (features): " + "; ".join(problems),
+              file=sys.stderr)
+        return 1
+    extra = ""
+    if feat is not None:
+        extra = (f", {feat['executions']} featurize execs / "
+                 f"{feat['cache_hits']} hits / {feat['coalesced']} "
+                 f"coalesced")
+    print(f"SMOKE OK (features/{report['mode']}): {snap['served']} "
+          f"folds, idle fraction {idle_fraction:.3f}{extra}",
           file=sys.stderr)
     return 0
 

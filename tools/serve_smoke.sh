@@ -62,6 +62,22 @@
 #      their own cache extras, so nothing can cross-serve), and
 #      obs_report --check finds no orphan recycle spans. The
 #      iteration-level-scheduling tripwire.
+#   9. feature-pipeline disaggregation (--feature-latency-ms /
+#      --feature-pool, serve.FeaturePool): the identical raw-submission
+#      workload with synthetic featurize latency comparable to fold
+#      time, run TWICE — the serialized featurize-in-submit baseline
+#      (--feature-pool 0: every submit pays featurization inline),
+#      then the pipelined path (a 4-worker FeaturePool + FeatureCache +
+#      in-flight featurize coalescing, duplicate raw traffic at rate
+#      0.5). FAILS unless the pipelined run shows STRICTLY higher
+#      folds/hour and STRICTLY lower executor idle fraction than the
+#      baseline on the equal workload, the feature cache hit ratio is
+#      > 0 under the duplicate traffic, featurize executions equal
+#      unique raw keys (zero duplicate featurize work for coalesced/
+#      cached keys — serve_loadtest --smoke enforces it in-process),
+#      every request resolves ok, and obs_report --check is clean over
+#      the pipelined traces with featurize spans present in the
+#      waterfall. The feature-pipeline tripwire.
 #   7. multi-chip mesh serving (--mesh-policy, serve.MeshPolicy) under
 #      XLA_FLAGS=--xla_force_host_platform_device_count=8: a mixed
 #      short+long workload where the long bucket is pinned to a 4-chip
@@ -94,7 +110,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 DURATION="${SMOKE_DURATION_S:-30}"
-PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8}"
+PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8,9}"
 
 phase_on() {
     case ",${PHASES}," in
@@ -396,5 +412,91 @@ print(f"RECYCLE SMOKE OK: executor steps {sched['executor_steps']} < "
       f"{sched['recycle']['preemptions']} preemptions, "
       f"{sched.get('progress_updates', 0)} progressive updates, "
       f"p99 by class {sched.get('latency_by_class')}", file=sys.stderr)
+EOF
+fi
+
+# phase 9: feature-pipeline disaggregation — the identical raw
+# (AA-string) workload with synthetic featurize latency ~ fold time,
+# serialized featurize-in-submit baseline vs the FeaturePool pipeline;
+# the pipelined path must win folds/hour AND executor idle outright,
+# with zero duplicate featurize executions and a live feature cache
+if phase_on 9; then
+rm -f /tmp/serve_smoke_feat_traces.jsonl
+
+feature_phase() {  # $1 = report path, extra args follow
+    local out="$1"; shift
+    timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+        python tools/serve_loadtest.py \
+        --smoke \
+        --requests 32 \
+        --lengths 24,48 \
+        --buckets 32,64 \
+        --msa-depth 3 \
+        --max-batch 2 \
+        --concurrency 2 \
+        --num-recycles 0 \
+        --feature-latency-ms 250 \
+        --feature-dup-rate 0.5 \
+        "$@" > "$out"
+    cat "$out"
+}
+
+feature_phase /tmp/serve_smoke_feat_base.json \
+    --feature-pool 0 \
+    --metrics-path /tmp/serve_smoke_feat_base.jsonl
+feature_phase /tmp/serve_smoke_feat.json \
+    --feature-pool 4 \
+    --metrics-path /tmp/serve_smoke_feat.jsonl \
+    --trace-path /tmp/serve_smoke_feat_traces.jsonl \
+    --prom-path /tmp/serve_smoke_feat.prom
+
+timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/obs_report.py /tmp/serve_smoke_feat_traces.jsonl \
+    --check --prom /tmp/serve_smoke_feat.prom
+
+env -u PYTHONPATH python - <<'EOF'
+import json, sys
+base = json.load(open("/tmp/serve_smoke_feat_base.json"))
+pipe = json.load(open("/tmp/serve_smoke_feat.json"))
+problems = []
+if pipe["folds_per_hour"] <= base["folds_per_hour"]:
+    problems.append(f"pipelined folds/hour {pipe['folds_per_hour']} <= "
+                    f"serialized baseline {base['folds_per_hour']}")
+if pipe["executor_idle_fraction"] >= base["executor_idle_fraction"]:
+    problems.append(
+        f"pipelined executor idle {pipe['executor_idle_fraction']} >= "
+        f"baseline {base['executor_idle_fraction']}")
+feat = pipe.get("featurize") or {}
+if feat.get("hit_ratio", 0) <= 0:
+    problems.append("feature cache never hit under duplicate traffic")
+if feat.get("executions") != pipe["unique_raw_keys"]:
+    problems.append(f"{feat.get('executions')} featurize executions != "
+                    f"{pipe['unique_raw_keys']} unique raw keys")
+for rep in (base, pipe):
+    bad = rep["shed"] + rep["errors"] + rep["rejected"] + \
+        len(rep["failures"])
+    if bad or rep["served"] == 0:
+        problems.append(f"{bad} bad outcomes / {rep['served']} served "
+                        f"in {'pipe' if rep is pipe else 'base'} run")
+spans = {}
+for line in open("/tmp/serve_smoke_feat_traces.jsonl"):
+    try:
+        rec = json.loads(line)
+    except ValueError:
+        continue
+    for s in rec.get("spans", ()):
+        spans[s.get("name")] = spans.get(s.get("name"), 0) + 1
+if not spans.get("featurize"):
+    problems.append("no featurize spans in the pipelined traces")
+if problems:
+    print("FEATURE SMOKE FAIL: " + "; ".join(problems), file=sys.stderr)
+    sys.exit(1)
+print(f"FEATURE SMOKE OK: folds/hour {pipe['folds_per_hour']} > "
+      f"{base['folds_per_hour']}, executor idle "
+      f"{pipe['executor_idle_fraction']} < "
+      f"{base['executor_idle_fraction']}, feature hit_ratio "
+      f"{feat['hit_ratio']}, {feat['executions']} featurize execs == "
+      f"{pipe['unique_raw_keys']} unique keys, "
+      f"{spans['featurize']} featurize spans", file=sys.stderr)
 EOF
 fi
