@@ -549,7 +549,9 @@ def replica_main(config: dict) -> int:
         msa_depth=msa_depth,
         hbm_gb=float(config.get("mesh_hbm_gb", 16.0)),
         devices=mesh_devices,
-        carry_recyclables=recycle_policy is not None)
+        carry_recyclables=recycle_policy is not None,
+        continuous=bool(recycle_policy is not None
+                        and recycle_policy.continuous))
     scheduler = serve.Scheduler(
         executor, policy,
         serve.SchedulerConfig(

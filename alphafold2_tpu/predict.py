@@ -141,6 +141,38 @@ def fold_init(model, params, seq, msa=None, mask=None, msa_mask=None,
     return _step_state(coords, ret)
 
 
+def fold_init_rows(model, params, seq, row_mask, state: FoldStepState,
+                   msa=None, mask=None, msa_mask=None,
+                   **extra) -> FoldStepState:
+    """Row-masked init: the continuous-batching admission program
+    (ISSUE 11). Rows where `row_mask` is True are (re)initialized from
+    the CURRENT batch tensors — exactly `fold_init`'s embed+first pass,
+    recyclables=None — while rows where it is False pass the carried
+    `state` through untouched, so survivor rows keep stepping from
+    their own recycle depth while freed rows restart at iteration 0
+    with a newly admitted request's content.
+
+    The pass computes the init over the WHOLE batch (one fixed-shape
+    executable, no data-dependent shapes) and selects per row; rows are
+    independent through the model (regression-pinned by the repack
+    tests), so an admitted row's init is byte-identical to folding that
+    request alone at the same batch signature, and a survivor row's
+    carried state is byte-identical through the `where` pass-through.
+
+    row_mask: (b,) bool — True = initialize this row fresh.
+    state: the carried FoldStepState whose non-admitted rows survive.
+    """
+    fresh = fold_init(model, params, seq, msa=msa, mask=mask,
+                      msa_mask=msa_mask, **extra)
+
+    def sel(new, old):
+        m = jnp.reshape(row_mask, row_mask.shape
+                        + (1,) * (new.ndim - row_mask.ndim))
+        return jnp.where(m, new, old)
+
+    return jax.tree_util.tree_map(sel, fresh, state)
+
+
 def fold_step(model, params, seq, recyclables: Recyclables, msa=None,
               mask=None, msa_mask=None, **extra) -> FoldStepState:
     """One recycle iteration: the `lax.scan` body of fold() as its own

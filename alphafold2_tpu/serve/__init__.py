@@ -27,7 +27,11 @@ The serving stack, bottom-up:
              RecyclePolicy(converge_tol=...))` and the scheduler owns
              the recycle loop: early-exit converged folds, preempt
              between recycles for deadline traffic, stream per-recycle
-             progressive results (README "Iteration-level scheduling")
+             progressive results, and — with `continuous=True` —
+             refill freed rows mid-loop with pending requests via the
+             row-masked init program, so a hot bucket's slice never
+             idles a row (README "Iteration-level scheduling" /
+             "Continuous batching")
 - resilience: RetryPolicy/CircuitBreaker/Quarantine — pass
              `Scheduler(..., retry=RetryPolicy(...))` for transient-
              batch retry, poison isolation by bisection + quarantine,

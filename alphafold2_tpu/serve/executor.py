@@ -61,13 +61,16 @@ from alphafold2_tpu.parallel.mesh import make_mesh
 from alphafold2_tpu.parallel.sharding import (fold_input_shardings,
                                               shard_pytree_tp, use_mesh)
 from alphafold2_tpu.predict import (FoldResult, FoldStepState, fold,
-                                    fold_init, fold_step)
+                                    fold_init, fold_init_rows, fold_step)
 from alphafold2_tpu.serve.bucketing import msa_depth_of
 from alphafold2_tpu.serve.meshpolicy import MeshShape, factor_chips, \
     mesh_label
 
 # (bucket_len, batch_size, msa_depth, num_recycles, mesh_shape,
-#  model_tag, variant) — variant in ("fold", "init", "step")
+#  model_tag, variant) — variant in ("fold", "init", "step",
+#  "init_rows"); init_rows (ISSUE 11) is the row-masked admission
+#  program of the continuous batcher, warmed alongside the init+step
+#  pair so a mid-loop row admission never pays a serving-path compile
 ExecKey = Tuple[int, int, int, int, MeshShape, str, str]
 
 _SINGLE: MeshShape = (1, 1)
@@ -157,6 +160,14 @@ class FoldExecutor:
                                  mask=mask, msa_mask=msa_mask)
 
             return jax.jit(run_init)
+        if variant == "init_rows":
+            def run_init_rows(params, seq, mask, msa, msa_mask,
+                              row_mask, state) -> FoldStepState:
+                return fold_init_rows(self.model, params, seq, row_mask,
+                                      state, msa=msa, mask=mask,
+                                      msa_mask=msa_mask)
+
+            return jax.jit(run_init_rows)
         if variant != "step":
             raise ValueError(f"unknown executable variant {variant!r}")
 
@@ -343,18 +354,42 @@ class FoldExecutor:
         return self._run_stepmode("init", batch, (), trace, devices,
                                   mesh_shape, span="fold", attrs={})
 
+    def run_init_rows(self, batch: dict, state: FoldStepState,
+                      row_mask, trace=NULL_TRACE,
+                      devices: Optional[Sequence] = None,
+                      mesh_shape: Optional[MeshShape] = None
+                      ) -> FoldStepState:
+        """Row-masked admission init (continuous batching, ISSUE 11):
+        rows where `row_mask` is True restart at iteration 0 from the
+        batch tensors (which the scheduler just rewrote with newly
+        admitted requests), rows where it is False pass the carried
+        `state` through untouched — survivors keep stepping, nothing
+        recompiles mid-loop because this variant was warmed with the
+        init+step pair. Span: `admit` (the admission cost is its own
+        waterfall stage — it is neither a fold nor a recycle)."""
+        mask_arr = jnp.asarray(row_mask, bool)
+        return self._run_stepmode(
+            "init_rows", batch, (mask_arr, state), trace, devices,
+            mesh_shape, span="admit",
+            attrs={"rows": int(mask_arr.sum())})
+
     def run_step(self, batch: dict, state: FoldStepState,
                  recycle_index: int, trace=NULL_TRACE,
                  devices: Optional[Sequence] = None,
-                 mesh_shape: Optional[MeshShape] = None) -> FoldStepState:
+                 mesh_shape: Optional[MeshShape] = None,
+                 span_attrs: Optional[dict] = None) -> FoldStepState:
         """One recycle iteration: feeds `state.recyclables` (from
         run_init or a previous run_step on the same slice) through the
         step executable. Span: `recycle`, tagged with the iteration
-        index (and mesh label on a slice)."""
+        index (and mesh label on a slice). `span_attrs` merges extra
+        attributes into the recycle span (the continuous scheduler tags
+        per-step row occupancy for the obs_report occupancy line)."""
+        attrs = {"recycle": int(recycle_index)}
+        if span_attrs:
+            attrs.update(span_attrs)
         return self._run_stepmode(
             "step", batch, (state.recyclables,), trace, devices,
-            mesh_shape, span="recycle",
-            attrs={"recycle": int(recycle_index)})
+            mesh_shape, span="recycle", attrs=attrs)
 
     def _run_stepmode(self, variant: str, batch: dict, extra_args,
                       trace, devices, mesh_shape, span: str,
@@ -427,7 +462,8 @@ class FoldExecutor:
     def warmup(self, keys: Iterable,
                timer=None, devices: Optional[Sequence] = None,
                mesh_shape: Optional[MeshShape] = None,
-               step_mode: bool = False) -> int:
+               step_mode: bool = False,
+               continuous: bool = False) -> int:
         """Compile (and discard) each key's signature with a zero batch.
         Keys may be legacy 4-tuples (len, batch, msa_depth, recycles) or
         full ExecKeys; `devices`/`mesh_shape` warm the slice-bound
@@ -435,7 +471,10 @@ class FoldExecutor:
         scheduler warms per bucket with the bucket's own lease).
         `step_mode` warms the init+step executable PAIR instead of the
         opaque fold — what a scheduler driving the recycle loop
-        (recycle_policy set) will actually execute.
+        (recycle_policy set) will actually execute. `continuous`
+        (step_mode only) additionally warms the row-masked `init_rows`
+        admission program, so a continuous batcher's first mid-loop row
+        admission never triggers a mid-serving compile (ISSUE 11).
         Returns the number of fresh compiles. Optional `timer` is a
         profiling.StepTimer measuring each warmup (== compile+first-run)
         wall time."""
@@ -459,6 +498,13 @@ class FoldExecutor:
                 if step_mode:
                     state = self.run_init(batch, devices=devices,
                                           mesh_shape=mesh_shape)
+                    if continuous:
+                        # shape-only warm: the mask values never change
+                        # the compiled program, only which rows reinit
+                        mask0 = jnp.zeros((batch_size,), bool)
+                        state = self.run_init_rows(
+                            batch, state, mask0, devices=devices,
+                            mesh_shape=mesh_shape)
                     self.run_step(batch, state, 0, devices=devices,
                                   mesh_shape=mesh_shape)
                 else:

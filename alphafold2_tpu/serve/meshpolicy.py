@@ -140,13 +140,19 @@ class FoldMemoryModel:
     def fold_bytes(self, bucket_len: int, batch_size: int,
                    msa_depth: int, chips: int = 1,
                    shape: Optional[MeshShape] = None,
-                   carry_recyclables: bool = False) -> int:
+                   carry_recyclables: bool = False,
+                   continuous: bool = False) -> int:
         """Estimated peak per-device bytes for one fold batch. Pass the
         actual slice `shape` when known (admits() does) — the MSA track
         divides by its i factor only; a bare `chips` count prices the
         canonical squarest factorization. `carry_recyclables` adds the
         step-mode recycle carry (the scheduler passes it iff a
-        RecyclePolicy drives the loop)."""
+        RecyclePolicy drives the loop). `continuous` (implies step
+        mode) additionally prices the row-admission seam (ISSUE 11):
+        `fold_init_rows` holds the full-batch fresh init output live
+        alongside the old carried state while the per-row select
+        builds the merged state, one extra single-buffered copy of the
+        carry on top of `recycle_carry_live`'s double-buffering."""
         L, B, M = int(bucket_len), int(batch_size), int(msa_depth)
         if shape is not None:
             i = max(int(shape[0]), 1)
@@ -163,8 +169,13 @@ class FoldMemoryModel:
             * self.msa_live
         dist = B * L * L * self.distogram_buckets * 4
         total = self.param_bytes + dist + pair / chips + msa / i
-        if carry_recyclables:
+        if carry_recyclables or continuous:
             total += self.carry_bytes(L, B, chips=chips)
+        if continuous:
+            # the admission seam's extra live copy (one, not the
+            # carry's recycle_carry_live-many)
+            total += self.carry_bytes(L, B, chips=chips) \
+                / max(self.recycle_carry_live, 1.0)
         return int(total)
 
     def carry_bytes(self, bucket_len: int, batch_size: int,
@@ -185,10 +196,12 @@ class FoldMemoryModel:
     def fits(self, bucket_len: int, batch_size: int, msa_depth: int,
              chips: int = 1,
              shape: Optional[MeshShape] = None,
-             carry_recyclables: bool = False) -> bool:
+             carry_recyclables: bool = False,
+             continuous: bool = False) -> bool:
         return self.fold_bytes(
             bucket_len, batch_size, msa_depth, chips, shape,
-            carry_recyclables=carry_recyclables) \
+            carry_recyclables=carry_recyclables,
+            continuous=continuous) \
             <= self.hbm_bytes_per_device
 
 
@@ -362,6 +375,7 @@ class MeshPolicy:
                    devices: Optional[Sequence[object]] = None,
                    max_chips: Optional[int] = None,
                    carry_recyclables: bool = False,
+                   continuous: bool = False,
                    **memory_overrides) -> "MeshPolicy":
         """Derive the policy analytically: for each bucket edge, the
         smallest power-of-two slice whose estimated per-device footprint
@@ -374,7 +388,9 @@ class MeshPolicy:
         prices the carried Recyclables exactly like the admission
         guard will, so a bucket whose opaque fold just fits an n-chip
         slice is assigned the bigger slice it actually needs instead
-        of being auto-sized into a guaranteed "too_large"."""
+        of being auto-sized into a guaranteed "too_large".
+        `continuous` does the same for the continuous batcher's
+        row-admission seam (ISSUE 11)."""
         if devices is None:
             import jax
             devices = jax.devices()
@@ -386,7 +402,8 @@ class MeshPolicy:
         for edge in edges:
             n = 1
             while not memory.fits(edge, max_batch, msa_depth, n,
-                                  carry_recyclables=carry_recyclables) \
+                                  carry_recyclables=carry_recyclables,
+                                  continuous=continuous) \
                     and n * 2 <= cap:
                 n *= 2
             shapes[int(edge)] = n
@@ -398,6 +415,7 @@ class MeshPolicy:
               hbm_gb: float = 16.0,
               devices: Optional[Sequence[object]] = None,
               carry_recyclables: bool = False,
+              continuous: bool = False,
               **memory_overrides) -> Optional["MeshPolicy"]:
         """The ONE parser for every `--mesh-policy` surface (the
         loadtest CLI, `fleet.ProcFleet` replica configs,
@@ -419,6 +437,7 @@ class MeshPolicy:
                                   msa_depth=msa_depth, hbm_gb=hbm_gb,
                                   devices=devices,
                                   carry_recyclables=carry_recyclables,
+                                  continuous=continuous,
                                   **memory_overrides)
         shapes = {}
         for kv in spec.split(","):
@@ -438,19 +457,25 @@ class MeshPolicy:
         return chips_of(self.shape_for(bucket_len))
 
     def admits(self, bucket_len: int, batch_size: int, msa_depth: int,
-               carry_recyclables: bool = False) -> bool:
+               carry_recyclables: bool = False,
+               continuous: bool = False) -> bool:
         """False when the bucket's configured slice — already the
         largest one the policy was willing/able to assign — cannot hold
         the batch's analytic footprint. The scheduler maps False to
         status "too_large" at submit, and passes `carry_recyclables`
         iff a RecyclePolicy makes it run the step loop (whose carried
         Recyclables are extra live bytes the opaque fold never
-        double-buffers)."""
+        double-buffers) and `continuous` iff that policy also admits
+        rows mid-loop (the row-masked init's select seam holds one
+        more live copy of the carry — the guard must refuse a bucket
+        that fits the plain step loop but would OOM on its first
+        admission)."""
         if self.memory is None:
             return True
         return self.memory.fits(bucket_len, batch_size, msa_depth,
                                 shape=self.shape_for(bucket_len),
-                                carry_recyclables=carry_recyclables)
+                                carry_recyclables=carry_recyclables,
+                                continuous=continuous)
 
     def allocator(self) -> DeviceSliceAllocator:
         return DeviceSliceAllocator(self.devices)

@@ -30,7 +30,13 @@ early exit fires), and between steps the scheduler can:
 - STREAM progressive results: every step publishes a `FoldProgress`
   (coords + confidence + recycle index) to the caller's `FoldTicket`,
   and the fleet front door exposes the latest one on the existing
-  long-poll (`GET /v1/result/<id>?progress=1` -> 206 + X-Recycle).
+  long-poll (`GET /v1/result/<id>?progress=1` -> 206 + X-Recycle);
+- ADMIT new work into freed rows (`continuous=True`, ISSUE 11): a row
+  freed by early exit (or never filled at batch formation) is refilled
+  mid-loop with a pending same-bucket request via a row-masked init
+  program — the vLLM/Orca iteration-level pattern with recycles as our
+  decode tokens; a saturated bucket's slice never idles a row
+  (`serve_row_admissions_total`, `serve_rows_occupied_fraction`).
 
 `converge_tol=0.0` (the default) disables early exit — every element
 runs the full `num_recycles`, and because the step body IS the scan
@@ -73,12 +79,31 @@ class RecyclePolicy:
     stream: publish per-recycle FoldProgress updates (coords +
         confidence) to each element's FoldTicket. Costs one host copy
         of the element's rows per step; off by default.
+    continuous: continuous batching (ISSUE 11) — when early exit (or
+        an under-filled batch) leaves rows free mid-loop, ADMIT new
+        same-bucket pending requests into those rows between recycles
+        via the row-masked init program (`predict.fold_init_rows` /
+        `FoldExecutor.run_init_rows`) instead of padding until the
+        batch's last survivor finishes: survivor rows keep stepping at
+        their own recycle depth, each row carries its own iteration
+        index, and a hot bucket's slice never idles a row. Admission
+        pulls from the pending queue in deadline/priority order
+        through the existing cache -> coalesce -> HBM-admission front
+        (a store hit never burns a row; an in-flight duplicate parks
+        as a coalescing follower), and it composes with preemption:
+        urgent same-bucket folds claim freed rows first, without
+        needing a batch gap. Off by default; continuous=False is
+        byte-for-byte the PR-9/10 step-loop behavior (scrubbed
+        serve_stats identity regression-pinned). Row-admitted results
+        are row-independent through the model, so `continuous` never
+        changes what is computed and does not split cache keys.
     """
 
     converge_tol: float = 0.0
     min_recycles: int = 0
     preempt: bool = True
     stream: bool = False
+    continuous: bool = False
 
     def __post_init__(self):
         if self.converge_tol < 0:
@@ -111,7 +136,8 @@ class RecyclePolicy:
         return {"converge_tol": self.converge_tol,
                 "min_recycles": self.min_recycles,
                 "preempt": self.preempt,
-                "stream": self.stream}
+                "stream": self.stream,
+                "continuous": self.continuous}
 
 
 def element_deltas(prev_coords: np.ndarray, prev_conf: np.ndarray,

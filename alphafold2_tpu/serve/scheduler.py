@@ -386,6 +386,14 @@ class Scheduler:
         #   exceed per-device HBM (memory-aware preemption admission)
         self._n_retired_early = 0       # elements resolved before the
         self._n_parked_admits = 0       # last configured recycle
+        # continuous batching (ISSUE 11): row-level occupancy ledger.
+        # live/total accumulate per executed step; their ratio is the
+        # rows-occupied fraction the smoke gates on; dead steps are the
+        # padded row-steps continuous admission exists to eliminate
+        self._n_row_admissions = 0
+        self._n_rows_dead_steps = 0
+        self._row_steps_live = 0
+        self._row_steps_total = 0
         # "a preemptor never preempts": per-thread reentrancy guard for
         # the between-recycles preemption window
         self._preempting = threading.local()
@@ -406,13 +414,28 @@ class Scheduler:
                 "leased preemption yields refused because the urgent "
                 "batch plus the suspended loop's HBM-resident carry "
                 "would exceed the per-device budget")
+            self._c_row_admissions = reg.counter(
+                "serve_row_admissions_total",
+                "pending requests admitted into freed batch rows "
+                "mid-recycle by the continuous batcher")
+            self._c_rows_dead_steps = reg.counter(
+                "serve_rows_dead_steps_total",
+                "row-steps executed on dead (unoccupied) batch rows — "
+                "the padding waste continuous admission eliminates")
+            self._g_rows_occupied = reg.gauge(
+                "serve_rows_occupied_fraction",
+                "live rows / batch rows of the step executed last, "
+                "sampled per recycle step")
             # step mode needs TWO executables per (bucket, slice) —
-            # init + step; grow the LRU so warmup's pair is not
-            # self-evicting (the mesh block below multiplies its own
-            # sizing the same way)
+            # init + step (THREE with continuous batching: + the
+            # row-masked init_rows admission program); grow the LRU so
+            # warmup's set is not self-evicting (the mesh block below
+            # multiplies its own sizing the same way)
+            per_bucket = 3 if recycle_policy.continuous else 2
             if self._step_capable and hasattr(executor, "max_entries"):
-                executor.max_entries = max(executor.max_entries,
-                                           2 * len(self.buckets.edges))
+                executor.max_entries = max(
+                    executor.max_entries,
+                    per_bucket * len(self.buckets.edges))
         if self.config.parked_bytes_budget > 0 or cache is not None:
             self._c_parked_admits = reg.counter(
                 "serve_parked_admits_total",
@@ -449,7 +472,9 @@ class Scheduler:
                         mesh_policy.shape_for(edge)))
                     for edge in self.buckets.edges)
                 if recycle_policy is not None and self._step_capable:
-                    needed *= 2          # init + step pair per slice
+                    # init + step pair per slice (+ init_rows when the
+                    # continuous batcher admits rows mid-loop)
+                    needed *= 3 if recycle_policy.continuous else 2
                 executor.max_entries = max(executor.max_entries, needed)
             self._c_mesh_folds = reg.counter(
                 "serve_mesh_folds_total",
@@ -619,26 +644,39 @@ class Scheduler:
         keys = [(edge, self.config.max_batch_size, msa_depth,
                  self.config.num_recycles) for edge in self.buckets.edges]
         # with a recycle policy the serving path runs the init+step
-        # executable pair, never the opaque fold — warm what will run
+        # executable pair (plus the row-masked init_rows admission
+        # program when continuous), never the opaque fold — warm what
+        # will run so a mid-loop row admission never compiles mid-serve
         step_mode = self._use_step_loop()
+        continuous = self._use_continuous()
         if self._allocator is None:
-            return self.executor.warmup(keys, step_mode=step_mode)
+            return self.executor.warmup(keys, step_mode=step_mode,
+                                        continuous=continuous)
         fresh = 0
         for key in keys:
             if not self.mesh_policy.admits(
                     key[0], key[1], key[2],
-                    carry_recyclables=step_mode):
+                    carry_recyclables=step_mode,
+                    continuous=continuous):
                 continue     # the guard rejects this bucket at submit;
                 #              compiling it would be the OOM we prevent
             shape = self.mesh_policy.shape_for(key[0])
             for devices in self._allocator.slices(shape):
                 fresh += self.executor.warmup(
                     [key], devices=devices, mesh_shape=shape,
-                    step_mode=step_mode)
+                    step_mode=step_mode, continuous=continuous)
         return fresh
 
     def _use_step_loop(self) -> bool:
         return self.recycle_policy is not None and self._step_capable
+
+    def _use_continuous(self) -> bool:
+        """True when the step loop will ADMIT rows mid-recycle
+        (continuous batching, ISSUE 11): a step-capable executor that
+        also speaks the row-masked init variant, under a policy that
+        asked for it."""
+        return self._use_step_loop() and self.recycle_policy.continuous \
+            and hasattr(self.executor, "run_init_rows")
 
     # -- submission ------------------------------------------------------
 
@@ -688,7 +726,8 @@ class Scheduler:
                     else int(request.msa.shape[0])
             if not self.mesh_policy.admits(
                     bucket_len, self.config.max_batch_size, guard_msa,
-                    carry_recyclables=self._use_step_loop()):
+                    carry_recyclables=self._use_step_loop(),
+                    continuous=self._use_continuous()):
                 self._raise_unless_running(entry)
                 if not self._serve_too_large_from_cache(entry):
                     self._too_large_shed(entry)
@@ -1314,6 +1353,7 @@ class Scheduler:
                                  inflight_batches=inflight,
                                  folds=folds)
         if self.recycle_policy is not None:
+            row_steps = self._row_steps_total
             stats["recycle"] = dict(
                 self.recycle_policy.snapshot(),
                 step_mode=self._use_step_loop(),
@@ -1321,7 +1361,16 @@ class Scheduler:
                 recycles_skipped=self._n_recycles_skipped,
                 preemptions=self._n_preemptions,
                 preempt_hbm_refusals=self._n_preempt_hbm_refusals,
-                retired_early=self._n_retired_early)
+                retired_early=self._n_retired_early,
+                # row-level occupancy over every executed step: the
+                # number continuous batching exists to drive to 1.0
+                # (identical keys with continuous off, so the loadtest
+                # baseline comparison reads the same stat)
+                row_admissions=self._n_row_admissions,
+                rows_dead_steps=self._n_rows_dead_steps,
+                rows_occupied_fraction=(
+                    self._row_steps_live / row_steps if row_steps
+                    else 0.0))
         if self.feature_pool is not None:
             stats["featurize"] = self.feature_pool.snapshot()
         with self._cond:
@@ -1436,14 +1485,18 @@ class Scheduler:
     def _shed_expired(self):
         now = time.monotonic()
         shed: List[_Entry] = []
-        for bucket_len, entries in self._pending.items():
-            keep = []
-            for e in entries:
-                if e.deadline is not None and now > e.deadline:
-                    shed.append(e)
-                else:
-                    keep.append(e)
-            self._pending[bucket_len] = keep
+        # under _cond: continuous row admission takes from _pending on
+        # dispatch-pool threads (ISSUE 11), so every _pending mutation
+        # is lock-guarded now (the Condition's RLock nests fine)
+        with self._cond:
+            for bucket_len, entries in self._pending.items():
+                keep = []
+                for e in entries:
+                    if e.deadline is not None and now > e.deadline:
+                        shed.append(e)
+                    else:
+                        keep.append(e)
+                self._pending[bucket_len] = keep
         self._resolve_removed(shed)
         for e in shed:
             self.metrics.record_shed()
@@ -1495,25 +1548,31 @@ class Scheduler:
                 and not self._breaker.allow_execute():
             return None
         best = None                      # (oldest, bucket_len, take)
-        for bucket_len, entries in self._pending.items():
-            if not entries:
-                continue
-            # mesh: a bucket whose slice shape has no free devices is
-            # not ready — forming its batch would just park it; other
-            # buckets' slices may be free right now
-            if self._allocator is not None and not \
-                    self._allocator.can_allocate(
-                        self.mesh_policy.shape_for(bucket_len)):
-                continue
-            cand = self._bucket_candidate(entries, stopping, now)
-            if cand is not None and (best is None or cand[0] < best[0]):
-                best = (cand[0], bucket_len, cand[1])
-        if best is None:
-            return None
-        _, bucket_len, take = best
-        taken = {id(e) for e in take}
-        self._pending[bucket_len] = [e for e in self._pending[bucket_len]
-                                     if id(e) not in taken]
+        # under _cond: continuous row admission (pool threads) also
+        # takes from _pending, so candidate selection + removal must be
+        # one atomic step against it
+        with self._cond:
+            for bucket_len, entries in self._pending.items():
+                if not entries:
+                    continue
+                # mesh: a bucket whose slice shape has no free devices
+                # is not ready — forming its batch would just park it;
+                # other buckets' slices may be free right now
+                if self._allocator is not None and not \
+                        self._allocator.can_allocate(
+                            self.mesh_policy.shape_for(bucket_len)):
+                    continue
+                cand = self._bucket_candidate(entries, stopping, now)
+                if cand is not None and (best is None
+                                         or cand[0] < best[0]):
+                    best = (cand[0], bucket_len, cand[1])
+            if best is None:
+                return None
+            _, bucket_len, take = best
+            taken = {id(e) for e in take}
+            self._pending[bucket_len] = [
+                e for e in self._pending[bucket_len]
+                if id(e) not in taken]
         if self._breaker is not None:
             self._breaker.begin_probe()  # no-op unless half-open
         self._resolve_removed(take)
@@ -1755,9 +1814,25 @@ class Scheduler:
         results stream to tickets.
         With converge_tol=0 every element runs all `num_recycles` steps
         and — because the step program IS the scan body — the served
-        numerics are identical to the opaque `lax.scan` path."""
+        numerics are identical to the opaque `lax.scan` path.
+
+        CONTINUOUS BATCHING (`RecyclePolicy(continuous=True)`,
+        ISSUE 11): each position carries its own recycle index (`ages`),
+        retirement is always in place (the position->row map frees
+        physical rows instead of re-packing), and between steps freed
+        rows are REFILLED with pending same-bucket requests via the
+        row-masked init program (`_admit_rows` ->
+        `FoldExecutor.run_init_rows`): survivors keep stepping from
+        their own depth while admitted rows restart at iteration 0, so
+        a saturated bucket's slice never idles a row. Convergence,
+        min_recycles, full-depth retirement, progressive streaming and
+        `FoldResponse.recycles` are all evaluated against each row's
+        OWN age — an admitted row is never compared against a
+        pre-admission prev-state (the post-admission fetch refreshes
+        the prev snapshot for exactly this reason)."""
         cfg = self.config
         policy = self.recycle_policy
+        continuous = self._use_continuous()
         t0 = time.monotonic()
         if self.tracer.enabled:
             for e in entries:
@@ -1769,16 +1844,22 @@ class Scheduler:
         mesh_shape = lease.shape if lease is not None else None
         num_recycles = cfg.num_recycles
         active = list(entries)         # still folding, position-ordered
+        all_members = list(entries)    # + row admissions (ISSUE 11):
+        #   the exception handler and batch accounting must cover every
+        #   entry that ever rode this loop, not just the founders
         rows = list(range(len(entries)))   # position -> batch row
+        ages = [0] * len(entries)          # position -> OWN recycle idx
         # physical repacking gathers the carried state on the batch
         # axis; on a MULTI-chip lease that is an eager op over a
         # mesh-sharded O(L^2) carry outside the step executable's
         # sharding discipline — retire rows logically there instead
         # (the rows map above) and compact only where the carry lives
-        # on a single device
-        can_repack = devices is None or len(devices) == 1
+        # on a single device. The continuous batcher never repacks:
+        # freed physical rows are exactly where admissions land.
+        can_repack = (devices is None or len(devices) == 1) \
+            and not continuous
         any_nonfinite = False
-        r = 0
+        r = 0                          # loop-level step count
         # entries already left the queue: any unresolved exception here
         # would orphan tickets — same guard discipline as _execute
         try:
@@ -1803,8 +1884,12 @@ class Scheduler:
                 coords_np = np.asarray(state.coords)
                 conf_np = np.asarray(state.confidence)
                 self._stream_progress(active, rows, coords_np, conf_np,
-                                      0)
-            while active and r < num_recycles:
+                                      ages)
+            # every surviving row has age < num_recycles (full-depth
+            # rows retire inside the loop), so the condition only
+            # gates entry: num_recycles == 0 skips straight to the
+            # final retirement below, exactly like the opaque path
+            while active and min(ages) < num_recycles:
                 if policy.preempt:
                     lease = self._maybe_preempt(active, lease, r,
                                                 bucket_len)
@@ -1812,76 +1897,157 @@ class Scheduler:
                 prev_coords, prev_conf = coords_np, conf_np
                 step_trace = (MultiTrace([e.trace for e in active])
                               if self.tracer.enabled else NULL_TRACE)
+                step_kw = dict(trace=step_trace, devices=devices,
+                               mesh_shape=mesh_shape)
+                if continuous:
+                    # per-step occupancy rides the recycle span so the
+                    # obs_report occupancy line can read it back (the
+                    # kwarg only exists on row-admission-capable
+                    # executors, which _use_continuous vetted)
+                    step_kw["span_attrs"] = {
+                        "rows_live": len(active),
+                        "rows_total": cfg.max_batch_size}
                 state = self._run_step_guarded(
-                    lambda st=state, rr=r, tr=step_trace:
-                    self.executor.run_step(
-                        batch, st, rr, trace=tr, devices=devices,
-                        mesh_shape=mesh_shape))
+                    lambda st=state, rr=r, kw=step_kw:
+                    self.executor.run_step(batch, st, rr, **kw))
+                ages = [a + 1 for a in ages]
                 self._n_recycles_exec += 1
                 self._c_recycles.inc()
+                # row-occupancy ledger, sampled per executed step: a
+                # step costs the same whether a row is live or dead,
+                # which is exactly the waste continuous admission
+                # exists to eliminate
+                live = len(active)
+                self._row_steps_live += live
+                self._row_steps_total += cfg.max_batch_size
+                dead = cfg.max_batch_size - live
+                if dead > 0:
+                    self._n_rows_dead_steps += dead
+                    self._c_rows_dead_steps.inc(dead)
+                self._g_rows_occupied.set(live / cfg.max_batch_size)
                 if fetch_steps:
                     coords_np = np.asarray(state.coords)
                     conf_np = np.asarray(state.confidence)
                     self._stream_progress(active, rows, coords_np,
-                                          conf_np, r)
-                if r >= num_recycles:
-                    break          # final state; everyone retires below
-                if policy.converge_tol <= 0 or r < policy.min_recycles:
-                    continue
-                deltas = element_deltas(
-                    prev_coords, prev_conf, coords_np, conf_np,
-                    [e.request.length for e in active], rows=rows)
-                retired = [i for i, d in enumerate(deltas)
-                           if d <= policy.converge_tol]
-                if not retired:
-                    continue
+                                          conf_np, ages)
+                else:
+                    # fetchless policy: a snapshot fetched for an
+                    # earlier retirement is one step stale NOW — the
+                    # ripe pass below must re-fetch, never serve a
+                    # surviving row its previous iteration's state
+                    coords_np = conf_np = None
+                # retirement against each row's OWN age: full-depth
+                # rows are final (their state IS the fold result);
+                # converged rows past their min_recycles floor retire
+                # early. A full-depth row never counts as an early
+                # retirement even if its last delta also converged.
+                ripe = {i for i in range(len(active))
+                        if ages[i] >= num_recycles}
+                conv: List[int] = []
+                if policy.converge_tol > 0 and prev_coords is not None:
+                    elig = [i for i in range(len(active))
+                            if i not in ripe
+                            and ages[i] >= policy.min_recycles]
+                    if elig:
+                        deltas = element_deltas(
+                            prev_coords, prev_conf, coords_np, conf_np,
+                            [active[i].request.length for i in elig],
+                            rows=[rows[i] for i in elig])
+                        for i, d in zip(elig, deltas):
+                            if d <= policy.converge_tol:
+                                conv.append(i)
+                                active[i].trace.event(
+                                    "recycle_converged",
+                                    recycle=ages[i], delta=d)
+                retired = sorted(ripe | set(conv))
+                if retired:
+                    if coords_np is None:
+                        # fetchless policy retiring full-depth rows:
+                        # one fetch, exactly like the opaque path's end
+                        coords_np = np.asarray(state.coords)
+                        conf_np = np.asarray(state.confidence)
+                    now = time.monotonic()
+                    for i in retired:
+                        e = active[i]
+                        if i not in ripe:
+                            self._n_retired_early += 1
+                        if not self._retire_entry(e, bucket_len,
+                                                  coords_np[rows[i]],
+                                                  conf_np[rows[i]],
+                                                  ages[i], now):
+                            any_nonfinite = True
+                    gone = set(retired)
+                    keep = [i for i in range(len(active))
+                            if i not in gone]
+                    active = [active[i] for i in keep]
+                    rows = [rows[i] for i in keep]
+                    ages = [ages[i] for i in keep]
+                    if not active:
+                        if r < num_recycles:
+                            # fully-converged batch: remaining steps
+                            # are skipped outright
+                            skipped = steps_saved(num_recycles, r)
+                            self._n_recycles_skipped += skipped
+                            self._c_recycles_skipped.inc(skipped)
+                        break
+                    if can_repack:
+                        # re-pack the survivor batch: survivors become
+                        # a dense row prefix of both the carried state
+                        # and the batch tensors (and the executor's
+                        # placement cache is dropped with the old
+                        # batch dict)
+                        state, idx_list = repack_rows(
+                            state, rows, cfg.max_batch_size)
+                        batch = repack_batch(batch, idx_list)
+                        sel = np.asarray(rows)
+                        coords_np, conf_np = coords_np[sel], \
+                            conf_np[sel]
+                        rows = list(range(len(active)))
+                    # (not can_repack: rows retire in place — the
+                    # position -> row map already shrank above)
+                if continuous and active:
+                    if lease is None:
+                        # inline path: this IS the worker thread, and a
+                        # continuously refilled loop would keep it here
+                        # indefinitely — drain fresh submissions and
+                        # run the worker's shed sweep from the gap so
+                        # expired tickets (which admission skips by
+                        # design) never hang behind a long-lived loop
+                        with self._cond:
+                            while self._incoming:
+                                e_in = self._incoming.popleft()
+                                self._pending.setdefault(
+                                    e_in.bucket_len, []).append(e_in)
+                        self._shed_expired()
+                    batch, state, admitted = self._admit_rows(
+                        bucket_len, batch, state, active, rows, ages,
+                        all_members, devices, mesh_shape,
+                        inline=lease is None, gap=r)
+                    if admitted and fetch_steps:
+                        # refresh the prev snapshot NOW: an admitted
+                        # row's first delta must compare its own
+                        # post-init state, never the pre-admission
+                        # occupant of the same physical row
+                        coords_np = np.asarray(state.coords)
+                        conf_np = np.asarray(state.confidence)
+                        self._stream_progress(
+                            admitted, rows[-len(admitted):],
+                            coords_np, conf_np, [0] * len(admitted))
+            if active:
+                # only reachable at num_recycles == 0: the init state
+                # is the final state for every founder row
+                if coords_np is None:
+                    coords_np = np.asarray(state.coords)
+                    conf_np = np.asarray(state.confidence)
                 now = time.monotonic()
-                for i in retired:
-                    e = active[i]
-                    self._n_retired_early += 1
-                    e.trace.event("recycle_converged", recycle=r,
-                                  delta=deltas[i])
+                for i, e in enumerate(active):
                     if not self._retire_entry(e, bucket_len,
                                               coords_np[rows[i]],
                                               conf_np[rows[i]],
-                                              r, now):
+                                              ages[i], now):
                         any_nonfinite = True
-                survivors = [i for i in range(len(active))
-                             if i not in set(retired)]
-                if not survivors:
-                    skipped = steps_saved(num_recycles, r)
-                    self._n_recycles_skipped += skipped
-                    self._c_recycles_skipped.inc(skipped)
-                    active = []
-                    break
-                if can_repack:
-                    # re-pack the survivor batch: survivors become a
-                    # dense row prefix of both the carried state and
-                    # the batch tensors (and the executor's placement
-                    # cache is dropped with the old batch dict)
-                    keep = [rows[i] for i in survivors]
-                    state, idx_list = repack_rows(state, keep,
-                                                  cfg.max_batch_size)
-                    batch = repack_batch(batch, idx_list)
-                    sel = np.asarray(keep)
-                    coords_np, conf_np = coords_np[sel], conf_np[sel]
-                    rows = list(range(len(survivors)))
-                else:
-                    # multi-chip carry: retire rows in place, only the
-                    # position -> row map shrinks
-                    rows = [rows[i] for i in survivors]
-                active = [active[i] for i in survivors]
-            if active and coords_np is None:
-                coords_np = np.asarray(state.coords)
-                conf_np = np.asarray(state.confidence)
-            now = time.monotonic()
-            for i, e in enumerate(active):
-                if not self._retire_entry(e, bucket_len,
-                                          coords_np[rows[i]],
-                                          conf_np[rows[i]], r, now):
-                    any_nonfinite = True
         except Exception as exc:  # resolve/retry, never kill the caller
-            survivors = [e for e in entries if not e.ticket.done()]
+            survivors = [e for e in all_members if not e.ticket.done()]
             if not survivors:
                 return            # everyone already retired
             if self._handle_batch_failure(bucket_len, survivors, exc,
@@ -1906,9 +2072,15 @@ class Scheduler:
                 self._mesh_batches[lease.label] = \
                     self._mesh_batches.get(lease.label, 0) + 1
                 self._mesh_served[lease.label] = \
-                    self._mesh_served.get(lease.label, 0) + len(entries)
+                    self._mesh_served.get(lease.label, 0) \
+                    + len(all_members)
             depth = self._depth
         try:
+            # founders only: padding_waste is a batch-FORMATION metric
+            # (real tokens vs the padded grid minted at assemble time);
+            # row admissions reuse that grid over time and are
+            # accounted by the rows-occupied ledger instead — counting
+            # their tokens here would drive waste negative
             self.metrics.record_batch(
                 bucket_len, cfg.max_batch_size, len(entries),
                 sum(e.request.length for e in entries), waste,
@@ -1917,6 +2089,262 @@ class Scheduler:
                              else self.cache.snapshot()))
         except Exception:
             pass              # observability never takes down serving
+
+    # -- continuous batching: mid-recycle row admission (ISSUE 11) ------
+
+    def _take_admission_candidate(self, bucket_len: int,
+                                  batch_msa_depth: int
+                                  ) -> Optional[_Entry]:
+        """Thread-safe pop of the best same-bucket admission candidate
+        from the pending queue, in deadline/priority order (tightest
+        live deadline first — urgent folds claim freed rows without
+        needing a preemption gap — then priority, then FIFO). Runs on
+        dispatch-pool threads, which is why every `_pending` touch in
+        this scheduler now holds `_cond`. Excluded: bisection isolation
+        groups (cohort discipline wins), backoff-gated retries, expired
+        deadlines (the worker's sweep must shed them — admission must
+        never ride a dead request to an after-deadline "ok"), and —
+        under an unpinned msa_depth config — requests whose own MSA is
+        deeper than the running batch's compiled depth (truncating it
+        here would serve different content than its own batch would
+        have)."""
+        now = time.monotonic()
+        with self._cond:
+            if not self._running and not self._drain:
+                return None    # stop(drain=False) cancels the queue;
+                #                admission must not race entries away
+            while self._incoming:
+                entry = self._incoming.popleft()
+                self._pending.setdefault(entry.bucket_len,
+                                         []).append(entry)
+            pend = self._pending.get(bucket_len)
+            if not pend:
+                return None
+            best = None
+            for e in pend:
+                if e.group is not None or e.not_before > now:
+                    continue
+                if e.deadline is not None and e.deadline <= now:
+                    continue
+                if self.config.msa_depth is None \
+                        and e.request.msa is not None \
+                        and int(e.request.msa.shape[0]) \
+                        > batch_msa_depth:
+                    continue
+                k = (e.deadline is None, e.deadline or 0.0,
+                     -e.request.priority, e.enqueued_at)
+                if best is None or k < best[0]:
+                    best = (k, e)
+            if best is None:
+                return None
+            entry = best[1]
+            pend.remove(entry)
+        self._resolve_removed([entry])
+        return entry
+
+    def _readmit_pending(self, bucket_len: int, entry: _Entry):
+        """Return a taken-but-not-admitted candidate to the pending
+        queue (HBM refusal): deadline clock untouched, normal batch
+        formation serves it."""
+        with self._cond:
+            self._pending.setdefault(bucket_len, []).append(entry)
+            self._depth += 1
+            self._cond.notify_all()
+
+    def _admitted_batch(self, batch: dict, bucket_len: int,
+                        placements: List[Tuple[int, _Entry]]) -> dict:
+        """Fresh batch dict with each admitted request written into its
+        freed physical row — the same per-row padding/truncation
+        semantics as bucketing.assemble (zero-pad, mask real residues,
+        keep the first `depth` MSA rows). A fresh dict holding only the
+        canonical input keys (+ the host mirror) on purpose: the
+        executor's cached device placement is row-stale the moment a
+        row's content changes (same discipline as repack_batch).
+
+        The "_host" key carries the numpy mirror of the batch tensors
+        across admission rounds: the FIRST admission of a loop pays one
+        device->host fetch, every later one only rewrites the admitted
+        rows and re-uploads — no per-gap device sync inside the hot
+        step loop. Device arrays are built with `jnp.array` (copy
+        semantics), so mutating the mirror next round can never alias
+        an array the executor still holds."""
+        import jax.numpy as jnp
+
+        host = batch.get("_host")
+        if host is None:
+            host = {k: (None if batch[k] is None else np.array(batch[k]))
+                    for k in ("seq", "mask", "msa", "msa_mask")}
+        seq, mask = host["seq"], host["mask"]
+        msa, msa_mask = host["msa"], host["msa_mask"]
+        for row, e in placements:
+            req = e.request
+            n = req.length
+            seq[row] = 0
+            seq[row, :n] = req.seq
+            mask[row] = False
+            mask[row, :n] = True
+            if msa is not None:
+                msa[row] = 0
+                msa_mask[row] = False
+                if req.msa is not None:
+                    m = min(req.msa.shape[0], msa.shape[1])
+                    msa[row, :m, :n] = req.msa[:m]
+                    msa_mask[row, :m, :n] = True
+        return {"seq": jnp.array(seq), "mask": jnp.array(mask),
+                "msa": None if msa is None else jnp.array(msa),
+                "msa_mask": (None if msa_mask is None
+                             else jnp.array(msa_mask)),
+                "_host": host}
+
+    def _admit_rows(self, bucket_len: int, batch: dict, state,
+                    active: List[_Entry], rows: List[int],
+                    ages: List[int], all_members: List[_Entry],
+                    devices, mesh_shape, inline: bool, gap: int):
+        """Refill free batch rows mid-recycle (continuous batching,
+        ISSUE 11). Candidates come off the pending queue in deadline/
+        priority order and pass the same front submit() runs: a result-
+        store hit resolves immediately (source "cache") WITHOUT burning
+        a row, an in-flight duplicate parks as a coalescing follower
+        (never double-folds — its leader's fold populates the store
+        under the policy's own `key_extras` keying and settles it), and
+        the HBM admission guard prices the request before it may join
+        the resident batch. Surviving candidates are written into freed
+        physical rows (the position->row map — no physical repack, so
+        the same code path serves single-chip and mesh-sharded
+        carries) and initialized by the row-masked `init_rows`
+        executable under an `admit` span while survivor rows pass
+        through untouched.
+
+        `inline` marks the classic no-lease path, where this loop runs
+        ON the scheduler worker thread: sustained same-bucket traffic
+        could then refill the loop forever while every other bucket
+        starves behind it, so inline admission additionally yields —
+        stops admitting, letting the loop drain within num_recycles
+        steps — as soon as any OTHER bucket holds work past its
+        max_wait window. Mesh-leased loops run on pool threads and
+        leave the worker free, so they never need the gate.
+
+        Mutates active/rows/ages/all_members in place for the admitted
+        entries; returns (batch, state, admitted)."""
+        cfg = self.config
+        occupied = set(rows)
+        free = [k for k in range(cfg.max_batch_size)
+                if k not in occupied]
+        if not free:
+            return batch, state, []
+        # an open circuit breaker pauses batch formation; admission
+        # must honor the same pause (mirrors _maybe_preempt)
+        if self._breaker is not None \
+                and not self._breaker.allow_execute():
+            return batch, state, []
+        if inline:
+            now = time.monotonic()
+            with self._cond:
+                for other, pend in self._pending.items():
+                    if other == bucket_len:
+                        continue
+                    if any((now - e.enqueued_at) * 1000.0
+                           >= cfg.max_wait_ms for e in pend):
+                        # another bucket is past its batch-formation
+                        # window and only this worker can serve it:
+                        # stop refilling so the loop ends and the
+                        # worker gets back to _form_batch
+                        return batch, state, []
+        depth = 0 if batch.get("msa") is None \
+            else int(batch["msa"].shape[1])
+        placements: List[Tuple[int, _Entry]] = []
+        while free:
+            e = self._take_admission_candidate(bucket_len, depth)
+            if e is None:
+                break
+            # HBM guard, mirroring submit(): an unpinned msa_depth
+            # prices the request's own depth. The policy (or its
+            # budget) may have tightened since this entry passed the
+            # door — a refused candidate goes back to pending and the
+            # round stops (its siblings would refuse identically).
+            if self.mesh_policy is not None:
+                guard_msa = cfg.msa_depth
+                if guard_msa is None:
+                    guard_msa = 0 if e.request.msa is None \
+                        else int(e.request.msa.shape[0])
+                if not self.mesh_policy.admits(
+                        bucket_len, cfg.max_batch_size, guard_msa,
+                        carry_recyclables=True, continuous=True):
+                    e.trace.event("row_admission_refused_hbm",
+                                  gap=gap)
+                    self._readmit_pending(bucket_len, e)
+                    break
+            key = None
+            if self.cache is not None:
+                key = self._entry_key(e)
+            if key is not None:
+                try:
+                    cached = self.cache.get(key, trace=e.trace)
+                except Exception:
+                    cached = None
+                if cached is not None:
+                    # a store hit never burns a row: another batch (or
+                    # a peer) finished this key since submit
+                    self.metrics.record_cache_hit()
+                    e.trace.end("queue")
+                    resp = FoldResponse(
+                        request_id=e.request.request_id, status="ok",
+                        coords=cached.coords.copy(),
+                        confidence=cached.confidence.copy(),
+                        bucket_len=bucket_len,
+                        latency_s=time.monotonic() - e.enqueued_at,
+                        source="cache")
+                    e.resolve(resp)
+                    self._settle_followers(e, resp)
+                    continue
+                self.metrics.record_cache_miss()
+                if e.cache_key is None:
+                    # not a coalescing leader (the saturated block-mode
+                    # fall-through, or a cache attached after submit):
+                    # an in-flight duplicate must park behind its
+                    # leader, never double-fold in an admitted row
+                    def _trace_parked(leader, e=e):
+                        if leader is not None:
+                            e.trace.link(leader.trace.trace_id)
+                        e.trace.event("coalesced")
+                        e.trace.end("queue")
+                        e.trace.begin("parked")
+
+                    if self._inflight.attach_follower(
+                            key, e, on_follower=_trace_parked):
+                        self.metrics.record_coalesced()
+                        continue
+            placements.append((free.pop(0), e))
+        if not placements:
+            return batch, state, []
+        admitted = [e for _, e in placements]
+        if self.tracer.enabled:
+            for e in admitted:
+                e.trace.end("queue", bucket_len=bucket_len)
+                e.trace.end("retry")   # no-op on a first execution
+        for e in admitted:
+            e.attempts += 1
+        # bookkeeping BEFORE the executor call: if init_rows fails, the
+        # batch-failure handler must already own these tickets
+        for row, e in placements:
+            active.append(e)
+            rows.append(row)
+            ages.append(0)
+            e.trace.event("row_admitted", gap=gap, row=row)
+        all_members.extend(admitted)
+        self._n_row_admissions += len(admitted)
+        self._c_row_admissions.inc(len(admitted))
+        new_batch = self._admitted_batch(batch, bucket_len, placements)
+        row_mask = np.zeros((cfg.max_batch_size,), bool)
+        for row, _ in placements:
+            row_mask[row] = True
+        admit_trace = (MultiTrace([e.trace for e in admitted])
+                       if self.tracer.enabled else NULL_TRACE)
+        state = self._run_step_guarded(
+            lambda: self.executor.run_init_rows(
+                new_batch, state, row_mask, trace=admit_trace,
+                devices=devices, mesh_shape=mesh_shape))
+        return new_batch, state, admitted
 
     def _retire_entry(self, e: _Entry, bucket_len: int, coords_row,
                       conf_row, recycles: int, now: float) -> bool:
@@ -1952,13 +2380,17 @@ class Scheduler:
 
     def _stream_progress(self, active: List[_Entry],
                          rows: List[int], coords_np, conf_np,
-                         recycle: int):
+                         recycles):
         """Publish one per-recycle progressive update to every active
         element's ticket (RecyclePolicy(stream=True) only). `rows`
-        maps each active position to its batch row."""
+        maps each active position to its batch row; `recycles` is the
+        per-position OWN recycle index list (ages — an admitted row
+        streams from 0 while its batch mates stream their own depth),
+        or one shared int for legacy callers."""
         if not self.recycle_policy.stream:
             return
         validate = self.retry is not None
+        per_row = isinstance(recycles, (list, tuple))
         for i, e in enumerate(active):
             n = e.request.length
             try:
@@ -1971,7 +2403,8 @@ class Scheduler:
                     # leak the same garbage to a streaming client
                     continue
                 e.ticket._publish_progress(FoldProgress(
-                    e.request.request_id, recycle,
+                    e.request.request_id,
+                    recycles[i] if per_row else recycles,
                     coords.copy(), conf.copy()))
             except Exception:
                 pass          # a broken observer never stalls the loop
@@ -2123,7 +2556,8 @@ class Scheduler:
         urgent_bytes = mp.memory.fold_bytes(
             urgent_bucket, cfg.max_batch_size, guard_msa,
             shape=mp.shape_for(urgent_bucket),
-            carry_recyclables=self._use_step_loop())
+            carry_recyclables=self._use_step_loop(),
+            continuous=self._use_continuous())
         carry = mp.memory.carry_bytes(
             running_bucket, cfg.max_batch_size,
             chips=mp.chips_for(running_bucket))
@@ -2147,38 +2581,43 @@ class Scheduler:
         tightest deadlines first. Bisection isolation groups never ride
         a preemption batch — their cohort discipline wins."""
         now = time.monotonic()
+        # one _cond hold end to end: continuous row admission (pool
+        # threads) takes from _pending too, so scan + removal must be
+        # atomic against it
         with self._cond:
             while self._incoming:
                 entry = self._incoming.popleft()
                 self._pending.setdefault(entry.bucket_len,
                                          []).append(entry)
-        best = None
-        for bucket_len, pend in self._pending.items():
-            for e in pend:
-                if not self._urgent_eligible(e, now):
-                    continue
-                if tighter_than is not None and e.deadline >= tighter_than:
-                    continue
-                if best is None or e.deadline < best[0]:
-                    best = (e.deadline, bucket_len)
-        if best is None:
-            return None
-        _, bucket_len = best
-        # batch fill excludes expired deadlines too: a dead request
-        # must resolve "shed" via the worker's sweep, never ride a
-        # preemption batch to an after-deadline "ok" (deadline-free
-        # fill entries are fine — they just serve sooner)
-        pend = [e for e in self._pending[bucket_len]
-                if e.group is None and e.not_before <= now
-                and not (e.deadline is not None and e.deadline <= now)]
-        take = sorted(pend, key=lambda e: (e.deadline is None,
-                                           e.deadline or 0.0,
-                                           -e.request.priority,
-                                           e.enqueued_at))
-        take = take[:self.config.max_batch_size]
-        taken = {id(e) for e in take}
-        self._pending[bucket_len] = [e for e in self._pending[bucket_len]
-                                     if id(e) not in taken]
+            best = None
+            for bucket_len, pend in self._pending.items():
+                for e in pend:
+                    if not self._urgent_eligible(e, now):
+                        continue
+                    if tighter_than is not None \
+                            and e.deadline >= tighter_than:
+                        continue
+                    if best is None or e.deadline < best[0]:
+                        best = (e.deadline, bucket_len)
+            if best is None:
+                return None
+            _, bucket_len = best
+            # batch fill excludes expired deadlines too: a dead request
+            # must resolve "shed" via the worker's sweep, never ride a
+            # preemption batch to an after-deadline "ok" (deadline-free
+            # fill entries are fine — they just serve sooner)
+            pend = [e for e in self._pending[bucket_len]
+                    if e.group is None and e.not_before <= now
+                    and not (e.deadline is not None and e.deadline <= now)]
+            take = sorted(pend, key=lambda e: (e.deadline is None,
+                                               e.deadline or 0.0,
+                                               -e.request.priority,
+                                               e.enqueued_at))
+            take = take[:self.config.max_batch_size]
+            taken = {id(e) for e in take}
+            self._pending[bucket_len] = [
+                e for e in self._pending[bucket_len]
+                if id(e) not in taken]
         self._resolve_removed(take)
         return bucket_len, take
 

@@ -1,0 +1,626 @@
+"""Continuous batching tests (ISSUE 11): row-masked init numerics
+(admitted row byte-equal to folding the same request alone), per-row
+recycle accounting, admission ordering (deadline/priority) + HBM guard,
+multi-chip in-place admission via the rows map, preemption composing
+with freed-row claims, the continuous=False scrubbed-stats identity
+pin, warmup of the init_rows variant, the admitted-duplicate
+coalescing bugfix, and the loadtest --continuous flag surface."""
+
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu import Alphafold2
+from alphafold2_tpu.cache import FoldCache
+from alphafold2_tpu.data.synthetic import synthetic_requests
+from alphafold2_tpu.obs.registry import MetricsRegistry
+from alphafold2_tpu.serve import (BucketPolicy, FoldExecutor,
+                                  FoldMemoryModel, FoldRequest,
+                                  MeshPolicy, RecyclePolicy, Scheduler,
+                                  SchedulerConfig, ServeMetrics)
+
+MSA_DEPTH = 3
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = Alphafold2(dim=32, depth=1, heads=2, dim_head=16,
+                       predict_coords=True, structure_module_depth=1)
+    n = 16
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, n), jnp.int32),
+        msa=jnp.zeros((1, MSA_DEPTH, n), jnp.int32),
+        mask=jnp.ones((1, n), bool),
+        msa_mask=jnp.ones((1, MSA_DEPTH, n), bool))
+    return model, params
+
+
+def requests_of(lengths, key=1):
+    return synthetic_requests(jax.random.PRNGKey(key),
+                              num=len(lengths), lengths=lengths,
+                              msa_depth=MSA_DEPTH)
+
+
+class GatedInitExecutor(FoldExecutor):
+    """Real executor whose FIRST armed run_init blocks until released:
+    the deterministic window for submitting work that must be admitted
+    MID-LOOP rather than riding the founder batch."""
+
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        self.reached = threading.Event()
+        self.release = threading.Event()
+        self.armed = False
+
+    def run_init(self, *a, **k):
+        out = super().run_init(*a, **k)
+        if self.armed:
+            self.armed = False
+            self.reached.set()
+            assert self.release.wait(timeout=120)
+        return out
+
+
+def _scheduler(model_and_params, policy=None, num_recycles=2,
+               buckets=(16,), max_batch=2, ex_cls=FoldExecutor, **kw):
+    kw.setdefault("metrics", ServeMetrics(registry=MetricsRegistry()))
+    kw.setdefault("registry", MetricsRegistry())
+    ex = ex_cls(*model_and_params, max_entries=8)
+    sched = Scheduler(
+        ex, BucketPolicy(buckets),
+        SchedulerConfig(max_batch_size=max_batch, max_wait_ms=5.0,
+                        num_recycles=num_recycles, msa_depth=MSA_DEPTH),
+        recycle_policy=policy, **kw)
+    return ex, sched
+
+
+class TestRowMaskedInit:
+    def test_fold_init_rows_numerics(self, model_and_params):
+        """The admission program's two contracts at the executor level:
+        survivor rows pass through BYTE-identical, admitted rows equal
+        a fresh init — and a step after admission equals folding the
+        admitted request alone."""
+        ex = FoldExecutor(*model_and_params, max_entries=8)
+        pol = BucketPolicy((16,))
+        a, b = requests_of((12, 10), key=5)
+        batch, _ = pol.assemble([a, b], 16, 2)
+        st1 = ex.run_step(batch, ex.run_init(batch), 1)
+        new = requests_of((8,), key=6)[0]
+        batch2, _ = pol.assemble([new, b], 16, 2)
+        st2 = ex.run_init_rows(batch2, st1, np.array([True, False]))
+        np.testing.assert_array_equal(np.asarray(st1.coords)[1],
+                                      np.asarray(st2.coords)[1])
+        np.testing.assert_array_equal(
+            np.asarray(st1.recyclables.pairwise_repr)[1],
+            np.asarray(st2.recyclables.pairwise_repr)[1])
+        fresh = ex.run_init(batch2)
+        np.testing.assert_array_equal(np.asarray(fresh.coords)[0],
+                                      np.asarray(st2.coords)[0])
+        st3 = ex.run_step(batch2, st2, 2)
+        alone_batch, _ = pol.assemble([new], 16, 2)
+        alone = ex.run_step(alone_batch, ex.run_init(alone_batch), 1)
+        np.testing.assert_array_equal(np.asarray(st3.coords)[0],
+                                      np.asarray(alone.coords)[0])
+
+    def test_admitted_row_byte_equal_folded_alone(self,
+                                                  model_and_params):
+        """ISSUE 11 acceptance at tol 0, end to end through the
+        scheduler: a request admitted into a freed row mid-loop serves
+        final coords BYTE-equal to the same request folded alone, with
+        its OWN full recycle count."""
+        a, b = requests_of((12, 10), key=5)
+        ex, sched = _scheduler(
+            model_and_params,
+            RecyclePolicy(converge_tol=0.0, continuous=True),
+            ex_cls=GatedInitExecutor)
+        sched.warmup()
+        ex.armed = True
+        sched.start()
+        try:
+            ta = sched.submit(FoldRequest(seq=a.seq, msa=a.msa))
+            assert ex.reached.wait(timeout=120)
+            tb = sched.submit(FoldRequest(seq=b.seq, msa=b.msa))
+            time.sleep(0.1)       # let B reach the pending queue
+            ex.release.set()
+            ra = ta.result(timeout=300)
+            rb = tb.result(timeout=300)
+        finally:
+            sched.stop()
+        assert ra.ok and rb.ok, (ra.error, rb.error)
+        assert ra.recycles == 2 and rb.recycles == 2
+        rec = sched.serve_stats()["recycle"]
+        assert rec["row_admissions"] == 1
+        assert 0 < rec["rows_occupied_fraction"] < 1
+        _, alone = _scheduler(model_and_params,
+                              RecyclePolicy(converge_tol=0.0))
+        with alone:
+            rb2 = alone.submit(
+                FoldRequest(seq=b.seq, msa=b.msa)).result(timeout=300)
+        np.testing.assert_array_equal(rb.coords, rb2.coords)
+        np.testing.assert_array_equal(rb.confidence, rb2.confidence)
+
+    def test_warmup_compiles_row_init_variant(self, model_and_params):
+        ex = FoldExecutor(*model_and_params, max_entries=8)
+        fresh = ex.warmup([(16, 2, MSA_DEPTH, 3)], step_mode=True,
+                          continuous=True)
+        assert fresh == 3                    # init + init_rows + step
+        variants = {k[6] for k in ex.stats()["keys"]}
+        assert variants == {"init", "init_rows", "step"}
+        # the scheduler's warmup warms what continuous serving runs:
+        # a mid-loop admission afterwards never compiles
+        ex2, sched = _scheduler(
+            model_and_params,
+            RecyclePolicy(converge_tol=0.0, continuous=True))
+        assert sched.warmup() == 3
+        assert "init_rows" in {k[6] for k in ex2.stats()["keys"]}
+
+
+class _ContStub:
+    """Step/admission-capable executor stub with deterministic per-row
+    convergence: a row's coords climb 1.0 per step until the plan's
+    converge count for its request (keyed by the seq's first token),
+    then freeze — its inter-recycle delta drops to 0 exactly at age
+    plan+1. An optional gate blocks inside the armed run_step so the
+    test can inject pending work at a chosen recycle gap."""
+
+    def __init__(self, plan):
+        self.plan = plan              # first token -> freeze count
+        self.calls = []
+        self.reached = threading.Event()
+        self.release = threading.Event()
+        self.gate_at = None           # recycle index to block at
+        self._lock = threading.Lock()
+
+    def _mk_state(self, ids, counts, b, n):
+        coords = np.zeros((b, n, 3), np.float32)
+        for i, c in enumerate(counts):
+            coords[i] = float(c)
+        return SimpleNamespace(coords=coords,
+                               confidence=np.zeros((b, n), np.float32),
+                               recyclables=None,
+                               ids=np.array(ids), counts=np.array(counts))
+
+    def run_init(self, batch, trace=None, devices=None,
+                 mesh_shape=None):
+        seq = np.asarray(batch["seq"])
+        b, n = seq.shape
+        ids = seq[:, 0]
+        with self._lock:
+            self.calls.append(("init", [int(i) for i in ids]))
+        return self._mk_state(ids, [0] * b, b, n)
+
+    def run_init_rows(self, batch, state, row_mask, trace=None,
+                      devices=None, mesh_shape=None):
+        seq = np.asarray(batch["seq"])
+        b, n = seq.shape
+        mask = np.asarray(row_mask)
+        ids = state.ids.copy()
+        counts = state.counts.copy()
+        ids[mask] = seq[:, 0][mask]
+        counts[mask] = 0
+        with self._lock:
+            self.calls.append(
+                ("init_rows", [int(i) for i in seq[:, 0][mask]]))
+        return self._mk_state(ids, counts, b, n)
+
+    def run_step(self, batch, state, recycle_index, trace=None,
+                 devices=None, mesh_shape=None, span_attrs=None):
+        b, n = np.asarray(batch["seq"]).shape
+        with self._lock:
+            self.calls.append(("step", int(recycle_index)))
+            gated = self.gate_at is not None \
+                and recycle_index == self.gate_at
+            if gated:
+                self.gate_at = None
+        if gated:
+            self.reached.set()
+            assert self.release.wait(timeout=60)
+        counts = [min(int(c) + 1,
+                      self.plan.get(int(t), 10 ** 9))
+                  for t, c in zip(state.ids, state.counts)]
+        time.sleep(0.01)
+        return self._mk_state(state.ids, counts, b, n)
+
+    def run(self, batch, num_recycles, **kw):       # opaque fallback
+        st = self.run_init(batch)
+        return SimpleNamespace(coords=st.coords,
+                               confidence=st.confidence)
+
+    def stats(self):
+        return {"calls": len(self.calls)}
+
+
+def _stub_sched(stub, num_recycles, policy, max_batch=2,
+                buckets=(32,), **kw):
+    kw.setdefault("metrics", ServeMetrics(registry=MetricsRegistry()))
+    kw.setdefault("registry", MetricsRegistry())
+    return Scheduler(
+        stub, BucketPolicy(buckets),
+        SchedulerConfig(max_batch_size=max_batch, max_wait_ms=5.0,
+                        num_recycles=num_recycles, msa_depth=0),
+        recycle_policy=policy, **kw)
+
+
+def _req(token, length=12, **kw):
+    return FoldRequest(seq=np.full(length, token, np.int32), **kw)
+
+
+class TestPerRowAccounting:
+    def test_recycles_reported_per_row_age(self):
+        """Founders and admitted rows each report recycles against
+        their OWN age: a founder that converges at 2 says 2, a row
+        admitted mid-loop that runs its full depth says num_recycles —
+        even though the loop stepped far past that for the founders."""
+        stub = _ContStub({1: 1, 2: 10 ** 9, 3: 10 ** 9})
+        stub.gate_at = 2
+        sched = _stub_sched(
+            stub, 4, RecyclePolicy(converge_tol=0.5, continuous=True,
+                                   preempt=False))
+        sched.start()
+        try:
+            t1 = sched.submit(_req(1))
+            t2 = sched.submit(_req(2))
+            assert stub.reached.wait(timeout=60)
+            t3 = sched.submit(_req(3))       # pending mid-loop
+            time.sleep(0.05)
+            stub.release.set()
+            r1 = t1.result(timeout=60)
+            r2 = t2.result(timeout=60)
+            r3 = t3.result(timeout=60)
+        finally:
+            sched.stop()
+        assert r1.ok and r2.ok and r3.ok
+        # token 1 freezes at count 1 -> delta 0 at age 2 -> early exit
+        assert r1.recycles == 2
+        # token 2 never converges -> full depth
+        assert r2.recycles == 4
+        # token 3 admitted into token 1's freed row, runs ITS full
+        # depth from age 0 (never measured against the pre-admission
+        # occupant's state)
+        assert r3.recycles == 4
+        rec = sched.serve_stats()["recycle"]
+        assert rec["row_admissions"] == 1
+        assert rec["retired_early"] == 1
+        assert ("init_rows", [3]) in stub.calls
+
+    def test_admission_deadline_order(self):
+        """Freed rows fill tightest-deadline-first, then FIFO: an
+        urgent fold submitted AFTER a bulk one still claims the first
+        freed row — composing with preemption without needing a batch
+        gap (preemptions stays 0)."""
+        stub = _ContStub({1: 1, 2: 10 ** 9, 3: 1, 4: 1})
+        stub.gate_at = 2
+        sched = _stub_sched(
+            stub, 6, RecyclePolicy(converge_tol=0.5, continuous=True,
+                                   preempt=True))
+        order = []
+        sched.start()
+        try:
+            t1 = sched.submit(_req(1))
+            t2 = sched.submit(_req(2))
+            assert stub.reached.wait(timeout=60)
+            t4 = sched.submit(_req(4))                 # bulk, FIFO-first
+            t3 = sched.submit(_req(3, deadline_s=30.0))  # urgent, later
+            for tok, t in ((4, t4), (3, t3)):
+                t.add_done_callback(
+                    lambda r, tok=tok: order.append(tok))
+            time.sleep(0.05)
+            stub.release.set()
+            rs = [t.result(timeout=60) for t in (t1, t2, t3, t4)]
+        finally:
+            sched.stop()
+        assert all(r.ok for r in rs)
+        admitted = [c[1] for c in stub.calls if c[0] == "init_rows"]
+        # the urgent fold claimed the FIRST freed row despite arriving
+        # after the bulk one; the bulk fold took the next freed row
+        assert admitted[0] == [3]
+        assert [3] in admitted and [4] in admitted
+        assert order.index(3) < order.index(4)
+        rec = sched.serve_stats()["recycle"]
+        assert rec["preemptions"] == 0
+        assert rec["row_admissions"] == 2
+
+    def test_admission_honors_hbm_guard(self):
+        """A candidate the (tightened) HBM guard refuses is NOT
+        admitted mid-loop — it returns to the pending queue and folds
+        through normal batch formation once the loop ends."""
+        mem = FoldMemoryModel(param_bytes=0, dim=64, heads=4)
+        mem.hbm_bytes_per_device = 1 << 60       # admits everything
+        pol = MeshPolicy({32: 1}, devices=jax.devices()[:1], memory=mem)
+        stub = _ContStub({1: 10 ** 9})
+        stub.gate_at = 1
+        sched = _stub_sched(
+            stub, 3, RecyclePolicy(converge_tol=0.5, continuous=True,
+                                   preempt=False),
+            mesh_policy=pol)
+        sched.start()
+        try:
+            t1 = sched.submit(_req(1))      # founder, under-filled batch
+            assert stub.reached.wait(timeout=60)
+            t2 = sched.submit(_req(2))      # candidate for the free row
+            time.sleep(0.05)
+            # the guard tightens mid-flight: admission must refuse
+            mem.hbm_bytes_per_device = 1
+            stub.release.set()
+            r1 = t1.result(timeout=60)
+            r2 = t2.result(timeout=60)
+        finally:
+            sched.stop()
+        assert r1.ok and r2.ok
+        rec = sched.serve_stats()["recycle"]
+        assert rec["row_admissions"] == 0
+        # token 2 folded in its own batch afterwards, full depth
+        assert r2.recycles == 3
+        assert ("init", [2, 2]) in stub.calls or \
+            ("init", [2]) in [(c[0], c[1][:1]) for c in stub.calls
+                              if c[0] == "init"]
+
+    def test_continuous_false_scrubbed_stats_identity(
+            self, model_and_params):
+        """The off switch: RecyclePolicy(continuous=False) leaves
+        scrubbed serve_stats() byte-identical to a policy that never
+        mentioned the field (same scrub discipline as the
+        recycle_policy=None pin in test_recycle.py)."""
+        def scrub(obj):
+            if isinstance(obj, dict):
+                return {k: scrub(v) for k, v in sorted(obj.items())
+                        if k != "traces" and not k.endswith("_s")}
+            if isinstance(obj, list):
+                return [scrub(v) for v in obj]
+            return obj
+
+        def run_one(policy):
+            _, sched = _scheduler(model_and_params, policy,
+                                  num_recycles=1)
+            reqs = requests_of((12, 8), key=9)
+            with sched:
+                for r in reqs:
+                    assert sched.submit(
+                        FoldRequest(seq=r.seq, msa=r.msa)).result(
+                            timeout=300).ok
+            return scrub(sched.serve_stats())
+
+        explicit_off = run_one(RecyclePolicy(converge_tol=0.0,
+                                             continuous=False))
+        never_heard = run_one(RecyclePolicy(converge_tol=0.0))
+        assert json.dumps(explicit_off, sort_keys=True, default=str) \
+            == json.dumps(never_heard, sort_keys=True, default=str)
+        assert explicit_off["recycle"]["row_admissions"] == 0
+        assert explicit_off["recycle"]["continuous"] is False
+
+
+class TestInlineWorkerLiveness:
+    def test_other_bucket_past_max_wait_stops_admission(self):
+        """The inline (no-mesh) continuous loop runs ON the worker
+        thread: with a same-bucket backlog feeding admissions it could
+        hold the worker forever while other buckets starve. The
+        admission gate yields as soon as another bucket is past its
+        max_wait window: the loop stops refilling (admissions stay
+        well below the backlog) and the other bucket's request still
+        resolves."""
+        plan = {t: 1 for t in range(1, 12)}   # everyone converges fast
+        plan[99] = 1
+        stub = _ContStub(plan)
+        stub.gate_at = 1
+        sched = _stub_sched(
+            stub, 4, RecyclePolicy(converge_tol=0.5, continuous=True,
+                                   preempt=False),
+            max_batch=2, buckets=(32, 64))
+        backlog = 8
+        sched.start()
+        try:
+            t0 = sched.submit(_req(1))
+            assert stub.reached.wait(timeout=60)
+            tickets = [sched.submit(_req(2 + i)) for i in range(backlog)]
+            t_other = sched.submit(_req(99, length=40))  # bucket 64
+            time.sleep(0.05)
+            stub.release.set()
+            r_other = t_other.result(timeout=60)
+            rs = [t.result(timeout=60) for t in [t0] + tickets]
+        finally:
+            sched.stop()
+        assert r_other.ok
+        assert all(r.ok for r in rs)
+        # the gate halted refills once bucket 64 went past max_wait:
+        # nowhere near the whole backlog rode the first loop
+        rec = sched.serve_stats()["recycle"]
+        assert rec["row_admissions"] < backlog
+
+    def test_expired_pending_sheds_during_inline_loop(self):
+        """The worker's expired-deadline sweep runs from the inline
+        loop's admission gaps: a pending request whose deadline dies
+        mid-loop resolves "shed" promptly instead of hanging until the
+        loop ends (admission itself skips expired entries by design,
+        so without the in-loop sweep they would wait out the whole
+        batch)."""
+        stub = _ContStub({1: 10 ** 9})        # founder never converges
+        stub.gate_at = 1
+        sched = _stub_sched(
+            stub, 40, RecyclePolicy(converge_tol=0.5, continuous=True,
+                                    preempt=False),
+            max_batch=1)                      # no free rows: only the
+        #                                       sweep can serve C
+        done = {}
+        sched.start()
+        try:
+            t1 = sched.submit(_req(1))
+            assert stub.reached.wait(timeout=60)
+            tc = sched.submit(_req(3, deadline_s=0.05))
+            tc.add_done_callback(
+                lambda r: done.setdefault("at", time.monotonic()))
+            t_rel = time.monotonic()
+            stub.release.set()
+            rc = tc.result(timeout=60)
+            assert rc.status == "shed"
+            shed_after = done["at"] - t_rel
+            r1 = t1.result(timeout=60)
+        finally:
+            sched.stop()
+        assert r1.ok and r1.recycles == 40
+        # 40 recycles at >= 10ms each: the loop ran ~0.4s+; the shed
+        # landed from an early gap, not after the loop
+        assert shed_after < 0.3, shed_after
+
+
+class TestMultiChipAdmission:
+    @pytest.mark.skipif(len(jax.devices()) < 2,
+                        reason="needs >= 2 devices")
+    def test_inplace_admission_on_mesh_lease(self, model_and_params):
+        """Admission on a multi-chip lease writes into freed rows via
+        the position->row map (no physical repack of the mesh-sharded
+        carry) from the dispatch-pool thread — and the admitted row's
+        result is byte-equal to folding it alone on the same mesh."""
+        a, b = requests_of((12, 10), key=5)
+
+        def mk(gated):
+            # pool of exactly ONE 2-chip slice: pending work cannot
+            # dodge admission by grabbing a free slice of its own
+            ex, sched = _scheduler(
+                model_and_params,
+                RecyclePolicy(converge_tol=0.0, continuous=True),
+                ex_cls=GatedInitExecutor if gated else FoldExecutor,
+                mesh_policy=MeshPolicy({16: 2},
+                                       devices=jax.devices()[:2]))
+            return ex, sched
+
+        ex, sched = mk(True)
+        sched.warmup()
+        ex.armed = True
+        sched.start()
+        try:
+            ta = sched.submit(FoldRequest(seq=a.seq, msa=a.msa))
+            assert ex.reached.wait(timeout=300)
+            tb = sched.submit(FoldRequest(seq=b.seq, msa=b.msa))
+            time.sleep(0.1)
+            ex.release.set()
+            ra = ta.result(timeout=300)
+            rb = tb.result(timeout=300)
+        finally:
+            sched.stop()
+        assert ra.ok and rb.ok, (ra.error, rb.error)
+        stats = sched.serve_stats()
+        assert stats["recycle"]["row_admissions"] == 1
+        assert "1x2" in stats["mesh"]["folds"]      # ran sharded
+        _, alone = mk(False)
+        alone.warmup()
+        with alone:
+            rb2 = alone.submit(
+                FoldRequest(seq=b.seq, msa=b.msa)).result(timeout=300)
+        np.testing.assert_array_equal(rb.coords, rb2.coords)
+
+
+class TestAdmittedDuplicateCoalesces:
+    def test_inflight_duplicate_parks_never_double_folds(
+            self, model_and_params):
+        """Bugfix satellite: an admission candidate that is an
+        in-flight duplicate (the saturated block-mode fall-through:
+        store_key set, not a leader) attaches as a coalescing follower
+        instead of burning a row on a double fold — and the leader's
+        fold populates the store under the policy's own key_extras
+        keying, settling it."""
+        cache = FoldCache(registry=MetricsRegistry())
+        policy = RecyclePolicy(converge_tol=1e9, min_recycles=3,
+                               continuous=True, preempt=False)
+        a, b = requests_of((12, 10), key=5)
+        ex, sched = _scheduler(
+            model_and_params, policy, num_recycles=3,
+            ex_cls=GatedInitExecutor, cache=cache, model_tag="v1")
+        # saturate the queue so the duplicate takes the block-mode
+        # fall-through (store_key, no leader attach)
+        sched.config.queue_limit = 1
+        sched.config.full_policy = "block"
+        sched.warmup()
+        ex.armed = True
+        sched.start()
+        dup_box = {}
+
+        def submit_dup():
+            t = sched.submit(FoldRequest(seq=b.seq.copy(),
+                                         msa=b.msa.copy()))
+            dup_box["ticket"] = t
+
+        try:
+            ta = sched.submit(FoldRequest(seq=a.seq, msa=a.msa))
+            assert ex.reached.wait(timeout=120)
+            tb = sched.submit(FoldRequest(seq=b.seq, msa=b.msa))
+            # a duplicate of B while the queue is full: blocks until
+            # B's admission frees capacity, then enqueues with
+            # store_key only (the fall-through under test)
+            th = threading.Thread(target=submit_dup, daemon=True)
+            th.start()
+            time.sleep(0.1)
+            ex.release.set()
+            ra = ta.result(timeout=300)
+            rb = tb.result(timeout=300)
+            th.join(timeout=120)
+            rdup = dup_box["ticket"].result(timeout=300)
+        finally:
+            sched.stop()
+        assert ra.ok and rb.ok and rdup.ok
+        assert rdup.source == "coalesced"
+        # exactly one admission (B); the duplicate never burned a row
+        rec = sched.serve_stats()["recycle"]
+        assert rec["row_admissions"] == 1
+        # the result landed in the store under the SAME key the
+        # queue path uses (RecyclePolicy.key_extras included)
+        key = sched._cache_key_for(FoldRequest(seq=b.seq, msa=b.msa))
+        assert cache.get(key) is not None
+        np.testing.assert_array_equal(rb.coords, rdup.coords)
+
+
+class TestMemoryPricing:
+    def test_continuous_admission_seam_priced(self):
+        mem = FoldMemoryModel(param_bytes=0, dim=64, heads=4)
+        plain = mem.fold_bytes(256, 2, 3)
+        carry = mem.fold_bytes(256, 2, 3, carry_recyclables=True)
+        cont = mem.fold_bytes(256, 2, 3, carry_recyclables=True,
+                              continuous=True)
+        assert plain < carry < cont
+
+    def test_admits_flips_under_continuous(self):
+        mem = FoldMemoryModel(param_bytes=0, dim=64, heads=4)
+        L, B, M = 256, 2, 3
+        carry = mem.fold_bytes(L, B, M, carry_recyclables=True)
+        cont = mem.fold_bytes(L, B, M, carry_recyclables=True,
+                              continuous=True)
+        mem.hbm_bytes_per_device = (carry + cont) // 2
+        pol = MeshPolicy({L: 1}, devices=[0], memory=mem)
+        assert pol.admits(L, B, M, carry_recyclables=True)
+        assert not pol.admits(L, B, M, carry_recyclables=True,
+                              continuous=True)
+
+
+class TestLoadtestFlags:
+    def test_continuous_flags_fast(self, tmp_path, capsys):
+        """Tier-1 flag-rot tripwire: the --continuous/--converge-
+        percentile surface drives a real (tiny) run and reports the
+        occupancy fields."""
+        import sys
+        sys.path.insert(0, "tools")
+        try:
+            import serve_loadtest
+        finally:
+            sys.path.pop(0)
+        rc = serve_loadtest.main([
+            "--requests", "8", "--concurrency", "4",
+            "--lengths", "12", "--buckets", "16",
+            "--msa-depth", str(MSA_DEPTH), "--max-batch", "2",
+            "--max-wait-ms", "5", "--num-recycles", "2",
+            "--continuous", "--converge-percentile", "50",
+            "--dim", "32", "--depth", "1",
+            "--metrics-path", str(tmp_path / "m.jsonl")])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out.strip()
+                            .splitlines()[-1])
+        assert report["continuous"] is True
+        assert report["served"] == 8
+        assert "rows_occupied_fraction" in report
+        assert "row_admissions" in report
+        assert report["converge_tol_calibrated"] > 0
+        assert report["recycle"]["continuous"] is True

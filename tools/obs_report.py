@@ -57,13 +57,20 @@ from alphafold2_tpu.utils.profiling import percentile  # noqa: E402
 # featurize (the CPU feature-pipeline stage of a RAW submission:
 # feature-cache lookup, in-flight coalesce wait, pool queue + the
 # tokenize/MSA-prep work itself) with ISSUE 10 — it precedes submit in
-# the pipeline, so it leads the waterfall.
+# the pipeline, so it leads the waterfall;
+# admit (the continuous batcher's mid-recycle row admission: the
+# row-masked init executable that restarts a freed row with a newly
+# admitted request while survivor rows keep stepping) with ISSUE 11 —
+# it is an admitted request's first accelerator pass, so the
+# accelerator-time rule below accepts it alongside fold/compile, and
+# its sibling recycle spans carry rows_live/rows_total attrs the
+# occupancy line reads back.
 # --check's orphan-span rules apply to all of them unchanged, which is
 # how the chaos smokes prove recovery cost is fully accounted.
 STAGE_ORDER = ("featurize", "submit", "forward", "rpc", "queue",
                "parked", "retry", "drain", "batch_form", "shard",
-               "compile", "fold", "recycle", "watchdog", "writeback",
-               "peer_fetch", "cache_lookup", "write")
+               "compile", "fold", "recycle", "admit", "watchdog",
+               "writeback", "peer_fetch", "cache_lookup", "write")
 
 # span/trace boundary slack: start_s, dur_s, and duration_s are each
 # INDEPENDENTLY rounded to 1e-6 when emitted, so a span auto-closed at
@@ -115,9 +122,14 @@ def check_traces(records: List[dict]) -> List[str]:
                 problems.append(f"{where}: span {name!r} escapes its "
                                 f"trace window ({t0}+{dur} > {duration})")
         if status == "ok" and rec.get("source") == "fold":
+            # admit counts as accelerator time: a row-admitted request
+            # (continuous batching, ISSUE 11) gets its first pass via
+            # the row-masked init executable under an `admit` span, not
+            # the batch-level `fold` span its founders carry
             fold_time = sum(s.get("dur_s", 0.0)
                             for s in rec.get("spans", ())
-                            if s.get("name") in ("fold", "compile"))
+                            if s.get("name") in ("fold", "compile",
+                                                 "admit"))
             if fold_time <= 0:
                 problems.append(f"{where}: served from the accelerator "
                                 "but has no non-zero fold span")
@@ -161,6 +173,30 @@ def mesh_fold_stats(records: List[dict]) -> dict:
                    "p50_s": percentile(durs, 50),
                    "p99_s": percentile(durs, 99)}
             for mesh, durs in sorted(by_mesh.items())}
+
+
+def rows_occupied_stats(records: List[dict]) -> Optional[dict]:
+    """Row-occupancy read back from recycle spans' rows_live/rows_total
+    attrs (the continuous batcher tags every step, ISSUE 11): the
+    span-weighted mean occupancy plus the span count. None when no
+    span carries the attrs (non-continuous runs). Span-weighted on
+    purpose — each live element of a step carries the span, so busy
+    steps weigh more; the scheduler-side
+    serve_stats()["recycle"]["rows_occupied_fraction"] is the
+    step-weighted truth the smoke gates on."""
+    fracs = []
+    for rec in records:
+        for span in rec.get("spans", ()):
+            if span.get("name") != "recycle":
+                continue
+            attrs = span.get("attrs") or {}
+            live, total = attrs.get("rows_live"), attrs.get("rows_total")
+            if live is not None and total:
+                fracs.append(float(live) / float(total))
+    if not fracs:
+        return None
+    return {"spans": len(fracs),
+            "mean_fraction": sum(fracs) / len(fracs)}
 
 
 def render_mesh_folds(stats: dict) -> str:
@@ -281,6 +317,7 @@ def main(argv=None) -> int:
         out = summarize(records)
         out["stages"] = stage_stats(records)
         out["mesh_folds"] = mesh_fold_stats(records)
+        out["rows_occupied"] = rows_occupied_stats(records)
         out["problems"] = problems[:20]
         print(json.dumps(out))
     else:
@@ -293,6 +330,11 @@ def main(argv=None) -> int:
         if len(mesh) > 1 or any(m != "1x1" for m in mesh):
             print("\n-- fold latency by mesh shape --")
             print(render_mesh_folds(mesh))
+        occ = rows_occupied_stats(records)
+        if occ is not None:
+            print(f"\nrows occupied (continuous batching): "
+                  f"{occ['mean_fraction']:.3f} span-weighted mean over "
+                  f"{occ['spans']} recycle spans")
         print(f"\n-- top {args.top} slowest --")
         print(render_slowest(records, args.top))
         if problems:

@@ -167,6 +167,25 @@ def parse_args(argv=None):
                     help="per-element convergence threshold for "
                          "early exit (0 = off: full recycles, "
                          "numerics identical to the opaque fold)")
+    ap.add_argument("--converge-percentile", type=float, default=0.0,
+                    help="CALIBRATE --converge-tol from the measured "
+                         "per-element recycle-1 delta distribution of "
+                         "the synthetic pool at this percentile "
+                         "(0 = off). Injects SKEWED convergence: ~P%% "
+                         "of elements early-exit at recycle 1, the "
+                         "rest run longer — the freed-rows workload "
+                         "the continuous batcher exists for. "
+                         "Deterministic (same seeds -> same tol), so "
+                         "a --continuous run and its early-exit-only "
+                         "baseline see the identical threshold")
+    ap.add_argument("--continuous", action="store_true",
+                    help="continuous batching (ISSUE 11, implies "
+                         "--recycle-sched): admit pending requests "
+                         "into freed batch rows BETWEEN recycles via "
+                         "the row-masked init program instead of "
+                         "padding until the batch's last survivor "
+                         "finishes; the report adds rows_occupied_"
+                         "fraction / row_admissions / rows_dead_steps")
     ap.add_argument("--min-recycles", type=int, default=0,
                     help="recycles every element must run before "
                          "early exit may fire")
@@ -281,20 +300,63 @@ def _build_mesh_policy(args, model, params, policy, jax,
         max_batch=args.max_batch, msa_depth=args.msa_depth,
         hbm_gb=args.mesh_hbm_gb, devices=devices,
         # auto-sized slices must price what will actually run: the
-        # step loop's carried Recyclables under --recycle-sched
-        carry_recyclables=bool(getattr(args, "recycle_sched", False)))
+        # step loop's carried Recyclables under --recycle-sched, plus
+        # the row-admission seam under --continuous
+        carry_recyclables=bool(getattr(args, "recycle_sched", False)
+                               or getattr(args, "continuous", False)),
+        continuous=bool(getattr(args, "continuous", False)))
 
 
 def _build_recycle_policy(args):
-    """serve.RecyclePolicy (or None) from --recycle-sched."""
-    if not args.recycle_sched:
+    """serve.RecyclePolicy (or None) from --recycle-sched /
+    --continuous (which implies it)."""
+    if not (args.recycle_sched or getattr(args, "continuous", False)):
         return None
     from alphafold2_tpu.serve import RecyclePolicy
 
     return RecyclePolicy(converge_tol=args.converge_tol,
                          min_recycles=args.min_recycles,
                          preempt=not args.no_preempt,
-                         stream=args.stream)
+                         stream=args.stream,
+                         continuous=getattr(args, "continuous", False))
+
+
+def _calibrate_converge_tol(args, executor, policy, pool):
+    """--converge-percentile: measure the SERVING pool's own
+    recycle-1 deltas at the serving signature (the same init+step
+    executables the scheduler will run — they stay warm in the
+    executor's LRU) and return the P-th percentile as the converge
+    tol. Elements whose delta sits below it early-exit at recycle 1;
+    the rest outlive them — exactly the skewed per-element convergence
+    that frees rows mid-loop. Calibrating on the pool the run will
+    actually submit (not a disjoint sample: delta distributions shift
+    between pools by more than their spread on small models) keeps the
+    split honest, and it is seed-deterministic, so a --continuous run
+    and its early-exit-only baseline gate on one identical
+    threshold."""
+    import numpy as np
+
+    from alphafold2_tpu.serve.recycle import element_deltas
+    from alphafold2_tpu.utils.profiling import percentile
+
+    protos = pool[:max(16, 2 * args.max_batch)]
+    by_bucket = {}
+    for p in protos:
+        by_bucket.setdefault(
+            policy.bucket_for(int(p.seq.shape[0])), []).append(p)
+    deltas = []
+    for bucket, group in sorted(by_bucket.items()):
+        for i in range(0, len(group), args.max_batch):
+            chunk = group[i:i + args.max_batch]
+            batch, _ = policy.assemble(chunk, bucket, args.max_batch,
+                                       msa_depth=args.msa_depth)
+            st0 = executor.run_init(batch)
+            st1 = executor.run_step(batch, st0, 1)
+            deltas.extend(element_deltas(
+                np.asarray(st0.coords), np.asarray(st0.confidence),
+                np.asarray(st1.coords), np.asarray(st1.confidence),
+                [int(r.seq.shape[0]) for r in chunk]))
+    return float(percentile(deltas, args.converge_percentile))
 
 
 def _poison_pool(args, jax):
@@ -393,6 +455,8 @@ def _build_tiny_model(args, jax, jnp, policy):
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.continuous:
+        args.recycle_sched = True    # continuous batching IS step mode
     import __graft_entry__
     if args.platform == "cpu":
         __graft_entry__.force_cpu_fallback()
@@ -420,18 +484,37 @@ def main(argv=None) -> int:
 
     model, params = _build_tiny_model(args, jax, jnp, policy)
 
+    deadline_s = args.deadline_s or None
+    # duration-mode cache runs need unique headroom: a 64-prototype pool
+    # under a 4096-entry schedule would force-duplicate almost every
+    # submission regardless of --dup-rate. The report's
+    # unique_requests/requests ratio is the effective duplicate rate.
+    pool_n = max(args.requests, 64)
+    if args.duration_s > 0 and (args.cache == "on" or args.dup_rate > 0):
+        pool_n = max(pool_n, 1024)
+    pool = synthetic_requests(
+        jax.random.PRNGKey(1), num=pool_n,
+        lengths=lengths, msa_depth=args.msa_depth, deadline_s=deadline_s)
+
     plan, retry = _build_resilience(args)
     mesh_policy = _build_mesh_policy(args, model, params, policy, jax)
-    recycle_policy = _build_recycle_policy(args)
     # mesh serving mints one executable per (bucket, slice identity):
     # size the LRU so concurrent slices don't thrash each other out
-    # (the scheduler doubles it for the step-mode init+step pair)
+    # (the scheduler doubles it for the step-mode init+step pair,
+    # triples under --continuous for the init_rows admission program)
     max_entries = policy.num_buckets * (
         len(jax.devices()) if mesh_policy is not None else 1)
     executor = serve.FoldExecutor(model, params,
                                   max_entries=max_entries,
                                   faults=plan,
                                   model_tag="serve_loadtest")
+    calibrated_tol = None
+    if args.recycle_sched and args.converge_percentile > 0:
+        # measure BEFORE the policy is built; the executables compiled
+        # here are the serving ones, so warmup below hits them warm
+        args.converge_tol = calibrated_tol = _calibrate_converge_tol(
+            args, executor, policy, pool)
+    recycle_policy = _build_recycle_policy(args)
     metrics = serve.ServeMetrics(args.metrics_path)
     config = serve.SchedulerConfig(
         max_batch_size=args.max_batch, max_wait_ms=args.max_wait_ms,
@@ -466,18 +549,6 @@ def main(argv=None) -> int:
             plan.add_poison(np.asarray(p.seq),
                             mode=args.chaos_poison_mode)
         plan.arm()        # warmup/compiles ran clean; the window starts
-
-    deadline_s = args.deadline_s or None
-    # duration-mode cache runs need unique headroom: a 64-prototype pool
-    # under a 4096-entry schedule would force-duplicate almost every
-    # submission regardless of --dup-rate. The report's
-    # unique_requests/requests ratio is the effective duplicate rate.
-    pool_n = max(args.requests, 64)
-    if args.duration_s > 0 and (args.cache == "on" or args.dup_rate > 0):
-        pool_n = max(pool_n, 1024)
-    pool = synthetic_requests(
-        jax.random.PRNGKey(1), num=pool_n,
-        lengths=lengths, msa_depth=args.msa_depth, deadline_s=deadline_s)
 
     schedule = _schedule_poison(_zipf_schedule(args, len(pool)),
                                 len(poisons))
@@ -625,6 +696,16 @@ def main(argv=None) -> int:
             + rec["recycles_executed"]
         report["recycle"] = rec
         report["recycles_saved"] = rec["recycles_skipped"]
+        # continuous-batching occupancy (identical keys with
+        # --continuous off, so the smoke's baseline comparison reads
+        # the same stat from both runs)
+        report["rows_occupied_fraction"] = round(
+            rec["rows_occupied_fraction"], 4)
+        report["row_admissions"] = rec["row_admissions"]
+        report["rows_dead_steps"] = rec["rows_dead_steps"]
+        report["continuous"] = bool(args.continuous)
+        if calibrated_tol is not None:
+            report["converge_tol_calibrated"] = calibrated_tol
         from alphafold2_tpu.utils.profiling import percentile
         report["latency_by_class"] = {
             k: {"count": len(v),
@@ -703,6 +784,14 @@ def main(argv=None) -> int:
                       f"{args.converge_tol} never early-exited "
                       f"(recycle stats {rec})", file=sys.stderr)
                 return 1
+            if args.continuous and rec["row_admissions"] == 0:
+                # a skewed-convergence workload under load that never
+                # refills a freed row means the continuous batcher is
+                # dead weight — fail loudly
+                print(f"SMOKE FAIL: --continuous with converge-tol "
+                      f"{args.converge_tol} never admitted a row "
+                      f"(recycle stats {rec})", file=sys.stderr)
+                return 1
         extra = (f", {cache_snap['hits']} cache hits, "
                  f"{cache_snap['coalesced']} coalesced"
                  if cache_on else "")
@@ -713,6 +802,10 @@ def main(argv=None) -> int:
                       f"({snap['recycle']['recycles_skipped']} recycles "
                       f"skipped, {snap['recycle']['preemptions']} "
                       f"preemptions)")
+            if args.continuous:
+                extra += (f", rows occupied "
+                          f"{report['rows_occupied_fraction']} "
+                          f"({report['row_admissions']} row admissions)")
         print(f"SMOKE OK: {snap['served']} folds, 0 shed/errors{extra}",
               file=sys.stderr)
     return 0
@@ -1352,7 +1445,8 @@ def _run_procs(args) -> int:
             converge_tol=args.converge_tol,
             min_recycles=args.min_recycles,
             preempt=not args.no_preempt,
-            stream=args.stream)))
+            stream=args.stream,
+            continuous=args.continuous)))
     print(f"procfleet: starting {n} replica processes under {run_dir}",
           file=sys.stderr)
     try:

@@ -78,6 +78,21 @@
 #      every request resolves ok, and obs_report --check is clean over
 #      the pipelined traces with featurize spans present in the
 #      waterfall. The feature-pipeline tripwire.
+#  10. continuous batching (--continuous, RecyclePolicy(continuous)):
+#      a single-bucket workload at num-recycles 3 with MEASURED skewed
+#      convergence (--converge-percentile 50 calibrates the tol at the
+#      median recycle-1 delta, so ~half of each batch early-exits at
+#      recycle 1 and the rest outlives it — the freed-rows shape), run
+#      TWICE on the identical schedule: early-exit-only baseline, then
+#      --continuous (freed rows refilled mid-loop from the pending
+#      queue via the row-masked init program). FAILS unless the
+#      continuous run's rows-occupied fraction is STRICTLY above the
+#      baseline's AND its folds/hour is no worse, rows were actually
+#      admitted (row_admissions > 0), every request resolves ok in
+#      both runs (admitted-row numerics are pinned byte-equal in
+#      tests/test_continuous.py), and obs_report --check is clean over
+#      the continuous traces with admit spans present in the
+#      waterfall. The continuous-batching tripwire.
 #   7. multi-chip mesh serving (--mesh-policy, serve.MeshPolicy) under
 #      XLA_FLAGS=--xla_force_host_platform_device_count=8: a mixed
 #      short+long workload where the long bucket is pinned to a 4-chip
@@ -110,7 +125,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 DURATION="${SMOKE_DURATION_S:-30}"
-PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8,9}"
+PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8,9,10}"
 
 phase_on() {
     case ",${PHASES}," in
@@ -498,5 +513,94 @@ print(f"FEATURE SMOKE OK: folds/hour {pipe['folds_per_hour']} > "
       f"{feat['hit_ratio']}, {feat['executions']} featurize execs == "
       f"{pipe['unique_raw_keys']} unique keys, "
       f"{spans['featurize']} featurize spans", file=sys.stderr)
+EOF
+fi
+
+# phase 10: continuous batching — the identical single-bucket workload
+# with measured skewed convergence (median recycle-1 delta as tol: ~half
+# of each batch early-exits at recycle 1), early-exit-only baseline vs
+# --continuous; the continuous run must hold rows occupied strictly
+# above the baseline at folds/hour no worse, with rows actually
+# admitted mid-loop, zero bad outcomes, and orphan-free admit spans
+if phase_on 10; then
+rm -f /tmp/serve_smoke_cont_traces.jsonl
+
+cont_phase() {  # $1 = report path, extra args follow
+    local out="$1"; shift
+    timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+        python tools/serve_loadtest.py \
+        --smoke \
+        --requests 64 \
+        --lengths 24 \
+        --buckets 32 \
+        --msa-depth 3 \
+        --max-batch 4 \
+        --max-wait-ms 10 \
+        --concurrency 8 \
+        --deadline-s 120 \
+        --num-recycles 3 \
+        --recycle-sched \
+        --converge-percentile 50 \
+        "$@" > "$out"
+    cat "$out"
+}
+
+cont_phase /tmp/serve_smoke_cont_base.json \
+    --metrics-path /tmp/serve_smoke_cont_base.jsonl
+cont_phase /tmp/serve_smoke_cont.json \
+    --continuous \
+    --metrics-path /tmp/serve_smoke_cont.jsonl \
+    --trace-path /tmp/serve_smoke_cont_traces.jsonl \
+    --prom-path /tmp/serve_smoke_cont.prom
+
+timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/obs_report.py /tmp/serve_smoke_cont_traces.jsonl \
+    --check --prom /tmp/serve_smoke_cont.prom
+
+env -u PYTHONPATH python - <<'EOF'
+import json, sys
+base = json.load(open("/tmp/serve_smoke_cont_base.json"))
+cont = json.load(open("/tmp/serve_smoke_cont.json"))
+problems = []
+if cont["rows_occupied_fraction"] <= base["rows_occupied_fraction"]:
+    problems.append(
+        f"continuous rows occupied {cont['rows_occupied_fraction']} <= "
+        f"baseline {base['rows_occupied_fraction']}")
+if cont["folds_per_hour"] < base["folds_per_hour"]:
+    problems.append(f"continuous folds/hour {cont['folds_per_hour']} < "
+                    f"baseline {base['folds_per_hour']}")
+if cont.get("row_admissions", 0) <= 0:
+    problems.append("no rows were admitted mid-loop")
+if base.get("row_admissions", 0):
+    problems.append(f"baseline (continuous off) admitted "
+                    f"{base['row_admissions']} rows")
+for rep in (base, cont):
+    bad = rep["shed"] + rep["errors"] + rep["rejected"] + \
+        len(rep["failures"])
+    if bad or rep["served"] == 0:
+        problems.append(f"{bad} bad outcomes / {rep['served']} served "
+                        f"in {'cont' if rep is cont else 'base'} run")
+spans = {}
+for line in open("/tmp/serve_smoke_cont_traces.jsonl"):
+    try:
+        rec = json.loads(line)
+    except ValueError:
+        continue
+    for s in rec.get("spans", ()):
+        spans[s.get("name")] = spans.get(s.get("name"), 0) + 1
+if not spans.get("admit"):
+    problems.append("no admit spans in the continuous traces")
+if problems:
+    print("CONTINUOUS SMOKE FAIL: " + "; ".join(problems),
+          file=sys.stderr)
+    sys.exit(1)
+print(f"CONTINUOUS SMOKE OK: rows occupied "
+      f"{cont['rows_occupied_fraction']} > "
+      f"{base['rows_occupied_fraction']}, folds/hour "
+      f"{cont['folds_per_hour']} >= {base['folds_per_hour']}, "
+      f"{cont['row_admissions']} row admissions "
+      f"({cont['rows_dead_steps']} dead row-steps vs "
+      f"{base['rows_dead_steps']}), {spans['admit']} admit spans",
+      file=sys.stderr)
 EOF
 fi
