@@ -361,14 +361,13 @@ def block_sparse_block_pattern(n_blocks: int, num_global: int = 1,
                                window: int = 1):
     """(n_blocks, n_blocks) bool numpy block pattern: attend within
     +-`window` blocks of the diagonal plus the first `num_global` blocks
-    (global tokens). The single source of the local+global semantics —
-    both the dense mask below and the Pallas kernel plan derive from it,
-    so the two backends cannot diverge."""
-    import numpy as np
-    bi = np.arange(n_blocks)
-    local = np.abs(bi[:, None] - bi[None, :]) <= window
-    glob = (bi < num_global)[:, None] | (bi < num_global)[None, :]
-    return local | glob
+    (global tokens). Delegates to `ops.block_sparse.
+    banded_block_pattern` — the ONE local+global source the dense mask
+    below, the Pallas kernel plan, and the serving KernelPolicy's
+    static masks all share, so no two of them can diverge."""
+    from alphafold2_tpu.ops.block_sparse import banded_block_pattern
+    return banded_block_pattern(n_blocks, window=window,
+                                num_global=num_global)
 
 
 def block_sparse_mask(n: int, block: int = 32, num_global: int = 1,
@@ -388,14 +387,20 @@ class BlockSparseAttention(nn.Module):
     Two compute backends behind ONE params tree (the projections and
     gated output tail live in the inner `Attention`, shared by both):
 
-    - dense + additive mask (default): correct at any size/mask;
     - the true block-skipping Pallas kernel
-      (`ops.block_sparse.block_sparse_attention`, FLOPs ∝ nnz blocks)
-      when `ops.use_pallas_attention(True)` is on and n divides into
-      `block`s. Token masks ride into the kernel as per-key validity
-      (replayed across the folded head axis); masked-query rows are
-      unspecified on both backends. Exactness between the backends:
-      tests/test_ops.py::TestBlockSparseKernel.
+      (`ops.block_sparse.block_sparse_attention`, FLOPs ∝ nnz blocks):
+      the DEFAULT on a TPU backend whenever n divides into `block`s
+      (ISSUE 12 — the documented sparse config must actually skip
+      FLOPs, not just mask them); off-TPU it is opt-in via
+      `ops.use_pallas_attention(True)` (interpreter mode, exactness
+      tests only);
+    - dense + additive mask: the CPU fallback (and the dropout-active
+      training path) — identical attention support, no FLOP skipping.
+
+    Token masks ride into the kernel as per-key validity (replayed
+    across the folded head axis); masked-query rows are unspecified on
+    both backends. Exactness between the backends:
+    tests/test_ops.py::TestBlockSparseKernel.
     """
 
     dim: int
@@ -407,16 +412,31 @@ class BlockSparseAttention(nn.Module):
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
 
+    def _kernel_available(self) -> bool:
+        """True when the FLOP-skipping Pallas kernel should serve this
+        trace: on a TPU backend it is ALWAYS preferred (ISSUE 12 — the
+        old gate made the documented sparse_self_attn config silently
+        pay dense N^2 compute unless the unrelated fused-attention
+        flag was flipped); off-TPU it stays opt-in via
+        `ops.use_pallas_attention(True)` (interpreter mode — exactness
+        tests), so CPU tier-1 keeps the cheap masked-dense fallback."""
+        from alphafold2_tpu.ops.attention import pallas_attention_enabled
+        from alphafold2_tpu.ops.block_sparse import (HAS_PALLAS,
+                                                     on_tpu_backend)
+        if not HAS_PALLAS:
+            return False
+        return on_tpu_backend() or pallas_attention_enabled()
+
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
         from alphafold2_tpu.model.primitives import Attention
-        from alphafold2_tpu.ops.attention import pallas_attention_enabled
         n = x.shape[-2]
         attn = Attention(dim=self.dim, heads=self.heads,
                          dim_head=self.dim_head, dropout=self.dropout,
                          dtype=self.dtype, name="attn")
 
-        if pallas_attention_enabled() and n % self.block == 0 and \
+        use_kernel = self._kernel_available() and n % self.block == 0
+        if use_kernel and \
                 not (self.dropout == 0.0 or deterministic):
             # refuse-to-be-silent: the Pallas kernel has no dropout, so a
             # dropout-active training trace pays full dense n^2 attention
@@ -427,8 +447,7 @@ class BlockSparseAttention(nn.Module):
                 "skipping kernel has no dropout) — the sparse FLOP "
                 "savings do not apply to these steps", RuntimeWarning,
                 stacklevel=2)
-        if pallas_attention_enabled() and n % self.block == 0 and \
-                (self.dropout == 0.0 or deterministic):
+        if use_kernel and (self.dropout == 0.0 or deterministic):
             from alphafold2_tpu.ops.block_sparse import (
                 block_sparse_attention)
             block_pattern = block_sparse_block_pattern(

@@ -103,8 +103,10 @@ class MsaAttentionBlock(nn.Module):
                    attention; the only variant that consumes pair edges);
     - "sparse"   — `BlockSparseAttention` local+global block pattern (the
                    DeepSpeed sparse-self-attn analog, README.md:388-417;
-                   dispatches to the Pallas block-skipping kernel under
-                   `ops.use_pallas_attention(True)`);
+                   dispatches to the Pallas block-skipping kernel on TPU
+                   by default — `ops.use_pallas_attention(True)` opts in
+                   the interpreter-mode kernel off-TPU, otherwise CPU
+                   keeps the masked-dense fallback);
     - "linear"   — kernelized linear attention (Performer slot,
                    README.md:419-449);
     - "compress" — memory-compressed attention, K/V mean-pooled by
@@ -153,6 +155,11 @@ class MsaAttentionBlock(nn.Module):
         x = AxialAttention(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
             row_attn=False, col_attn=True, dropout=self.dropout,
+            # the column track attends ALIGNMENT rows — a serving
+            # KernelSpec's residue-axis block pattern must never apply
+            # here, even when msa_depth happens to equal the bucket
+            # length (ISSUE 12)
+            sparse_kernel_ok=False,
             dtype=self.dtype, name="col_attn",
         )(x, mask=mask, deterministic=deterministic) + x
         return shard_msa(x)

@@ -25,6 +25,7 @@ in via `alphafold2_tpu.ops` once it beats the XLA baseline.
 
 from __future__ import annotations
 
+import contextlib
 import warnings
 
 from typing import Optional
@@ -207,6 +208,63 @@ class Attention(nn.Module):
         else:
             cmask = None
 
+        # serving-side kernel selection (ISSUE 12): a trace-time
+        # KernelSpec (ops/block_sparse.kernel_context — the executor
+        # activates it through predict.fold(kernel=)) reroutes matching
+        # SELF-attention (attended-axis length == spec.n, no context,
+        # no tie_dim) onto the true block-skipping Pallas kernel, pair
+        # bias and key masks riding along unrepeated; its masked-dense
+        # backend applies the same pattern as an additive bias instead
+        # (identical support, no FLOP skip — the CPU fallback and the
+        # numerics reference). Params are untouched either way: the
+        # kernel choice lives in which executable gets compiled.
+        from alphafold2_tpu.ops.block_sparse import active_kernel_spec
+        kspec = active_kernel_spec()
+        n_q, n_k = q.shape[-2], k.shape[-2]
+        if kspec is not None and (has_context or tie_dim is not None
+                                  or n_q != n_k
+                                  or not kspec.covers(n_q)):
+            kspec = None
+        sparse_backend = None
+        if kspec is not None:
+            sparse_backend = kspec.resolve_backend()
+            if sparse_backend == "pallas" and self.dropout > 0.0 \
+                    and not deterministic:
+                # the block-skipping kernel has no dropout; a training
+                # trace keeps the pattern via the masked-dense path
+                # (same refuse-don't-drop convention as the fused
+                # kernel below)
+                sparse_backend = "masked"
+        if sparse_backend == "pallas":
+            from alphafold2_tpu.ops.block_sparse import \
+                block_sparse_attention
+            b_all = q.shape[0]
+            bias_arg = None
+            if attn_bias is not None:
+                bias_arg = jnp.broadcast_to(
+                    attn_bias.astype(jnp.float32),
+                    (b_all // attn_bias_repeat, h, n_q, n_k)
+                ).reshape(-1, n_q, n_k)
+            out = block_sparse_attention(
+                q.reshape(b_all * h, n_q, dh),
+                k.reshape(b_all * h, n_k, dh),
+                v.reshape(b_all * h, n_k, dh),
+                kspec.pattern_array(),
+                bias=bias_arg, bias_repeat=attn_bias_repeat,
+                k_mask=cmask, heads=h,
+                scale=1.0,                # project_qkv pre-scales q
+                block=kspec.block,
+                interpret=kspec.interpret())
+            return self.finish(out.reshape(b_all, h, n_q, dh), x)
+        if sparse_backend == "masked":
+            # the pattern as a broadcastable additive bias: both the
+            # fused-Pallas and XLA dense paths below honor attn_bias,
+            # so the masked backend needs no further branching
+            fill = jnp.where(jnp.asarray(kspec.token_mask()), 0.0,
+                             MASK_VALUE).astype(jnp.float32)[None, None]
+            attn_bias = fill if attn_bias is None else \
+                attn_bias + fill.astype(attn_bias.dtype)
+
         # optional Pallas fused path (bias+mask+softmax+AV in one
         # VMEM-resident kernel; alphafold2_tpu/ops/attention.py). Bias
         # stays *unrepeated* (replayed over the folded axial axis by the
@@ -336,6 +394,13 @@ class AxialAttention(nn.Module):
     global_query_attn: bool = False
     dropout: float = 0.0
     ring_axes: Optional[tuple] = None   # (mesh axis of H, mesh axis of W)
+    # serving kernel selection (ISSUE 12): False suppresses any active
+    # ops.block_sparse KernelSpec for this attention — set on tracks
+    # whose attended axis is NOT the residue axis (the MSA column
+    # attention attends alignment rows; a residue-length pattern
+    # matching its length by coincidence would restrict the wrong
+    # axis). Params are unaffected (non-init field).
+    sparse_kernel_ok: bool = True
     dtype: jnp.dtype = jnp.float32
 
     def _ring_mesh(self, height, width):
@@ -451,12 +516,20 @@ class AxialAttention(nn.Module):
 
         tie_dim = axial_dim if self.global_query_attn else None
 
-        out = Attention(
-            dim=self.dim, heads=self.heads, dim_head=self.dim_head,
-            dropout=self.dropout, dtype=self.dtype, name="attn",
-        )(x_fold, mask=mask_fold, attn_bias=attn_bias, tie_dim=tie_dim,
-          attn_bias_repeat=axial_dim if attn_bias is not None else 1,
-          deterministic=deterministic)
+        from alphafold2_tpu.ops.block_sparse import (active_kernel_spec,
+                                                     kernel_context)
+        ctx = kernel_context(None) if (not self.sparse_kernel_ok
+                                       and active_kernel_spec()
+                                       is not None) \
+            else contextlib.nullcontext()
+        with ctx:
+            out = Attention(
+                dim=self.dim, heads=self.heads, dim_head=self.dim_head,
+                dropout=self.dropout, dtype=self.dtype, name="attn",
+            )(x_fold, mask=mask_fold, attn_bias=attn_bias,
+              tie_dim=tie_dim,
+              attn_bias_repeat=axial_dim if attn_bias is not None else 1,
+              deterministic=deterministic)
 
         if self.col_attn:
             out = out.reshape(b, width, height, d).swapaxes(1, 2)

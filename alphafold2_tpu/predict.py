@@ -55,6 +55,7 @@ def fold(
     mask: Optional[jnp.ndarray] = None,
     msa_mask: Optional[jnp.ndarray] = None,
     num_recycles: int = DEFAULT_NUM_RECYCLES,
+    kernel=None,
     **extra,
 ) -> FoldResult:
     """Run the model with `num_recycles` recycling iterations.
@@ -62,6 +63,13 @@ def fold(
     `model` must be constructed with predict_coords=True. Jit-safe: wrap
     in jax.jit(partial(fold, model), static_argnames='num_recycles') or
     call under jit via a closure.
+
+    kernel: optional `ops.block_sparse.KernelSpec` — routes the trunk's
+    residue-axis self-attention through the block-skipping Pallas
+    kernel (or its masked-dense fallback) for this trace (ISSUE 12).
+    STATIC: bake it into the jitted closure like num_recycles; the
+    serving executor keys executables by its label. None (default) is
+    byte-for-byte the dense path.
     """
     assert model.predict_coords, "fold() needs predict_coords=True"
 
@@ -70,7 +78,7 @@ def fold(
         # (fold_init/fold_step) trace, so the step-loop == scan
         # exactness contract cannot drift between two call sites
         return _one_pass(model, params, seq, msa, mask, msa_mask,
-                         recyclables, extra)
+                         recyclables, extra, kernel=kernel)
 
     # first pass has no recyclables (params cover both traces via the
     # init-time branch coverage)
@@ -98,7 +106,7 @@ def fold(
 
 
 def _one_pass(model, params, seq, msa, mask, msa_mask, recyclables,
-              extra):
+              extra, kernel=None):
     """One trunk+structure pass — THE call fold()'s closure and the
     step-mode entry points (fold_init/fold_step) all trace, so the
     step-loop == scan exactness contract cannot drift between call
@@ -106,12 +114,26 @@ def _one_pass(model, params, seq, msa, mask, msa_mask, recyclables,
     split_rngs give each layer an INDEPENDENT FAVOR+ projection at
     inference (per-layer estimator errors average out instead of
     adding coherently); unused collections are harmless for models
-    without Performer layers."""
-    return model.apply(
-        params, seq, msa=msa, mask=mask, msa_mask=msa_mask,
-        recyclables=recyclables, return_aux_logits=True,
-        return_recyclables=True,
-        rngs={"performer": jax.random.PRNGKey(0)}, **extra)
+    without Performer layers.
+
+    `kernel` (a static ops.block_sparse.KernelSpec) activates the
+    serving kernel-selection context for exactly this trace: the
+    model's residue-axis self-attention reads it at trace time and
+    dispatches to the block-sparse kernel; the spec never reaches
+    model.apply as an argument, so the params/trace signature is
+    unchanged."""
+    import contextlib
+
+    from alphafold2_tpu.ops.block_sparse import kernel_context
+
+    ctx = kernel_context(kernel) if kernel is not None \
+        else contextlib.nullcontext()
+    with ctx:
+        return model.apply(
+            params, seq, msa=msa, mask=mask, msa_mask=msa_mask,
+            recyclables=recyclables, return_aux_logits=True,
+            return_recyclables=True,
+            rngs={"performer": jax.random.PRNGKey(0)}, **extra)
 
 
 def _step_state(coords, ret) -> FoldStepState:
@@ -120,7 +142,7 @@ def _step_state(coords, ret) -> FoldStepState:
 
 
 def fold_init(model, params, seq, msa=None, mask=None, msa_mask=None,
-              **extra) -> FoldStepState:
+              kernel=None, **extra) -> FoldStepState:
     """The embed+first-pass executable of step-mode folding: exactly
     fold(..., num_recycles=0), but returning a FoldStepState whose
     `recyclables` seed `fold_step`. Jit-safe the same way fold() is.
@@ -137,12 +159,12 @@ def fold_init(model, params, seq, msa=None, mask=None, msa_mask=None,
     is not covered."""
     assert model.predict_coords, "fold_init() needs predict_coords=True"
     coords, ret = _one_pass(model, params, seq, msa, mask, msa_mask,
-                            None, extra)
+                            None, extra, kernel=kernel)
     return _step_state(coords, ret)
 
 
 def fold_init_rows(model, params, seq, row_mask, state: FoldStepState,
-                   msa=None, mask=None, msa_mask=None,
+                   msa=None, mask=None, msa_mask=None, kernel=None,
                    **extra) -> FoldStepState:
     """Row-masked init: the continuous-batching admission program
     (ISSUE 11). Rows where `row_mask` is True are (re)initialized from
@@ -163,7 +185,7 @@ def fold_init_rows(model, params, seq, row_mask, state: FoldStepState,
     state: the carried FoldStepState whose non-admitted rows survive.
     """
     fresh = fold_init(model, params, seq, msa=msa, mask=mask,
-                      msa_mask=msa_mask, **extra)
+                      msa_mask=msa_mask, kernel=kernel, **extra)
 
     def sel(new, old):
         m = jnp.reshape(row_mask, row_mask.shape
@@ -174,13 +196,17 @@ def fold_init_rows(model, params, seq, row_mask, state: FoldStepState,
 
 
 def fold_step(model, params, seq, recyclables: Recyclables, msa=None,
-              mask=None, msa_mask=None, **extra) -> FoldStepState:
+              mask=None, msa_mask=None, kernel=None,
+              **extra) -> FoldStepState:
     """One recycle iteration: the `lax.scan` body of fold() as its own
     executable. Feed it the previous state's `recyclables` (from
-    fold_init or an earlier fold_step)."""
+    fold_init or an earlier fold_step). `kernel` may DIFFER from the
+    init pass's spec — the contact-prior flow (ISSUE 12) re-plans the
+    block mask from the recycle-1 pair activations and runs the
+    remaining recycles under the re-lowered step executable."""
     assert model.predict_coords, "fold_step() needs predict_coords=True"
     coords, ret = _one_pass(model, params, seq, msa, mask, msa_mask,
-                            recyclables, extra)
+                            recyclables, extra, kernel=kernel)
     return _step_state(coords, ret)
 
 

@@ -1,0 +1,259 @@
+"""Per-bucket attention-kernel selection for serving (ISSUE 12).
+
+Round 5's keep-or-kill measured the block-sparse Pallas kernel
+(`ops/block_sparse.py`) beating the XLA dense path once the live block
+fraction drops far enough (`tools/tpu_blocksparse.json`: ~1.15x at 29%
+live blocks, parity around 50%, a loss above that — sparsity only pays
+when there is enough of it), yet every serving fold still compiled the
+dense path. `KernelPolicy` makes the kernel a first-class serving
+decision, the same shape as PR 7's `MeshPolicy`:
+
+- each length BUCKET maps to "dense" or "blocksparse". Short buckets
+  stay dense (their banded pattern is mostly live — the kernel's grid
+  overhead buys nothing); long buckets route onto the block-skipping
+  kernel with a static banded+global first-pass mask;
+- with `contact_priors=True`, a step-scheduled batch (RecyclePolicy —
+  the loop the scheduler already owns) re-plans its mask after the
+  first pass from the PAIR ACTIVATIONS the fold itself produced: the
+  recycle-1 distogram gives per-target contact probabilities, blocks
+  whose max contact probability clears the threshold stay live, and the
+  remaining recycles run under a re-lowered step executable
+  (`ops.block_sparse.contact_block_pattern` plans host-side,
+  `plan_block_pattern` compresses; the ExecKey's kernel element makes
+  the re-lower automatic and staleness impossible). A degenerate plan —
+  nearly every block live — falls back to the DENSE kernel: masking
+  95% live blocks pays kernel overhead for no FLOP savings;
+- the choice is baked into the `FoldExecutor`'s ExecKey (8-tuple, see
+  MIGRATING ISSUE-12) and pre-compiled by `Scheduler.warmup()`, so a
+  policy flip or rollout can never serve a stale executable and the
+  first sparse fold never pays a mid-serving compile.
+
+`Scheduler(kernel_policy=None)` — the default — is byte-for-byte the
+dense-only behavior (scrubbed serve_stats identity, like every prior
+feature).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Mapping, Optional, Sequence
+
+import numpy as np
+
+from alphafold2_tpu.ops.block_sparse import (KernelSpec,
+                                             contact_block_pattern,
+                                             contact_probs_from_distogram)
+
+DENSE = "dense"
+BLOCKSPARSE = "blocksparse"
+
+
+@dataclass
+class KernelPolicy:
+    """Bucket edge -> attention-kernel choice.
+
+    table: {bucket_len: "dense" | "blocksparse"}. Buckets not in the
+        table serve dense. A "blocksparse" bucket not divisible by
+        `block` also serves dense (refuse-don't-crash; the snapshot
+        says so).
+    block: token block size of the sparse pattern. 128 matches the TPU
+        lane width and the benched configs in tpu_blocksparse.json;
+        tests use smaller blocks on tiny buckets.
+    window / num_global: the static banded+global first-pass mask
+        (same semantics as model.attention_variants
+        block_sparse_block_pattern — +-window blocks of the diagonal
+        plus num_global global blocks).
+    backend: "auto" (Pallas kernel on TPU, masked-dense fallback on
+        CPU), "pallas" (force; interpret off-TPU — tests/smoke
+        numerics), "masked" (dense+mask everywhere — the numerics
+        reference).
+    contact_priors: re-plan each step-scheduled batch's mask from its
+        own recycle-1 distogram (see module docstring). Requires the
+        scheduler to run step mode (RecyclePolicy); opaque folds keep
+        the static mask. Each distinct planned pattern is a distinct
+        executable — expect one extra lowering per batch whose pattern
+        is novel; off by default.
+    contact_cutoff: contact distance (Angstrom) for
+        P(d < cutoff) from the distogram.
+    contact_threshold: a block stays live when its max cell contact
+        probability clears this.
+    contact_live_frac: alternatively, keep the top fraction of blocks
+        by contact score (a data-independent FLOP budget); overrides
+        contact_threshold when set.
+    dense_fallback_frac: a planned pattern whose live fraction is >=
+        this serves the DENSE kernel instead (degenerate all-dense
+        pattern — sparse overhead for no savings). Applies to the
+        static mask too.
+    """
+
+    table: Mapping[int, str] = field(default_factory=dict)
+    block: int = 128
+    window: int = 1
+    num_global: int = 1
+    backend: str = "auto"
+    contact_priors: bool = False
+    contact_cutoff: float = 8.0
+    contact_threshold: float = 0.5
+    contact_live_frac: Optional[float] = None
+    dense_fallback_frac: float = 0.95
+
+    def __post_init__(self):
+        self.table = {int(k): str(v) for k, v in dict(self.table).items()}
+        for edge, kind in self.table.items():
+            if kind not in (DENSE, BLOCKSPARSE):
+                raise ValueError(
+                    f"bucket {edge}: unknown kernel {kind!r} "
+                    f"(want '{DENSE}' or '{BLOCKSPARSE}')")
+        if self.block < 1:
+            raise ValueError(f"block must be >= 1, got {self.block}")
+        self._specs: Dict[int, Optional[KernelSpec]] = {}
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def from_buckets(cls, edges: Sequence[int],
+                     min_sparse_len: Optional[int] = None,
+                     sparse_live_frac: Optional[float] = None,
+                     **kw) -> "KernelPolicy":
+        """The auto policy: route a bucket blocksparse when its STATIC
+        banded+global pattern is sparse enough to win — live fraction
+        <= `sparse_live_frac` (default 0.5: tpu_blocksparse.json shows
+        ~parity at 53% live and a clear win at 29%, so at or below half
+        live the kernel is at worst free and strictly better as length
+        grows). `min_sparse_len` instead pins a simple length floor."""
+        pol = cls(**kw)
+        if sparse_live_frac is None:
+            sparse_live_frac = 0.5
+        table = {}
+        for edge in edges:
+            edge = int(edge)
+            if min_sparse_len is not None:
+                table[edge] = BLOCKSPARSE if edge >= min_sparse_len \
+                    else DENSE
+                continue
+            if edge % pol.block:
+                table[edge] = DENSE
+                continue
+            spec = KernelSpec.banded(edge, pol.block, pol.window,
+                                     pol.num_global, backend=pol.backend)
+            table[edge] = BLOCKSPARSE \
+                if spec.live_fraction <= sparse_live_frac else DENSE
+        pol.table = table
+        return pol
+
+    @classmethod
+    def parse(cls, spec: str, edges: Sequence[int], block: int = 128,
+              sparse_live_frac: Optional[float] = None,
+              backend: str = "auto", window: int = 1,
+              num_global: int = 1,
+              contact_priors: bool = False) -> Optional["KernelPolicy"]:
+        """The shared CLI surface (`serve_loadtest --kernel-policy`):
+
+        - ""            -> None (feature off, byte-identical serving)
+        - "dense"       -> a policy routing every bucket dense (the
+                           machinery runs — kernel stats, ExecKey
+                           labels — but every fold compiles dense)
+        - "blocksparse" -> every divisible bucket sparse
+        - "auto"        -> from_buckets(sparse_live_frac=...)
+        - "64=dense,512=blocksparse" -> explicit per-bucket pins
+        """
+        spec = (spec or "").strip()
+        if not spec:
+            return None
+        kw = dict(block=block, backend=backend, window=window,
+                  num_global=num_global, contact_priors=contact_priors)
+        if spec == "auto":
+            return cls.from_buckets(edges,
+                                    sparse_live_frac=sparse_live_frac,
+                                    **kw)
+        if spec in (DENSE, BLOCKSPARSE):
+            return cls(table={int(e): spec for e in edges}, **kw)
+        table = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            edge, _, kind = part.partition("=")
+            kind = kind.strip() or BLOCKSPARSE
+            if kind in ("sparse", "bs"):
+                kind = BLOCKSPARSE
+            table[int(edge)] = kind
+        return cls(table=table, **kw)
+
+    @classmethod
+    def from_model_config(cls, model_config, edges: Sequence[int],
+                          **kw) -> "KernelPolicy":
+        """Thread the one set of sparse knobs the config tree already
+        has (config.ModelConfig.sparse_kwargs — the same block/global/
+        window the model-level sparse_self_attn menu uses) into the
+        serving policy, so the two layers cannot drift."""
+        sk = model_config.sparse_kwargs()
+        kw.setdefault("block", sk["block"])
+        kw.setdefault("num_global", sk["num_global"])
+        kw.setdefault("window", sk["window"])
+        return cls.from_buckets(edges, **kw)
+
+    # -- selection --------------------------------------------------------
+
+    def kernel_for(self, bucket_len: int) -> str:
+        """"dense" | "blocksparse" — what this bucket actually serves
+        (a blocksparse entry the block size cannot tile, or whose
+        static pattern is degenerately dense, answers "dense")."""
+        return DENSE if self.spec_for(bucket_len) is None else BLOCKSPARSE
+
+    def spec_for(self, bucket_len: int) -> Optional[KernelSpec]:
+        """The static first-pass KernelSpec for a bucket (memoized), or
+        None for dense."""
+        bucket_len = int(bucket_len)
+        if bucket_len in self._specs:
+            return self._specs[bucket_len]
+        spec = None
+        if self.table.get(bucket_len) == BLOCKSPARSE \
+                and bucket_len % self.block == 0:
+            cand = KernelSpec.banded(bucket_len, self.block, self.window,
+                                     self.num_global,
+                                     backend=self.backend)
+            if cand.live_fraction < self.dense_fallback_frac:
+                spec = cand
+        self._specs[bucket_len] = spec
+        return spec
+
+    def contact_spec_for(self, bucket_len: int,
+                         distogram: np.ndarray
+                         ) -> Optional[KernelSpec]:
+        """Plan a per-target contact-prior KernelSpec from recycle-1
+        distogram logits ((b, n, n, buckets) — the batch shares one
+        executable, so the plan keeps any block ANY element needs).
+        None = run the remaining recycles DENSE: the bucket is not
+        sparse-routed, or the planned pattern is degenerately live
+        (the all-dense fallback — sparse overhead for no savings)."""
+        if self.spec_for(bucket_len) is None:
+            return None
+        contacts = contact_probs_from_distogram(
+            np.asarray(distogram), cutoff=self.contact_cutoff)
+        pattern = contact_block_pattern(
+            contacts, self.block, threshold=self.contact_threshold,
+            live_frac=self.contact_live_frac, window=self.window,
+            num_global=self.num_global)
+        if pattern.mean() >= self.dense_fallback_frac:
+            return None
+        return KernelSpec.from_pattern(pattern, self.block,
+                                       backend=self.backend,
+                                       source="contact")
+
+    # -- views ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        live = {}
+        for edge in sorted(self.table):
+            spec = self.spec_for(edge)
+            live[str(edge)] = {
+                "kernel": DENSE if spec is None else BLOCKSPARSE,
+                "live_frac": (None if spec is None
+                              else round(spec.live_fraction, 4)),
+                "label": None if spec is None else spec.label,
+            }
+        return {"block": self.block, "window": self.window,
+                "num_global": self.num_global, "backend": self.backend,
+                "contact_priors": self.contact_priors,
+                "buckets": live}

@@ -296,6 +296,17 @@ class Scheduler:
         one step at a time: early-exit on convergence, preemption
         between recycles, progressive results — see the module
         docstring and serve/recycle.py.
+    kernel_policy: optional serve.kernelpolicy.KernelPolicy (OFF when
+        None — the default, byte-for-byte the dense-only serving
+        path). Per-bucket attention-kernel routing (ISSUE 12): short
+        buckets compile the dense path, long buckets the block-sparse
+        Pallas kernel with a static banded+global mask; with
+        `contact_priors=True` under a recycle policy, each batch
+        re-plans its mask from its own recycle-1 distogram and the
+        remaining recycles run the re-lowered step executable. The
+        kernel choice is an ExecKey element, so a policy flip can
+        never serve a stale executable, and warmup() pre-compiles each
+        bucket's chosen kernel.
     """
 
     def __init__(self, executor: FoldExecutor, buckets: BucketPolicy,
@@ -311,7 +322,8 @@ class Scheduler:
                  quarantine_path: Optional[str] = None,
                  mesh_policy: Optional[MeshPolicy] = None,
                  recycle_policy: Optional[RecyclePolicy] = None,
-                 feature_pool=None):
+                 feature_pool=None,
+                 kernel_policy=None):
         self.executor = executor
         # two-stage pipeline front (serve.features.FeaturePool — OFF
         # when None, the default, which keeps submit_raw featurizing
@@ -436,6 +448,20 @@ class Scheduler:
                 executor.max_entries = max(
                     executor.max_entries,
                     per_bucket * len(self.buckets.edges))
+        # per-bucket attention-kernel routing (ISSUE 12) — nothing below
+        # touches the serving path when the policy is None
+        self.kernel_policy = kernel_policy
+        self._kernel_served: Dict[Tuple[str, int], int] = {}
+        self._kernel_batches: Dict[Tuple[str, int], int] = {}
+        if kernel_policy is not None:
+            self._c_kernel_folds = reg.counter(
+                "serve_kernel_folds_total",
+                "requests served, by attention kernel and bucket",
+                ("kernel", "bucket"))
+            self._c_kernel_replans = reg.counter(
+                "serve_kernel_contact_replans_total",
+                "step loops whose block mask was re-planned from "
+                "recycle-1 contact priors (re-lowered step executable)")
         if self.config.parked_bytes_budget > 0 or cache is not None:
             self._c_parked_admits = reg.counter(
                 "serve_parked_admits_total",
@@ -650,8 +676,16 @@ class Scheduler:
         step_mode = self._use_step_loop()
         continuous = self._use_continuous()
         if self._allocator is None:
-            return self.executor.warmup(keys, step_mode=step_mode,
-                                        continuous=continuous)
+            if self.kernel_policy is None:
+                return self.executor.warmup(keys, step_mode=step_mode,
+                                            continuous=continuous)
+            # per-bucket kernel routing (ISSUE 12): warm the executable
+            # each bucket will ACTUALLY serve — a sparse-routed bucket
+            # compiled dense here would still pay its kernel compile on
+            # the first real request
+            return sum(self.executor.warmup(
+                [key], step_mode=step_mode, continuous=continuous,
+                kernel=self._kernel_spec_for(key[0])) for key in keys)
         fresh = 0
         for key in keys:
             if not self.mesh_policy.admits(
@@ -661,10 +695,12 @@ class Scheduler:
                 continue     # the guard rejects this bucket at submit;
                 #              compiling it would be the OOM we prevent
             shape = self.mesh_policy.shape_for(key[0])
+            k_kw = {} if self.kernel_policy is None else \
+                {"kernel": self._kernel_spec_for(key[0])}
             for devices in self._allocator.slices(shape):
                 fresh += self.executor.warmup(
                     [key], devices=devices, mesh_shape=shape,
-                    step_mode=step_mode, continuous=continuous)
+                    step_mode=step_mode, continuous=continuous, **k_kw)
         return fresh
 
     def _use_step_loop(self) -> bool:
@@ -677,6 +713,33 @@ class Scheduler:
         asked for it."""
         return self._use_step_loop() and self.recycle_policy.continuous \
             and hasattr(self.executor, "run_init_rows")
+
+    # -- kernel selection (ISSUE 12) -------------------------------------
+
+    def _kernel_spec_for(self, bucket_len: int):
+        """The static first-pass KernelSpec this bucket serves under
+        the kernel policy (None = dense / policy off)."""
+        if self.kernel_policy is None:
+            return None
+        return self.kernel_policy.spec_for(bucket_len)
+
+    def _record_kernel_batch(self, bucket_len: int, spec, n_served: int,
+                             contact: bool = False):
+        """Per-(kernel, bucket) accounting for one executed batch.
+        No-op without a policy — `serve_stats()` stays byte-identical."""
+        if self.kernel_policy is None:
+            return
+        kind = "dense" if spec is None else "blocksparse"
+        if contact:
+            kind += "-contact"
+        key = (kind, bucket_len)
+        with self._cond:
+            self._kernel_served[key] = \
+                self._kernel_served.get(key, 0) + n_served
+            self._kernel_batches[key] = \
+                self._kernel_batches.get(key, 0) + 1
+        self._c_kernel_folds.inc(n_served, kernel=kind,
+                                 bucket=str(bucket_len))
 
     # -- submission ------------------------------------------------------
 
@@ -1371,6 +1434,16 @@ class Scheduler:
                 rows_occupied_fraction=(
                     self._row_steps_live / row_steps if row_steps
                     else 0.0))
+        if self.kernel_policy is not None:
+            with self._cond:
+                folds = {f"{kind}:{bucket}":
+                         {"batches": self._kernel_batches.get(
+                             (kind, bucket), 0),
+                          "served": served}
+                         for (kind, bucket), served
+                         in sorted(self._kernel_served.items())}
+            stats["kernel"] = dict(self.kernel_policy.snapshot(),
+                                   folds=folds)
         if self.feature_pool is not None:
             stats["featurize"] = self.feature_pool.snapshot()
         with self._cond:
@@ -1707,7 +1780,9 @@ class Scheduler:
                 batch, waste = self.buckets.assemble(
                     [e.request for e in entries], bucket_len,
                     cfg.max_batch_size, msa_depth=cfg.msa_depth)
-            result = self._run_executor(batch, batch_trace, lease)
+            kspec = self._kernel_spec_for(bucket_len)
+            result = self._run_executor(batch, batch_trace, lease,
+                                        kernel=kspec)
             coords = np.asarray(result.coords)
             confidence = np.asarray(result.confidence)
         except Exception as exc:  # resolve/retry, never kill the worker
@@ -1779,6 +1854,7 @@ class Scheduler:
             return
         if lease is not None:
             self._c_mesh_folds.inc(mesh=lease.label)
+        self._record_kernel_batch(bucket_len, kspec, len(entries))
         with self._cond:
             if lease is not None:
                 self._mesh_batches[lease.label] = \
@@ -1870,10 +1946,48 @@ class Scheduler:
                 batch, waste = self.buckets.assemble(
                     [e.request for e in entries], bucket_len,
                     cfg.max_batch_size, msa_depth=cfg.msa_depth)
+            # kernel routing (ISSUE 12): the init pass always runs the
+            # bucket's STATIC first-pass spec (warmup pre-compiled it);
+            # step_kernel is what the remaining recycles run — the
+            # contact-prior flow below may re-plan it per target
+            kspec = self._kernel_spec_for(bucket_len)
+            init_kw = {} if kspec is None else {"kernel": kspec}
+            step_kernel = kspec
+            contact_planned = False
             state = self._run_step_guarded(
                 lambda: self.executor.run_init(
                     batch, trace=batch_trace, devices=devices,
-                    mesh_shape=mesh_shape))
+                    mesh_shape=mesh_shape, **init_kw))
+
+            def _plan_contact(st, members):
+                """Re-plan the step mask from the batch's OWN pair
+                activations (the recycle-1 distogram st carries): the
+                remaining recycles run a re-lowered step executable
+                under the planned pattern — or DENSE when the plan
+                degenerates to nearly-all-live. Planning trouble keeps
+                the static mask (an observability loss, never a
+                serving one)."""
+                try:
+                    planned = self.kernel_policy.contact_spec_for(
+                        bucket_len, np.asarray(st.distogram))
+                except Exception:
+                    return kspec, False
+                self._c_kernel_replans.inc()
+                for e in members:
+                    e.trace.event(
+                        "kernel_contact_replan",
+                        kernel=("dense" if planned is None
+                                else planned.label),
+                        live_frac=(1.0 if planned is None
+                                   else round(planned.live_fraction,
+                                              4)))
+                return planned, True
+
+            if self.kernel_policy is not None \
+                    and self.kernel_policy.contact_priors \
+                    and kspec is not None:
+                step_kernel, contact_planned = _plan_contact(state,
+                                                             active)
             # the per-step device-to-host fetch exists for convergence
             # deltas and streaming; a preemption-only policy needs
             # neither, so it pays one fetch at the end like the opaque
@@ -1907,6 +2021,8 @@ class Scheduler:
                     step_kw["span_attrs"] = {
                         "rows_live": len(active),
                         "rows_total": cfg.max_batch_size}
+                if step_kernel is not None:
+                    step_kw["kernel"] = step_kernel
                 state = self._run_step_guarded(
                     lambda st=state, rr=r, kw=step_kw:
                     self.executor.run_step(batch, st, rr, **kw))
@@ -2022,7 +2138,19 @@ class Scheduler:
                     batch, state, admitted = self._admit_rows(
                         bucket_len, batch, state, active, rows, ages,
                         all_members, devices, mesh_shape,
-                        inline=lease is None, gap=r)
+                        inline=lease is None, gap=r, kernel=kspec)
+                    if admitted and contact_planned:
+                        # admitted rows' first pass just landed in the
+                        # distogram: re-plan so the mask covers THEIR
+                        # contacts too, not just the founders'. A
+                        # FAILED re-plan keeps the current contact
+                        # spec (still valid for survivor rows) rather
+                        # than silently widening back to the static
+                        # mask while the batch stays accounted as
+                        # contact-planned.
+                        new_kernel, ok = _plan_contact(state, admitted)
+                        if ok:
+                            step_kernel = new_kernel
                     if admitted and fetch_steps:
                         # refresh the prev snapshot NOW: an admitted
                         # row's first delta must compare its own
@@ -2067,6 +2195,8 @@ class Scheduler:
              else self._breaker.record_success)()
         if lease is not None:
             self._c_mesh_folds.inc(mesh=lease.label)
+        self._record_kernel_batch(bucket_len, kspec, len(all_members),
+                                  contact=contact_planned)
         with self._cond:
             if lease is not None:
                 self._mesh_batches[lease.label] = \
@@ -2199,7 +2329,8 @@ class Scheduler:
     def _admit_rows(self, bucket_len: int, batch: dict, state,
                     active: List[_Entry], rows: List[int],
                     ages: List[int], all_members: List[_Entry],
-                    devices, mesh_shape, inline: bool, gap: int):
+                    devices, mesh_shape, inline: bool, gap: int,
+                    kernel=None):
         """Refill free batch rows mid-recycle (continuous batching,
         ISSUE 11). Candidates come off the pending queue in deadline/
         priority order and pass the same front submit() runs: a result-
@@ -2340,10 +2471,14 @@ class Scheduler:
             row_mask[row] = True
         admit_trace = (MultiTrace([e.trace for e in admitted])
                        if self.tracer.enabled else NULL_TRACE)
+        # admission runs the bucket's STATIC first-pass spec (the one
+        # warmup pre-compiled) — a contact-planned step spec describes
+        # the founders' contacts, not a newly admitted target's
+        admit_kw = {} if kernel is None else {"kernel": kernel}
         state = self._run_step_guarded(
             lambda: self.executor.run_init_rows(
                 new_batch, state, row_mask, trace=admit_trace,
-                devices=devices, mesh_shape=mesh_shape))
+                devices=devices, mesh_shape=mesh_shape, **admit_kw))
         return new_batch, state, admitted
 
     def _retire_entry(self, e: _Entry, bucket_len: int, coords_row,
@@ -2624,18 +2759,20 @@ class Scheduler:
     # -- resilience: worker side -----------------------------------------
 
     def _run_executor(self, batch: dict, batch_trace,
-                      lease: Optional[SliceLease] = None):
+                      lease: Optional[SliceLease] = None, kernel=None):
         """executor.run with the optional per-batch watchdog deadline.
-        The trace/devices kwargs are only passed when in use, so
-        alternate executors (tests) needn't know about obs or meshes;
-        `self.executor` is read inside the closure so a rebuild between
-        batches takes effect immediately."""
+        The trace/devices/kernel kwargs are only passed when in use, so
+        alternate executors (tests) needn't know about obs, meshes, or
+        kernel policies; `self.executor` is read inside the closure so
+        a rebuild between batches takes effect immediately."""
         kw = {}
         if batch_trace is not NULL_TRACE:
             kw["trace"] = batch_trace
         if lease is not None:
             kw["devices"] = lease.devices
             kw["mesh_shape"] = lease.shape
+        if kernel is not None:
+            kw["kernel"] = kernel
         if kw:
             call = lambda: self.executor.run(  # noqa: E731
                 batch, self.config.num_recycles, **kw)

@@ -1,0 +1,487 @@
+"""Per-bucket kernel selection tests (ISSUE 12): KernelSpec/
+contact-prior mask planning units, the executor's 8-tuple ExecKey
+kernel element (stale-kernel staleness regression), serving-level
+numerics equivalence of the block-sparse kernel vs the dense path
+(executor + end-to-end scheduler), the kernel_policy=None
+scrubbed-stats identity pin, the contact-prior step re-lowering flow,
+and KernelPolicy.parse / config threading."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import perturb_params
+from alphafold2_tpu import Alphafold2
+from alphafold2_tpu.config import ModelConfig
+from alphafold2_tpu.data.synthetic import synthetic_requests
+from alphafold2_tpu.obs.registry import MetricsRegistry
+from alphafold2_tpu.ops.block_sparse import (KernelSpec,
+                                             contact_block_pattern,
+                                             contact_probs_from_distogram,
+                                             plan_block_pattern)
+from alphafold2_tpu.serve import (BucketPolicy, FoldExecutor,
+                                  FoldRequest, KernelPolicy,
+                                  RecyclePolicy, Scheduler,
+                                  SchedulerConfig, ServeMetrics)
+
+MSA_DEPTH = 3
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = Alphafold2(dim=32, depth=1, heads=2, dim_head=16,
+                       predict_coords=True, structure_module_depth=1)
+    n = 16
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, n), jnp.int32),
+        msa=jnp.zeros((1, MSA_DEPTH, n), jnp.int32),
+        mask=jnp.ones((1, n), bool),
+        msa_mask=jnp.ones((1, MSA_DEPTH, n), bool))
+    # zero-init output projections make every backend trivially equal;
+    # perturb so numerics comparisons actually compare attention paths
+    return model, perturb_params(params, jax.random.PRNGKey(5))
+
+
+def requests_of(lengths, key=1):
+    return synthetic_requests(jax.random.PRNGKey(key),
+                              num=len(lengths), lengths=lengths,
+                              msa_depth=MSA_DEPTH)
+
+
+def _scheduler(model_and_params, buckets=(16,), num_recycles=1,
+               max_entries=16, **kw):
+    kw.setdefault("metrics", ServeMetrics(registry=MetricsRegistry()))
+    kw.setdefault("registry", MetricsRegistry())
+    ex = FoldExecutor(*model_and_params, max_entries=max_entries)
+    return Scheduler(
+        ex, BucketPolicy(buckets),
+        SchedulerConfig(max_batch_size=2, max_wait_ms=10.0,
+                        num_recycles=num_recycles, msa_depth=MSA_DEPTH),
+        **kw)
+
+
+ALL_LIVE = dict(window=16, num_global=1)    # every block live
+SPARSE = dict(window=0, num_global=0)       # diagonal only
+
+
+class TestKernelSpec:
+    @pytest.mark.quick
+    def test_banded_spec_basics(self):
+        spec = KernelSpec.banded(32, 8, window=1, num_global=1)
+        assert spec.n == 32 and spec.covers(32) and not spec.covers(16)
+        assert 0.0 < spec.live_fraction < 1.0
+        # hashable + stable label; backend is part of the label (a
+        # masked and a pallas build of the same pattern are different
+        # compiled programs)
+        assert hash(spec) == hash(KernelSpec.banded(32, 8))
+        assert spec.label == KernelSpec.banded(32, 8).label
+        assert spec.label != KernelSpec.banded(
+            32, 8, backend="masked").label
+        assert spec.label != KernelSpec.banded(32, 8, window=2).label
+
+    @pytest.mark.quick
+    def test_spec_refuses_empty_row(self):
+        bad = np.zeros((2, 2), bool)
+        bad[0, 0] = True                    # row 1 has no live block
+        with pytest.raises(ValueError):
+            KernelSpec.from_pattern(bad, 8)
+
+    @pytest.mark.quick
+    def test_token_mask_expands_pattern(self):
+        spec = KernelSpec.banded(16, 8, **SPARSE)
+        tok = spec.token_mask()
+        assert tok.shape == (16, 16)
+        assert tok[:8, :8].all() and not tok[:8, 8:].any()
+
+    @pytest.mark.quick
+    def test_resolve_backend_cpu(self):
+        # CPU: auto never silently picks the interpret-mode kernel
+        assert KernelSpec.banded(16, 8).resolve_backend() == "masked"
+        assert KernelSpec.banded(
+            16, 8, backend="pallas").resolve_backend() == "pallas"
+
+
+class TestContactPlanning:
+    @pytest.mark.quick
+    def test_zero_contacts_keep_min_one_live_block(self):
+        """min-1-live guard: even a contact map with NO contacts plans
+        a pattern every q-block can softmax over (the diagonal band),
+        so plan_block_pattern never sees an empty row."""
+        pattern = contact_block_pattern(np.zeros((32, 32)), 8,
+                                        window=0, num_global=0)
+        assert pattern.diagonal().all()
+        cols, valid = plan_block_pattern(pattern)   # would raise
+        assert valid[:, 0].all()
+
+    @pytest.mark.quick
+    def test_contacts_add_support_and_symmetrize(self):
+        contacts = np.zeros((32, 32))
+        contacts[2, 28] = 0.9               # one off-diagonal contact
+        pattern = contact_block_pattern(contacts, 8, threshold=0.5,
+                                        window=0, num_global=0)
+        assert pattern[0, 3] and pattern[3, 0]     # symmetrized
+        assert not pattern[1, 3]
+
+    @pytest.mark.quick
+    def test_live_frac_budget_mode(self):
+        rng = np.random.default_rng(0)
+        contacts = rng.uniform(size=(64, 64))
+        pattern = contact_block_pattern(contacts, 8, live_frac=0.25,
+                                        window=0, num_global=0)
+        # the diagonal floor and symmetrization only ADD support over
+        # the 25% budget (worst case: budget doubled + diagonal)
+        assert 0.25 <= pattern.mean() <= 0.25 * 2 + 0.125
+
+    @pytest.mark.quick
+    def test_distogram_probs_shape_and_batch_max(self):
+        logits = np.zeros((2, 16, 16, 37), np.float32)
+        logits[1, 3, 12, 0] = 50.0          # element 1: certain contact
+        probs = contact_probs_from_distogram(logits, cutoff=8.0)
+        assert probs.shape == (16, 16)
+        assert probs[3, 12] > 0.9           # max over batch kept it
+
+    @pytest.mark.quick
+    def test_degenerate_all_dense_falls_back_to_dense(self):
+        """An all-contact map plans an all-live pattern — the policy
+        answers None (run the DENSE kernel) instead of paying sparse
+        overhead for zero FLOP savings; same rule for a static mask
+        whose banded window covers everything."""
+        pol = KernelPolicy(table={32: "blocksparse"}, block=8,
+                           window=0, num_global=0)
+        dist = np.zeros((1, 32, 32, 37), np.float32)
+        dist[..., 0] = 50.0                 # every pair in contact
+        assert pol.contact_spec_for(32, dist) is None
+        wide = KernelPolicy(table={32: "blocksparse"}, block=8,
+                            window=8, num_global=1)
+        assert wide.spec_for(32) is None
+        assert wide.kernel_for(32) == "dense"
+
+    @pytest.mark.quick
+    def test_contact_spec_for_sparse_map(self):
+        pol = KernelPolicy(table={32: "blocksparse"}, block=8,
+                           window=0, num_global=0,
+                           contact_threshold=0.5)
+        dist = np.zeros((1, 32, 32, 37), np.float32)
+        dist[..., -1] = 50.0                # everything far apart
+        spec = pol.contact_spec_for(32, dist)
+        assert spec is not None and spec.source == "contact"
+        assert spec.live_fraction < 0.5
+
+
+class TestKernelPolicy:
+    @pytest.mark.quick
+    def test_parse_surfaces(self):
+        edges = (64, 512)
+        assert KernelPolicy.parse("", edges) is None
+        allsparse = KernelPolicy.parse("blocksparse", edges, block=64)
+        assert allsparse.table == {64: "blocksparse",
+                                   512: "blocksparse"}
+        pinned = KernelPolicy.parse("64=dense,512=sparse", edges,
+                                    block=64)
+        assert pinned.kernel_for(64) == "dense"
+        assert pinned.kernel_for(512) == "blocksparse"
+        with pytest.raises(ValueError):
+            KernelPolicy.parse("64=warp", edges)
+
+    @pytest.mark.quick
+    def test_auto_routes_by_static_live_fraction(self):
+        # block 64: edge 128 is 2x2 blocks (banded mask all-live ->
+        # dense); edge 1024 is 16x16 (live frac ~0.3 -> sparse)
+        pol = KernelPolicy.parse("auto", (128, 1024), block=64,
+                                 sparse_live_frac=0.5)
+        assert pol.kernel_for(128) == "dense"
+        assert pol.kernel_for(1024) == "blocksparse"
+
+    @pytest.mark.quick
+    def test_indivisible_bucket_serves_dense(self):
+        pol = KernelPolicy(table={48: "blocksparse"}, block=32)
+        assert pol.spec_for(48) is None
+        assert pol.kernel_for(48) == "dense"
+
+    @pytest.mark.quick
+    def test_from_model_config_threads_sparse_knobs(self):
+        cfg = ModelConfig(sparse_block=8, sparse_num_global=1,
+                          sparse_window=0)
+        pol = KernelPolicy.from_model_config(cfg, (64,),
+                                             sparse_live_frac=0.5)
+        assert pol.block == 8 and pol.window == 0
+        spec = pol.spec_for(64)
+        assert spec is not None and spec.block == 8
+
+    @pytest.mark.quick
+    def test_snapshot_reports_routing(self):
+        pol = KernelPolicy(table={16: "dense", 32: "blocksparse"},
+                           block=8, window=0)
+        snap = pol.snapshot()
+        assert snap["buckets"]["16"]["kernel"] == "dense"
+        assert snap["buckets"]["32"]["kernel"] == "blocksparse"
+        assert 0 < snap["buckets"]["32"]["live_frac"] < 1
+
+
+class TestExecutorKernelKeys:
+    def test_exec_key_grows_kernel_element(self, model_and_params):
+        """MIGRATING ISSUE-12: the 8-tuple. Dense runs key "dense";
+        kernel'd runs key the spec label — both resident in the LRU at
+        once, so a policy flip re-lowers instead of serving stale."""
+        ex = FoldExecutor(*model_and_params, max_entries=8)
+        policy = BucketPolicy((16,))
+        batch, _ = policy.assemble(requests_of((12,)), 16, 2,
+                                   msa_depth=MSA_DEPTH)
+        spec = KernelSpec.banded(16, 8, **ALL_LIVE)
+        k_dense = ex.key_for(batch, 0)
+        k_spec = ex.key_for(batch, 0, kernel=spec)
+        assert len(k_dense) == len(k_spec) == 8
+        assert k_dense[7] == "dense" and k_spec[7] == spec.label
+        assert k_dense[:7] == k_spec[:7]
+
+    def test_legacy_key_normalization(self, model_and_params):
+        ex = FoldExecutor(*model_and_params)
+        assert ex._normalize_key((16, 1, 3, 0))[7] == "dense"
+        assert ex._normalize_key(
+            (16, 1, 3, 0, (1, 1), "tag", "step"))[7] == "dense"
+        full = (16, 1, 3, 0, (1, 1), "tag", "step", "bs8x2-sabc")
+        assert ex._normalize_key(full) == full
+
+    def test_kernel_flip_compiles_fresh_then_hits(self,
+                                                  model_and_params):
+        """The staleness regression: a different spec (a policy flip or
+        a contact re-plan) is a different executable — never a stale
+        serve; flipping BACK hits the still-resident original."""
+        ex = FoldExecutor(*model_and_params, max_entries=8)
+        policy = BucketPolicy((16,))
+        batch, _ = policy.assemble(requests_of((12,)), 16, 2,
+                                   msa_depth=MSA_DEPTH)
+        a = KernelSpec.banded(16, 8, **ALL_LIVE)
+        b = KernelSpec.banded(16, 8, **SPARSE)
+        ex.run(batch, 0, kernel=a)
+        ex.run(batch, 0, kernel=b)
+        ex.run(batch, 0)                    # dense is its own key too
+        assert ex.stats()["misses"] == 3
+        ex.run(batch, 0, kernel=a)
+        assert ex.stats()["hits"] == 1
+
+    def test_warmup_precompiles_kernel_variant(self, model_and_params):
+        ex = FoldExecutor(*model_and_params, max_entries=8)
+        spec = KernelSpec.banded(16, 8, **ALL_LIVE)
+        fresh = ex.warmup([(16, 2, MSA_DEPTH, 0)], kernel=spec)
+        assert fresh == 1
+        policy = BucketPolicy((16,))
+        batch, _ = policy.assemble(requests_of((12,)), 16, 2,
+                                   msa_depth=MSA_DEPTH)
+        ex.run(batch, 0, kernel=spec)
+        stats = ex.stats()
+        assert stats["misses"] == 1 and stats["hits"] == 1
+        assert any(k[7] == spec.label for k in stats["keys"])
+
+    @staticmethod
+    def _real_diff(a, b, lengths):
+        """Max |coords delta| over REAL residues only: padded rows are
+        masked-query positions — unspecified on every backend (the
+        scheduler never serves them), so equivalence is defined on the
+        positions that reach callers."""
+        return max(float(np.abs(np.asarray(a.coords)[i, :n]
+                                - np.asarray(b.coords)[i, :n]).max())
+                   for i, n in enumerate(lengths))
+
+    def test_executor_numerics_all_live_matches_dense(
+            self, model_and_params):
+        """Serving-level equivalence at the executor: an ALL-LIVE
+        pattern through the block-skipping kernel (interpret mode on
+        CPU) computes full attention — within float tolerance of the
+        dense executable on every real residue; the masked-dense
+        backend is bit-identical to dense (a bias of zeros)."""
+        ex = FoldExecutor(*model_and_params, max_entries=8)
+        policy = BucketPolicy((16,))
+        lengths = (12, 9)
+        batch, _ = policy.assemble(requests_of(lengths), 16, 2,
+                                   msa_depth=MSA_DEPTH)
+        dense = ex.run(batch, 1)
+        masked = ex.run(batch, 1, kernel=KernelSpec.banded(
+            16, 8, backend="masked", **ALL_LIVE))
+        pallas = ex.run(batch, 1, kernel=KernelSpec.banded(
+            16, 8, backend="pallas", **ALL_LIVE))
+        assert self._real_diff(masked, dense, lengths) == 0.0
+        assert self._real_diff(pallas, dense, lengths) < 5e-4
+        for i, n in enumerate(lengths):
+            np.testing.assert_allclose(
+                np.asarray(pallas.confidence)[i, :n],
+                np.asarray(dense.confidence)[i, :n], atol=5e-4)
+
+    def test_executor_numerics_sparse_backends_agree(
+            self, model_and_params):
+        """A genuinely sparse pattern: the FLOP-skipping kernel and the
+        masked-dense reference agree tightly with each other on every
+        real residue and BOTH differ from unrestricted dense (the
+        pattern is really applied)."""
+        ex = FoldExecutor(*model_and_params, max_entries=8)
+        policy = BucketPolicy((16,))
+        lengths = (12, 9)
+        batch, _ = policy.assemble(requests_of(lengths), 16, 2,
+                                   msa_depth=MSA_DEPTH)
+        dense = ex.run(batch, 1)
+        masked = ex.run(batch, 1, kernel=KernelSpec.banded(
+            16, 4, backend="masked", **SPARSE))
+        pallas = ex.run(batch, 1, kernel=KernelSpec.banded(
+            16, 4, backend="pallas", **SPARSE))
+        assert self._real_diff(pallas, masked, lengths) < 5e-4
+        assert self._real_diff(masked, dense, lengths) > 1e-3
+
+
+class TestSchedulerKernelRouting:
+    def test_end_to_end_routing_and_equivalence(self, model_and_params):
+        """Scheduler-level: a policy routing the long bucket
+        blocksparse serves every request ok; the dense bucket's outputs
+        are BYTE-identical to a policy-less scheduler, the sparse
+        bucket's masked and pallas backends agree within tight
+        tolerance, and serve_stats()["kernel"] counts both kinds."""
+        reqs = requests_of((12, 28, 9, 26), key=3)
+
+        def run_one(kp):
+            sched = _scheduler(model_and_params, buckets=(16, 32),
+                               kernel_policy=kp)
+            assert sched.warmup() >= 1
+            with sched:
+                resps = [sched.submit(
+                    FoldRequest(seq=r.seq, msa=r.msa)).result(
+                        timeout=300) for r in reqs]
+            assert all(r.ok for r in resps)
+            return resps, sched.serve_stats()
+
+        mk = lambda backend: KernelPolicy(  # noqa: E731
+            table={16: "dense", 32: "blocksparse"}, block=8,
+            window=0, num_global=1, backend=backend)
+        r_masked, snap = run_one(mk("masked"))
+        r_pallas, _ = run_one(mk("pallas"))
+        r_dense, snap_dense = run_one(None)
+
+        folds = snap["kernel"]["folds"]
+        assert folds["blocksparse:32"]["served"] == 2
+        assert folds["dense:16"]["served"] == 2
+        assert "kernel" not in snap_dense
+
+        for m, p, d in zip(r_masked, r_pallas, r_dense):
+            if m.bucket_len == 16:          # dense-routed: untouched
+                np.testing.assert_array_equal(m.coords, d.coords)
+                np.testing.assert_array_equal(p.coords, d.coords)
+            else:                           # sparse-routed: backends
+                np.testing.assert_allclose(  # agree with each other
+                    p.coords, m.coords, atol=5e-4)
+
+    def test_sparse_exec_key_actually_served(self, model_and_params):
+        """The smoke's routing assertion, in-process: with a sparse
+        policy the executor's resident keys include the spec label and
+        it took hits (the sparse executable served traffic, not just
+        compiled)."""
+        # num_global=0: with only 2 blocks at this bucket a global
+        # block would make the banded pattern all-live (dense fallback)
+        kp = KernelPolicy(table={16: "blocksparse"}, block=8,
+                          window=0, num_global=0)
+        sched = _scheduler(model_and_params, kernel_policy=kp)
+        sched.warmup()
+        with sched:
+            for r in requests_of((12, 9), key=4):
+                assert sched.submit(FoldRequest(
+                    seq=r.seq, msa=r.msa)).result(timeout=300).ok
+        stats = sched.executor.stats()
+        label = kp.spec_for(16).label
+        assert any(k[7] == label for k in stats["keys"])
+        assert stats["hits"] >= 1
+
+    def test_kernel_policy_none_stats_byte_identical(
+            self, model_and_params):
+        """The off switch: kernel_policy=None must leave scrubbed
+        serve_stats() byte-identical to a scheduler that has never
+        heard of kernel selection (same scrub discipline as the mesh/
+        recycle/continuous identity pins)."""
+        def scrub(obj):
+            if isinstance(obj, dict):
+                return {k: scrub(v) for k, v in sorted(obj.items())
+                        if k != "traces" and not k.endswith("_s")}
+            if isinstance(obj, list):
+                return [scrub(v) for v in obj]
+            return obj
+
+        def run_one(**kw):
+            sched = _scheduler(model_and_params, **kw)
+            reqs = requests_of((12, 8), key=9)
+            with sched:
+                for r in reqs:
+                    assert sched.submit(
+                        FoldRequest(seq=r.seq, msa=r.msa)).result(
+                            timeout=300).ok
+            return scrub(sched.serve_stats())
+
+        explicit_off = run_one(kernel_policy=None)
+        never_heard = run_one()
+        assert json.dumps(explicit_off, sort_keys=True, default=str) \
+            == json.dumps(never_heard, sort_keys=True, default=str)
+        assert "kernel" not in never_heard
+
+
+class TestLoadtestFlags:
+    def test_kernel_policy_flags_fast(self, tmp_path, capsys):
+        """Tier-1 flag-rot tripwire: the --kernel-policy surface drives
+        a real (tiny) run and reports the kernel section — per-kernel
+        folds/hour, the live-fraction histogram, and the interpret-mode
+        numerics check."""
+        import sys
+        sys.path.insert(0, "tools")
+        try:
+            import serve_loadtest
+        finally:
+            sys.path.pop(0)
+        rc = serve_loadtest.main([
+            "--requests", "8", "--concurrency", "4",
+            "--lengths", "12", "--buckets", "16",
+            "--msa-depth", str(MSA_DEPTH), "--max-batch", "2",
+            "--max-wait-ms", "5", "--num-recycles", "1",
+            "--kernel-policy", "blocksparse", "--sparse-block", "8",
+            "--sparse-window", "0", "--sparse-global", "0",
+            "--dim", "32", "--depth", "1",
+            "--metrics-path", str(tmp_path / "m.jsonl")])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out.strip()
+                            .splitlines()[-1])
+        assert report["served"] == 8
+        kern = report["kernel"]
+        assert kern["folds"]["blocksparse:16"]["served"] == 8
+        assert kern["folds_per_hour_by_kernel"]["blocksparse"] > 0
+        assert kern["live_frac_hist"]
+        assert kern["numerics_max_diff"]["16"] < 1e-3
+
+
+class TestContactPriorFlow:
+    def test_step_loop_replans_and_relowers(self, model_and_params):
+        """contact_priors under a recycle policy: the init pass runs
+        the static spec, the mask is re-planned from the batch's own
+        recycle-1 distogram, and the remaining recycles run a
+        RE-LOWERED step executable (a contact-labeled — or dense —
+        step key distinct from the static one), with every request
+        still resolving ok and finite."""
+        kp = KernelPolicy(table={16: "blocksparse"}, block=8,
+                          window=0, num_global=0, contact_priors=True,
+                          contact_threshold=0.2)
+        reg = MetricsRegistry()
+        sched = _scheduler(model_and_params, num_recycles=2,
+                           kernel_policy=kp,
+                           recycle_policy=RecyclePolicy(preempt=False),
+                           registry=reg)
+        sched.warmup()
+        static_label = kp.spec_for(16).label
+        with sched:
+            for r in requests_of((12, 9), key=6):
+                resp = sched.submit(FoldRequest(
+                    seq=r.seq, msa=r.msa)).result(timeout=300)
+                assert resp.ok and np.isfinite(resp.coords).all()
+        keys = sched.executor.stats()["keys"]
+        step_kernels = {k[7] for k in keys if k[6] == "step"}
+        # the static step was warmed; the replanned step (contact label
+        # or dense fallback) was lowered mid-loop alongside it
+        assert static_label in {k[7] for k in keys}
+        assert len(step_kernels) >= 2
+        folds = sched.serve_stats()["kernel"]["folds"]
+        assert any(k.startswith("blocksparse-contact")
+                   for k in folds)

@@ -205,11 +205,12 @@ class TestExecutor:
         ex.run(b32, 0)                       # evicts the 16-bucket entry
         stats = ex.stats()
         assert stats["evictions"] == 1 and stats["resident"] == 1
-        # ExecKey grew (mesh_shape, model_tag) in ISSUE 7 and the
-        # variant element in ISSUE 9 (see MIGRATING): single-chip
-        # untagged opaque-fold executors key as (1,1)/""/"fold"
+        # ExecKey grew (mesh_shape, model_tag) in ISSUE 7, the variant
+        # element in ISSUE 9, and the kernel element in ISSUE 12 (see
+        # MIGRATING): single-chip untagged opaque-fold dense executors
+        # key as (1,1)/""/"fold"/"dense"
         assert stats["keys"] == [(32, 1, MSA_DEPTH, 0, (1, 1), "",
-                                  "fold")]
+                                  "fold", "dense")]
         ex.run(b16, 0)                       # cold again after eviction
         assert ex.stats()["misses"] == 3
 
