@@ -9,10 +9,15 @@ pieces, each usable alone:
             feature_key — the UPSTREAM digest of one raw input's
             featurize work (no fold config: feature traffic dedups
             independently of fold traffic)
-- store:    FoldCache — byte-budgeted memory LRU over an optional
-            atomic-write on-disk .npz tier; corruption == miss
-- features: FeatureCache — the same architecture one stage upstream,
-            holding featurized inputs (serve.features.FeaturePool)
+- bytestore: ByteStore — THE one generic byte-budgeted store (memory
+            LRU + TTL over an atomic-write disk tier with quarantine),
+            parameterized on encode/decode; both stores below re-base
+            on it (ISSUE 13)
+- store:    FoldCache — ByteStore over encode_fold/decode_fold plus
+            the fold-specific stats, gauges, and peer tier;
+            corruption == miss
+- features: FeatureCache — the same store one stage upstream, holding
+            featurized inputs (serve.features.FeaturePool)
 - coalesce: InflightRegistry — duplicate submissions attach to the
             in-flight leader instead of folding twice
 
@@ -25,6 +30,7 @@ are fixed and identified by `model_tag` (README "Result cache &
 deduplication").
 """
 
+from alphafold2_tpu.cache.bytestore import ByteStore  # noqa: F401
 from alphafold2_tpu.cache.coalesce import InflightRegistry  # noqa: F401
 from alphafold2_tpu.cache.features import (FeatureCache,  # noqa: F401
                                            FeaturizedInput,

@@ -10,12 +10,15 @@ one entry serves every downstream fold variant, and feature traffic
 dedups independently of fold traffic.
 
 Same architecture and trust model as the fold-result store
-(`cache/store.py`): byte-budgeted memory LRU over an optional
-atomic-write on-disk `.npz` tier; anything wrong with a disk entry is a
-MISS and the file is quarantined (`*.quarantined`), never raised into
-the serving path. No peer tier — features are cheap to recompute
-relative to a network hop for token arrays (revisit when real MSA
-search lands; the seam is `FeatureCache.get/put`, same as FoldCache's).
+(`cache/store.py`) — literally: both re-base on the ONE generic
+byte-budgeted store (`cache.bytestore.ByteStore`, ISSUE 13),
+parameterized here on `encode_features`/`decode_features`; anything
+wrong with a disk entry is a MISS and the file is quarantined
+(`*.quarantined`), never raised into the serving path. No peer tier —
+features are cheap to recompute relative to a network hop for token
+arrays (revisit when real MSA search lands; the seam is
+`FeatureCache.get/put`, same as FoldCache's — and the shared store
+means spill tiers land in ONE place when they do).
 
 `serve.features.FeaturePool` wires this into the serving path; it is
 equally usable standalone for offline featurize memoization.
@@ -24,19 +27,16 @@ equally usable standalone for offline featurize memoization.
 from __future__ import annotations
 
 import io
-import os
 import threading
 import time
-from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, Optional
 
 import numpy as np
 
+from alphafold2_tpu.cache.bytestore import ByteStore
 from alphafold2_tpu.obs.registry import MetricsRegistry, get_registry
 from alphafold2_tpu.obs.trace import NULL_TRACE
-
-_QUARANTINE_SUFFIX = ".quarantined"
 
 
 @dataclass
@@ -86,17 +86,13 @@ def decode_features(key: str, data: bytes) -> FeaturizedInput:
     return value
 
 
-class _Entry:
-    __slots__ = ("value", "expires_at")
-
-    def __init__(self, value: FeaturizedInput,
-                 expires_at: Optional[float]):
-        self.value = value
-        self.expires_at = expires_at
-
-
 class FeatureCache:
     """Content-addressed featurized-input cache (memory LRU + disk).
+
+    The memory/disk/quarantine machinery is `cache.bytestore.ByteStore`
+    parameterized on `encode_features`/`decode_features` (ISSUE 13:
+    ONE copy, shared with `cache.store.FoldCache`); this class owns the
+    feature-specific counters and trace events.
 
     max_bytes / max_entries bound the memory tier; the disk tier is
     bounded by TTL (and the directory's owner). ttl_s=None disables
@@ -112,16 +108,7 @@ class FeatureCache:
                  disk_dir: Optional[str] = None,
                  clock: Callable[[], float] = time.time,
                  registry: Optional[MetricsRegistry] = None):
-        if max_bytes < 0 or max_entries < 0:
-            raise ValueError("max_bytes and max_entries must be >= 0")
-        self.max_bytes = int(max_bytes)
-        self.max_entries = int(max_entries)
-        self.ttl_s = ttl_s
-        self.disk_dir = disk_dir
-        self._clock = clock
         self._lock = threading.Lock()
-        self._mem: "OrderedDict[str, _Entry]" = OrderedDict()
-        self._bytes = 0
         self.hits = 0
         self.misses = 0
         self.puts = 0
@@ -133,128 +120,67 @@ class FeatureCache:
             "feature_cache_events_total",
             "feature-store outcomes across all FeatureCache instances",
             ("event",))
-        if disk_dir:
-            os.makedirs(disk_dir, exist_ok=True)
+        self._store = ByteStore(
+            encode=encode_features, decode=decode_features,
+            max_bytes=max_bytes, max_entries=max_entries, ttl_s=ttl_s,
+            disk_dir=disk_dir, clock=clock, on_event=self._bump,
+            quarantine_event="feature_quarantine")
 
     def _bump(self, field: str, n: int = 1):
         with self._lock:
             setattr(self, field, getattr(self, field) + n)
         self._m_events.inc(n, event=field)
 
-    # -- memory tier -----------------------------------------------------
+    @property
+    def max_bytes(self) -> int:
+        return self._store.max_bytes
+
+    @property
+    def max_entries(self) -> int:
+        return self._store.max_entries
+
+    @property
+    def ttl_s(self) -> Optional[float]:
+        return self._store.ttl_s
+
+    @property
+    def disk_dir(self) -> Optional[str]:
+        return self._store.disk_dir
+
+    # -- tier internals (delegated; names kept for tests/tooling) --------
 
     def _mem_get(self, key: str) -> Optional[FeaturizedInput]:
-        now = self._clock()
-        with self._lock:
-            entry = self._mem.get(key)
-            if entry is None:
-                return None
-            if entry.expires_at is not None and now >= entry.expires_at:
-                del self._mem[key]
-                self._bytes -= entry.value.nbytes
-                self.expirations += 1
-                return None
-            self._mem.move_to_end(key)
-            return entry.value
+        return self._store.mem_get(key)
 
     def _mem_put(self, key: str, value: FeaturizedInput,
                  expires_at: Optional[float] = None):
-        """expires_at overrides the fresh-write TTL — disk promotions
-        pass the ORIGINAL write time's expiry (same tier-bounce rule as
-        FoldCache._mem_put)."""
-        if self.max_entries == 0 or self.max_bytes == 0:
-            return
-        if expires_at is None:
-            expires_at = (None if self.ttl_s is None
-                          else self._clock() + self.ttl_s)
-        with self._lock:
-            old = self._mem.pop(key, None)
-            if old is not None:
-                self._bytes -= old.value.nbytes
-            self._mem[key] = _Entry(value, expires_at)
-            self._bytes += value.nbytes
-            while self._mem and (len(self._mem) > self.max_entries
-                                 or self._bytes > self.max_bytes):
-                _, evicted = self._mem.popitem(last=False)
-                self._bytes -= evicted.value.nbytes
-                self.evictions += 1
-
-    # -- disk tier -------------------------------------------------------
+        self._store.mem_put(key, value, expires_at=expires_at)
 
     def _path(self, key: str) -> str:
-        return os.path.join(self.disk_dir, key[:2], f"{key}.npz")
+        return self._store.path(key)
 
     def _quarantine(self, path: str, key: str, trace=NULL_TRACE):
-        self._bump("disk_errors")
-        trace.event("feature_quarantine")
-        with self._lock:
-            entry = self._mem.pop(key, None)
-            if entry is not None:
-                self._bytes -= entry.value.nbytes
-        try:
-            os.replace(path, path + _QUARANTINE_SUFFIX)
-        except OSError:
-            pass                       # racing quarantiners: either wins
+        self._store.quarantine(path, key, trace)
 
     def _disk_get(self, key: str, trace=NULL_TRACE):
         """Returns (value, expires_at) or None."""
-        path = self._path(key)
-        try:
-            if not os.path.exists(path):
-                return None
-            expires_at = None
-            if self.ttl_s is not None:
-                expires_at = os.path.getmtime(path) + self.ttl_s
-                if self._clock() >= expires_at:
-                    self._bump("expirations")
-                    try:
-                        os.remove(path)
-                    except OSError:
-                        pass
-                    return None
-        except OSError:
-            return None
-        try:
-            with open(path, "rb") as fh:
-                data = fh.read()
-            value = decode_features(key, data)
-        except Exception:              # unreadable/garbage/wrong entry
-            self._quarantine(path, key, trace)
-            return None
-        return value, expires_at
+        return self._store.disk_get(key, trace)
 
     def _disk_put(self, key: str, value: FeaturizedInput):
-        path = self._path(key)
-        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
-        try:
-            os.makedirs(os.path.dirname(path), exist_ok=True)
-            with open(tmp, "wb") as fh:
-                fh.write(encode_features(key, value))
-            os.replace(tmp, path)      # atomic: readers see old or new
-        except Exception:
-            self._bump("disk_errors")
-            try:
-                os.remove(tmp)
-            except OSError:
-                pass
+        self._store.disk_put(key, value)
 
     # -- public API ------------------------------------------------------
 
     def get(self, key: str, trace=NULL_TRACE) -> Optional[FeaturizedInput]:
         """Lookup; never raises. memory -> disk, disk hits promoted."""
-        value = self._mem_get(key)
-        tier = "memory"
-        if value is None and self.disk_dir:
-            hit = self._disk_get(key, trace)
-            if hit is not None:
-                value, expires_at = hit
-                tier = "disk"
-                self._bump("disk_hits")
-                self._mem_put(key, value, expires_at=expires_at)
-        if value is None:
+        hit = self._store.lookup(key, trace)
+        if hit is None:
             self._bump("misses")
             trace.event("feature_miss")
             return None
+        value, tier = hit
+        if tier == "disk":
+            self._bump("disk_hits")
         self._bump("hits")
         trace.event("feature_hit", tier=tier)
         return value
@@ -276,12 +202,10 @@ class FeatureCache:
 
     @property
     def bytes_resident(self) -> int:
-        with self._lock:
-            return self._bytes
+        return self._store.bytes_resident
 
     def __len__(self) -> int:
-        with self._lock:
-            return len(self._mem)
+        return len(self._store)
 
     @property
     def hit_ratio(self) -> float:
@@ -294,8 +218,8 @@ class FeatureCache:
             out = {f: getattr(self, f)
                    for f in ("hits", "misses", "puts", "evictions",
                              "expirations", "disk_hits", "disk_errors")}
-            out["entries_resident"] = len(self._mem)
-            out["bytes_resident"] = self._bytes
+        out["entries_resident"] = len(self._store)
+        out["bytes_resident"] = self._store.bytes_resident
         total = out["hits"] + out["misses"]
         out["hit_ratio"] = out["hits"] / total if total else 0.0
         out["max_bytes"] = self.max_bytes

@@ -37,16 +37,14 @@ import io
 import os
 import threading
 import time
-from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, Optional
 
 import numpy as np
 
+from alphafold2_tpu.cache.bytestore import ByteStore
 from alphafold2_tpu.obs.registry import MetricsRegistry, get_registry
 from alphafold2_tpu.obs.trace import NULL_TRACE
-
-_QUARANTINE_SUFFIX = ".quarantined"
 
 
 @dataclass
@@ -127,16 +125,15 @@ class CacheStats:
         return out
 
 
-class _Entry:
-    __slots__ = ("value", "expires_at")
-
-    def __init__(self, value: CachedFold, expires_at: Optional[float]):
-        self.value = value
-        self.expires_at = expires_at
-
-
 class FoldCache:
     """Content-addressed fold cache (memory LRU + optional disk + peer).
+
+    The memory/disk/quarantine machinery is `cache.bytestore.ByteStore`
+    parameterized on `encode_fold`/`decode_fold` (ISSUE 13: ONE copy,
+    shared with `cache.features.FeatureCache`); this class owns what a
+    FOLD store adds — hit/miss stats into `fold_cache_events_total`,
+    registry residency gauges, the peer tier, the fault-injection hook,
+    and the peer-serving `read_raw`.
 
     max_bytes / max_entries bound the memory tier only; the disk tier
     is bounded by TTL (and by whoever owns the directory). ttl_s=None
@@ -160,22 +157,12 @@ class FoldCache:
                  registry: Optional[MetricsRegistry] = None,
                  peer=None, peer_write_through: bool = False,
                  faults=None):
-        if max_bytes < 0 or max_entries < 0:
-            raise ValueError("max_bytes and max_entries must be >= 0")
-        self.max_bytes = int(max_bytes)
-        self.max_entries = int(max_entries)
-        self.ttl_s = ttl_s
-        self.disk_dir = disk_dir
         self.peer = peer
         self.peer_write_through = bool(peer_write_through)
         # optional serve.faults.FaultPlan: chaos-corrupts disk bytes
         # BEFORE validation, so injected corruption exercises exactly
         # the quarantine path a real bit-rotted entry would
         self.faults = faults
-        self._clock = clock
-        self._lock = threading.Lock()
-        self._mem: "OrderedDict[str, _Entry]" = OrderedDict()
-        self._bytes = 0
         reg = registry or get_registry()
         self.stats = CacheStats(registry=reg)
         self._m_bytes = reg.gauge(
@@ -184,130 +171,68 @@ class FoldCache:
         self._m_entries = reg.gauge(
             "fold_cache_entries_resident",
             "memory-tier resident entries (last-reporting store)")
-        if disk_dir:
-            os.makedirs(disk_dir, exist_ok=True)
 
-    # -- memory tier -----------------------------------------------------
+        def _resize(nbytes, entries):
+            self._m_bytes.set(nbytes)
+            self._m_entries.set(entries)
+
+        self._store = ByteStore(
+            encode=encode_fold, decode=decode_fold,
+            max_bytes=max_bytes, max_entries=max_entries, ttl_s=ttl_s,
+            disk_dir=disk_dir, clock=clock,
+            on_event=self.stats.bump, on_resize=_resize,
+            # read self.faults at call time: the plan may be armed or
+            # swapped after construction
+            corrupt=lambda key, data: (
+                data if self.faults is None
+                else self.faults.corrupt_cache_bytes(key, data)),
+            quarantine_event="cache_quarantine")
+
+    # sizing/config views delegate to the one store (ISSUE 13: the
+    # machinery lives in cache.bytestore; these stay part of the
+    # public surface snapshot()/tests read)
+    @property
+    def max_bytes(self) -> int:
+        return self._store.max_bytes
+
+    @property
+    def max_entries(self) -> int:
+        return self._store.max_entries
+
+    @property
+    def ttl_s(self) -> Optional[float]:
+        return self._store.ttl_s
+
+    @property
+    def disk_dir(self) -> Optional[str]:
+        return self._store.disk_dir
+
+    # -- tier internals (delegated; the names remain because tests and
+    # -- operational tooling reach for them directly) ---------------------
 
     def _mem_get(self, key: str) -> Optional[CachedFold]:
-        now = self._clock()
-        with self._lock:
-            entry = self._mem.get(key)
-            if entry is None:
-                return None
-            if entry.expires_at is not None and now >= entry.expires_at:
-                del self._mem[key]
-                self._bytes -= entry.value.nbytes
-                self.stats.bump("expirations")
-                self._m_bytes.set(self._bytes)
-                self._m_entries.set(len(self._mem))
-                return None
-            self._mem.move_to_end(key)
-            return entry.value
+        return self._store.mem_get(key)
 
     def _mem_put(self, key: str, value: CachedFold,
                  expires_at: Optional[float] = None):
-        """expires_at overrides the fresh-write TTL — disk promotions
-        pass the ORIGINAL write time's expiry so a value can never live
-        past write_time + ttl_s by bouncing between tiers."""
-        if self.max_entries == 0 or self.max_bytes == 0:
-            return
-        if expires_at is not None:
-            expires = expires_at
-        else:
-            expires = (None if self.ttl_s is None
-                       else self._clock() + self.ttl_s)
-        with self._lock:
-            old = self._mem.pop(key, None)
-            if old is not None:
-                self._bytes -= old.value.nbytes
-            self._mem[key] = _Entry(value, expires)
-            self._bytes += value.nbytes
-            while self._mem and (len(self._mem) > self.max_entries
-                                 or self._bytes > self.max_bytes):
-                _, evicted = self._mem.popitem(last=False)
-                self._bytes -= evicted.value.nbytes
-                self.stats.bump("evictions")
-            self._m_bytes.set(self._bytes)
-            self._m_entries.set(len(self._mem))
+        self._store.mem_put(key, value, expires_at=expires_at)
 
     def _mem_drop(self, key: str) -> bool:
-        """Remove a memory-resident entry WITH its byte accounting.
-        Every invalidation path (quarantine, explicit invalidate) must
-        come through here: popping from `_mem` without the `_bytes`
-        decrement leaks resident-byte accounting until restart."""
-        with self._lock:
-            entry = self._mem.pop(key, None)
-            if entry is None:
-                return False
-            self._bytes -= entry.value.nbytes
-            self._m_bytes.set(self._bytes)
-            self._m_entries.set(len(self._mem))
-            return True
-
-    # -- disk tier -------------------------------------------------------
+        return self._store.mem_drop(key)
 
     def _path(self, key: str) -> str:
-        return os.path.join(self.disk_dir, key[:2], f"{key}.npz")
+        return self._store.path(key)
 
     def _quarantine(self, path: str, key: Optional[str] = None,
                     trace=NULL_TRACE):
-        self.stats.bump("disk_errors")
-        trace.event("cache_quarantine")
-        if key is not None:
-            # the durable copy of `key` failed validation: drop any
-            # memory-resident copy too (reconciling bytes_resident) so
-            # a poisoned key costs one clean recompute, not a tier that
-            # keeps serving while its backing entry is quarantined
-            self._mem_drop(key)
-        try:
-            os.replace(path, path + _QUARANTINE_SUFFIX)
-        except OSError:
-            pass                       # racing quarantiners: either wins
+        self._store.quarantine(path, key, trace)
 
     def _disk_get(self, key: str, trace=NULL_TRACE):
         """Returns (value, expires_at) or None."""
-        path = self._path(key)
-        try:
-            if not os.path.exists(path):
-                return None
-            expires_at = None
-            if self.ttl_s is not None:
-                expires_at = os.path.getmtime(path) + self.ttl_s
-                if self._clock() >= expires_at:
-                    self.stats.bump("expirations")
-                    try:
-                        os.remove(path)
-                    except OSError:
-                        pass
-                    return None
-        except OSError:
-            return None
-        try:
-            with open(path, "rb") as fh:
-                data = fh.read()
-            if self.faults is not None:
-                data = self.faults.corrupt_cache_bytes(key, data)
-            value = decode_fold(key, data)
-        except Exception:              # unreadable/garbage/wrong entry
-            self._quarantine(path, key, trace)
-            return None
-        return value, expires_at
+        return self._store.disk_get(key, trace)
 
     def _disk_put(self, key: str, value: CachedFold):
-        path = self._path(key)
-        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
-        try:
-            os.makedirs(os.path.dirname(path), exist_ok=True)
-            with open(tmp, "wb") as fh:
-                fh.write(encode_fold(key, value))
-            os.replace(tmp, path)      # atomic: readers see old or new
-        except Exception:
-            self.stats.bump("disk_errors")
-            try:
-                os.remove(tmp)
-            except OSError:
-                pass
+        self._store.disk_put(key, value)
 
     # -- public API ------------------------------------------------------
 
@@ -324,15 +249,12 @@ class FoldCache:
         zero-cost NULL_TRACE default) receives cache_hit / cache_miss /
         cache_quarantine events plus a `peer_fetch` span so a request
         trace shows where its result came from."""
-        value = self._mem_get(key)
-        tier = "memory"
-        if value is None and self.disk_dir:
-            hit = self._disk_get(key, trace)
-            if hit is not None:
-                value, expires_at = hit
-                tier = "disk"
+        hit = self._store.lookup(key, trace)
+        value = tier = None
+        if hit is not None:
+            value, tier = hit
+            if tier == "disk":
                 self.stats.bump("disk_hits")
-                self._mem_put(key, value, expires_at=expires_at)
         if value is None and peer and self.peer is not None:
             value = self._peer_get(key, trace)
             if value is not None:
@@ -419,18 +341,15 @@ class FoldCache:
 
     @property
     def bytes_resident(self) -> int:
-        with self._lock:
-            return self._bytes
+        return self._store.bytes_resident
 
     def __len__(self) -> int:
-        with self._lock:
-            return len(self._mem)
+        return len(self._store)
 
     def snapshot(self) -> dict:
         out = self.stats.snapshot()
-        with self._lock:
-            out["entries_resident"] = len(self._mem)
-            out["bytes_resident"] = self._bytes
+        out["entries_resident"] = len(self._store)
+        out["bytes_resident"] = self._store.bytes_resident
         out["max_bytes"] = self.max_bytes
         out["max_entries"] = self.max_entries
         out["ttl_s"] = self.ttl_s

@@ -431,11 +431,20 @@ def kernel_context(spec: Optional[KernelSpec]):
 
 
 def contact_probs_from_distogram(distogram: np.ndarray,
-                                 cutoff: float = 8.0) -> np.ndarray:
+                                 cutoff: float = 8.0,
+                                 lengths=None) -> np.ndarray:
     """(n, n) contact probability from distogram logits: P(d < cutoff)
     via softmax over the distance buckets, max-reduced over the batch
     axis when given (b, n, n, buckets) — a batch shares one compiled
     pattern, so the mask must keep any block ANY element needs.
+
+    `lengths` (optional, one per batch element) zeroes each element's
+    contribution beyond its real residue count BEFORE the batch
+    reduce: a padded row's distogram is garbage, and under continuous
+    batching an admitted shorter fold's padding region (ISSUE 13) must
+    plan as DEAD blocks — the sparse kernel must never DMA pair-bias
+    garbage the mask would otherwise mark live. A length of 0 removes
+    the element entirely (an unoccupied batch row).
 
     Bucket edges follow the distogram head's convention
     (constants.DISTOGRAM_MIN_DIST..MAX_DIST, linspace over
@@ -446,6 +455,9 @@ def contact_probs_from_distogram(distogram: np.ndarray,
     if logits.ndim == 3:
         logits = logits[None]
     b, n, n2, nb = logits.shape
+    if lengths is not None and len(lengths) != b:
+        raise ValueError(
+            f"lengths has {len(lengths)} entries for batch of {b}")
     edges = np.linspace(constants.DISTOGRAM_MIN_DIST,
                         constants.DISTOGRAM_MAX_DIST, nb)
     # stable softmax over the bucket axis, ONE full-size temporary
@@ -457,6 +469,11 @@ def contact_probs_from_distogram(distogram: np.ndarray,
     close = edges <= cutoff
     probs = z[..., close].sum(-1)
     probs /= z.sum(-1)                       # (b, n, n)
+    if lengths is not None:
+        for i, ln in enumerate(lengths):
+            ln = max(int(ln), 0)
+            probs[i, ln:, :] = 0.0
+            probs[i, :, ln:] = 0.0
     return probs.max(0)
 
 

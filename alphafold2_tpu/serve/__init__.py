@@ -37,7 +37,12 @@ The serving stack, bottom-up:
              progressive results, and — with `continuous=True` —
              refill freed rows mid-loop with pending requests via the
              row-masked init program, so a hot bucket's slice never
-             idles a row (README "Iteration-level scheduling" /
+             idles a row; `cross_bucket=True` additionally lets a
+             freed row serve a SHORTER bucket's pending fold at the
+             host shape (priced per admit by meshpolicy's
+             AdmissionPricer) and `eager_form=True` launches thin
+             queues' batches immediately, counting on admission to
+             top them up (README "Iteration-level scheduling" /
              "Continuous batching")
 - resilience: RetryPolicy/CircuitBreaker/Quarantine — pass
              `Scheduler(..., retry=RetryPolicy(...))` for transient-
@@ -81,7 +86,9 @@ from alphafold2_tpu.serve.features import (FeaturePool,  # noqa: F401
                                            featurizer_config_digest)
 from alphafold2_tpu.ops.block_sparse import KernelSpec  # noqa: F401
 from alphafold2_tpu.serve.kernelpolicy import KernelPolicy  # noqa: F401
-from alphafold2_tpu.serve.meshpolicy import (DeviceSliceAllocator,  # noqa: F401
+from alphafold2_tpu.serve.meshpolicy import (AdmissionDecision,  # noqa: F401
+                                             AdmissionPricer,
+                                             DeviceSliceAllocator,
                                              FoldMemoryModel, MeshPolicy,
                                              SliceLease)
 from alphafold2_tpu.serve.metrics import ServeMetrics  # noqa: F401

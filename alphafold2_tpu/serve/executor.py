@@ -391,7 +391,8 @@ class FoldExecutor:
                       row_mask, trace=NULL_TRACE,
                       devices: Optional[Sequence] = None,
                       mesh_shape: Optional[MeshShape] = None,
-                      kernel=None) -> FoldStepState:
+                      kernel=None,
+                      span_attrs: Optional[dict] = None) -> FoldStepState:
         """Row-masked admission init (continuous batching, ISSUE 11):
         rows where `row_mask` is True restart at iteration 0 from the
         batch tensors (which the scheduler just rewrote with newly
@@ -399,12 +400,17 @@ class FoldExecutor:
         `state` through untouched — survivors keep stepping, nothing
         recompiles mid-loop because this variant was warmed with the
         init+step pair. Span: `admit` (the admission cost is its own
-        waterfall stage — it is neither a fold nor a recycle)."""
+        waterfall stage — it is neither a fold nor a recycle).
+        `span_attrs` merges extra attributes into the admit span (the
+        cross-bucket scheduler tags the admitted rows' native buckets,
+        ISSUE 13)."""
         mask_arr = jnp.asarray(row_mask, bool)
+        attrs = {"rows": int(mask_arr.sum())}
+        if span_attrs:
+            attrs.update(span_attrs)
         return self._run_stepmode(
             "init_rows", batch, (mask_arr, state), trace, devices,
-            mesh_shape, span="admit",
-            attrs={"rows": int(mask_arr.sum())}, kernel=kernel)
+            mesh_shape, span="admit", attrs=attrs, kernel=kernel)
 
     def run_step(self, batch: dict, state: FoldStepState,
                  recycle_index: int, trace=NULL_TRACE,

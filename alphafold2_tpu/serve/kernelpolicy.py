@@ -219,18 +219,24 @@ class KernelPolicy:
         return spec
 
     def contact_spec_for(self, bucket_len: int,
-                         distogram: np.ndarray
-                         ) -> Optional[KernelSpec]:
+                         distogram: np.ndarray,
+                         lengths=None) -> Optional[KernelSpec]:
         """Plan a per-target contact-prior KernelSpec from recycle-1
         distogram logits ((b, n, n, buckets) — the batch shares one
         executable, so the plan keeps any block ANY element needs).
         None = run the remaining recycles DENSE: the bucket is not
         sparse-routed, or the planned pattern is degenerately live
-        (the all-dense fallback — sparse overhead for no savings)."""
+        (the all-dense fallback — sparse overhead for no savings).
+        `lengths` (one per batch row; 0 = unoccupied) zeroes each
+        row's contribution beyond its real residues before planning,
+        so a continuously admitted shorter fold's padding region
+        (ISSUE 13) — and any dead row's garbage — plans as dead blocks
+        instead of DMA-ing pair-bias garbage through the kernel."""
         if self.spec_for(bucket_len) is None:
             return None
         contacts = contact_probs_from_distogram(
-            np.asarray(distogram), cutoff=self.contact_cutoff)
+            np.asarray(distogram), cutoff=self.contact_cutoff,
+            lengths=lengths)
         pattern = contact_block_pattern(
             contacts, self.block, threshold=self.contact_threshold,
             live_frac=self.contact_live_frac, window=self.window,

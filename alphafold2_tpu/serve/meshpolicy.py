@@ -206,6 +206,125 @@ class FoldMemoryModel:
 
 
 @dataclass
+class AdmissionDecision:
+    """One priced cross-bucket admission verdict (ISSUE 13)."""
+
+    admit: bool
+    reason: str            # "pad_frac" | "deadline" | "native_imminent"
+    #                        | "priced" | "padded_cost"
+    pad_frac: float
+    excess_s: float        # padding-share compute the admit would waste
+    native_delay_s: float  # projected wait for a native-bucket fold
+
+
+@dataclass
+class AdmissionPricer:
+    """Prices the padding-vs-dead-row trade of CROSS-BUCKET row
+    admission (ISSUE 13): may a pending request from a shorter bucket
+    ride a freed row of a longer host batch, padded to the host edge?
+
+    The trade, made explicit instead of unconditional:
+
+    - a freed row is FREE compute for as long as the host loop runs
+      anyway (a step costs the same whether a row is live or dead), so
+      a candidate whose remaining recycles fit inside the host loop's
+      remaining steps rides at zero marginal cost — strictly better
+      than a dead row plus a separate native-bucket batch formation
+      (the ParaFold keep-the-accelerator-busy thesis at iteration
+      level);
+    - a candidate that EXTENDS the loop pays O(L_host^2) per extension
+      step where a native fold would have paid O(L_native^2) — only
+      the padding share of those extension steps is waste, and it is
+      priced against the candidate's projected native-bucket queue
+      delay (the latency it buys);
+    - deadline urgency is the tiebreak: a candidate that would MISS
+      its deadline waiting for a native batch admits regardless of
+      cost;
+    - `max_pad_frac` is the hard guard: past it, no queue delay
+      justifies the padding (a 12-residue fold in a 512 host row).
+
+    memory: optional FoldMemoryModel whose pair/MSA terms weight the
+        relative step cost; None prices with representative dim/heads
+        (the RATIO of host to native cost is what matters, and it is
+        dominated by the O(L^2) term either way).
+    max_pad_frac: see above; the scheduler threads
+        `RecyclePolicy.cross_bucket_max_pad_frac` here.
+    """
+
+    memory: Optional[FoldMemoryModel] = None
+    max_pad_frac: float = 0.75
+
+    def step_cost(self, bucket_len: int, batch_size: int,
+                  msa_depth: int) -> float:
+        """Relative per-step compute of one (B, L, M) batch: the
+        O(L^2) pair + MSA terms of the memory model as a FLOP proxy
+        (the same terms the HBM guard prices — bytes and FLOPs share
+        the activation shapes)."""
+        dim = self.memory.dim if self.memory is not None else 64
+        heads = self.memory.heads if self.memory is not None else 8
+        L, B, M = int(bucket_len), int(batch_size), int(msa_depth)
+        return float(B * L * L * (dim + heads)
+                     + B * max(M, 1) * L * dim)
+
+    def price(self, *, native_len: int, host_len: int, length: int,
+              batch_size: int, msa_depth: int,
+              candidate_steps: int, remaining_host_steps: int,
+              native_delay_s: float,
+              deadline_slack_s: Optional[float],
+              host_step_s: float) -> AdmissionDecision:
+        """Decide one candidate.
+
+        native_len/host_len: the candidate's own bucket edge and the
+            host batch's edge; `length` is its real residue count (pad
+            fraction is priced at the host edge).
+        candidate_steps: recycles the candidate will run after its
+            row-masked init (its full depth — it enters at age 0).
+        remaining_host_steps: steps the host loop runs regardless
+            (max over surviving rows' remaining depth); the candidate
+            rides these for free, and only the excess extends the
+            loop.
+        native_delay_s: the scheduler's projection of how long this
+            candidate would wait for a native-bucket fold (batch
+            formation window + worker/slice availability). <= 0 means
+            a native batch can form RIGHT NOW — stealing its member
+            for padded compute buys nothing.
+        deadline_slack_s: seconds until the candidate's deadline
+            (None = no deadline).
+        host_step_s: measured per-step latency of the host bucket
+            (EWMA; 0.0 before the first measurement prices extension
+            as free, so a cold loop leans toward admitting).
+        """
+        pad_frac = 1.0 - float(length) / float(host_len)
+        if pad_frac > self.max_pad_frac:
+            return AdmissionDecision(False, "pad_frac", pad_frac,
+                                     0.0, native_delay_s)
+        # deadline urgency tiebreak: waiting for the native bucket
+        # would miss the deadline outright — admit whatever the cost
+        if deadline_slack_s is not None \
+                and deadline_slack_s < native_delay_s:
+            return AdmissionDecision(True, "deadline", pad_frac,
+                                     0.0, native_delay_s)
+        if native_delay_s <= 0.0:
+            return AdmissionDecision(False, "native_imminent", pad_frac,
+                                     0.0, native_delay_s)
+        extension = max(0, int(candidate_steps)
+                        - int(remaining_host_steps))
+        cost_ratio = self.step_cost(native_len, batch_size, msa_depth) \
+            / max(self.step_cost(host_len, batch_size, msa_depth), 1.0)
+        excess_s = extension * max(host_step_s, 0.0) \
+            * (1.0 - min(cost_ratio, 1.0))
+        if excess_s <= native_delay_s:
+            return AdmissionDecision(True, "priced", pad_frac,
+                                     excess_s, native_delay_s)
+        return AdmissionDecision(False, "padded_cost", pad_frac,
+                                 excess_s, native_delay_s)
+
+    def snapshot(self) -> dict:
+        return {"max_pad_frac": self.max_pad_frac,
+                "memory": self.memory is not None}
+
+
+@dataclass
 class SliceLease:
     """One acquired device slice; hold it for the duration of a batch."""
 

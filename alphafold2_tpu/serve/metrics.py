@@ -65,6 +65,15 @@ class ServeMetrics:
         self.coalesced = 0          # parked behind an in-flight leader
         self._real_tokens = 0
         self._padded_tokens = 0
+        # admission-aware occupancy-weighted padding (ISSUE 13): the
+        # formation-time accounting above prices the grid ONCE at
+        # assemble ("founders only" — PR 11's known gap); these price
+        # what each executed recycle step actually carried, so row
+        # admissions (and the padding a cross-bucket admit accepts)
+        # move the number instead of being invisible
+        self._step_real_tokens = 0
+        self._step_grid_tokens = 0
+        self.row_admits = 0          # rows admitted mid-loop (all kinds)
         # per-bucket latency reservoirs (seconds, request-level) —
         # instance-scoped Histograms answering this server's snapshot()
         self._latencies: Dict[int, Histogram] = {}
@@ -89,6 +98,16 @@ class ServeMetrics:
             "serve_request_latency_seconds",
             "submit-to-resolve latency of served requests",
             ("bucket_len",), reservoir=max_latencies_per_bucket)
+        self._m_admit_pad = reg.histogram(
+            "serve_admit_pad_fraction",
+            "per-admission pad fraction at the host bucket edge "
+            "(1 - length/host_edge) of rows admitted mid-loop",
+            buckets=(0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0))
+        # instance-scoped mirror answering this server's snapshot()
+        self._admit_pad_hist = Histogram(
+            "serve_admit_pad_fraction", "per-admit pad fraction",
+            buckets=(0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0),
+            reservoir=max_latencies_per_bucket)
 
     def _bucket_hist(self, bucket_len: int) -> Histogram:
         """Caller holds self._lock."""
@@ -149,6 +168,28 @@ class ServeMetrics:
         errors/shed as usual)."""
         with self._lock:
             self.retried += n
+
+    def record_admit(self, pad_fraction: float):
+        """One row admitted mid-loop (continuous batching): observe
+        its pad fraction at the host bucket edge. Cross-bucket admits
+        (ISSUE 13) populate the high bins — the distribution IS the
+        padding-vs-dead-row trade being taken."""
+        pad_fraction = min(max(float(pad_fraction), 0.0), 1.0)
+        with self._lock:
+            self.row_admits += 1
+            self._admit_pad_hist.observe(pad_fraction)
+        self._m_admit_pad.observe(pad_fraction)
+
+    def record_step_occupancy(self, real_tokens: int, grid_tokens: int):
+        """One executed recycle step's token accounting: live rows'
+        real residues vs the full (B, L) grid the step paid for.
+        `padding_waste_admitted` in snapshot() is 1 - sum/sum over
+        every recorded step — the occupancy-weighted padding fraction
+        the continuous/cross-bucket batcher actually served at (the
+        formation-time `padding_waste` cannot see admissions)."""
+        with self._lock:
+            self._step_real_tokens += int(real_tokens)
+            self._step_grid_tokens += int(grid_tokens)
 
     def record_cache_hit(self):
         with self._lock:
@@ -249,6 +290,14 @@ class ServeMetrics:
             padded = self._padded_tokens
             waste = (1.0 - self._real_tokens / float(padded)) if padded \
                 else 0.0
+            grid = self._step_grid_tokens
+            waste_admitted = (1.0 - self._step_real_tokens / float(grid)) \
+                if grid else 0.0
+            admit_pad = {
+                "count": self._admit_pad_hist.count(),
+                "p50": self._admit_pad_hist.percentile(50),
+                "p99": self._admit_pad_hist.percentile(99),
+            }
             return {
                 "enqueued": self.enqueued,
                 "served": self.served,
@@ -264,6 +313,11 @@ class ServeMetrics:
                 "queue_depth": self.queue_depth,
                 "exec_busy_s": self.exec_busy_s,
                 "padding_waste": waste,
+                # occupancy-weighted over executed recycle steps
+                # (0.0 when the step loop never ran — ISSUE 13)
+                "padding_waste_admitted": waste_admitted,
+                "row_admits": self.row_admits,
+                "admit_pad_fraction": admit_pad,
                 "latency_by_bucket": per_bucket,
                 "cache": self._cache_view(),
             }

@@ -97,6 +97,32 @@ class RecyclePolicy:
         serve_stats identity regression-pinned). Row-admitted results
         are row-independent through the model, so `continuous` never
         changes what is computed and does not split cache keys.
+    cross_bucket: cross-bucket continuous batching (ISSUE 13; needs
+        `continuous`) — when a host batch's freed rows outnumber its
+        own bucket's pending queue, admit a pending request from a
+        SHORTER bucket at the host batch's shape: the candidate is
+        padded to the host bucket edge (the same per-row padding masks
+        that already fold mixed lengths within a bucket), runs the
+        row-masked init, and retires against its own age, byte-equal
+        to folding the same request alone at the host shape. Every
+        cross-bucket admit is PRICED by `serve.meshpolicy.
+        AdmissionPricer`: padded step cost x the loop extension it
+        causes vs the candidate's projected native-bucket queue delay,
+        deadline urgency as a tiebreak, `cross_bucket_max_pad_frac` as
+        the hard guard, and the HBM admission guard re-prices at the
+        host shape. Off by default; cross_bucket=False is byte-for-
+        byte the PR-11 same-bucket behavior (scrubbed serve_stats
+        identity regression-pinned).
+    cross_bucket_max_pad_frac: refuse a cross-bucket candidate whose
+        pad fraction at the host edge (1 - length/host_edge) exceeds
+        this — a 12-residue fold in a 512 host row is almost all
+        padding, and no queue delay justifies it.
+    eager_form: admission-aware batch formation (ISSUE 13; needs
+        `continuous`) — when a bucket's queue is thin, form its batch
+        IMMEDIATELY instead of waiting out max_wait_ms, counting on
+        mid-loop row admission to top the under-filled batch up:
+        max_wait becomes a fallback, not a latency floor. Off by
+        default.
     """
 
     converge_tol: float = 0.0
@@ -104,6 +130,9 @@ class RecyclePolicy:
     preempt: bool = True
     stream: bool = False
     continuous: bool = False
+    cross_bucket: bool = False
+    cross_bucket_max_pad_frac: float = 0.75
+    eager_form: bool = False
 
     def __post_init__(self):
         if self.converge_tol < 0:
@@ -112,6 +141,19 @@ class RecyclePolicy:
         if self.min_recycles < 0:
             raise ValueError(
                 f"min_recycles must be >= 0, got {self.min_recycles}")
+        if not 0.0 <= self.cross_bucket_max_pad_frac <= 1.0:
+            raise ValueError(
+                f"cross_bucket_max_pad_frac must be in [0, 1], got "
+                f"{self.cross_bucket_max_pad_frac}")
+        if self.cross_bucket and not self.continuous:
+            raise ValueError(
+                "cross_bucket admission rides the continuous batcher: "
+                "RecyclePolicy(cross_bucket=True) needs continuous=True")
+        if self.eager_form and not self.continuous:
+            raise ValueError(
+                "eager_form counts on mid-loop admission to top the "
+                "under-filled batch up: RecyclePolicy(eager_form=True) "
+                "needs continuous=True")
 
     def affects_results(self) -> bool:
         """True when this policy can serve a result that differs from
@@ -137,7 +179,11 @@ class RecyclePolicy:
                 "min_recycles": self.min_recycles,
                 "preempt": self.preempt,
                 "stream": self.stream,
-                "continuous": self.continuous}
+                "continuous": self.continuous,
+                "cross_bucket": self.cross_bucket,
+                "cross_bucket_max_pad_frac":
+                    self.cross_bucket_max_pad_frac,
+                "eager_form": self.eager_form}
 
 
 def element_deltas(prev_coords: np.ndarray, prev_conf: np.ndarray,

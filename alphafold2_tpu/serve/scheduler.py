@@ -139,8 +139,8 @@ from alphafold2_tpu.obs.trace import (MultiTrace, NULL_TRACE, NULL_TRACER,
                                       Tracer)
 from alphafold2_tpu.serve.bucketing import BucketPolicy
 from alphafold2_tpu.serve.executor import FoldExecutor
-from alphafold2_tpu.serve.meshpolicy import (MeshPolicy, SliceLease,
-                                             chips_of)
+from alphafold2_tpu.serve.meshpolicy import (AdmissionPricer, MeshPolicy,
+                                             SliceLease, chips_of)
 from alphafold2_tpu.serve.metrics import ServeMetrics
 from alphafold2_tpu.serve.recycle import (RecyclePolicy, element_deltas,
                                           repack_batch, repack_rows,
@@ -199,7 +199,7 @@ class _Entry:
     __slots__ = ("request", "ticket", "bucket_len", "enqueued_at",
                  "deadline", "cache_key", "store_key", "trace", "route",
                  "attempts", "not_before", "group",
-                 "parked_admit_bytes")
+                 "parked_admit_bytes", "cross_refused")
 
     def __init__(self, request: FoldRequest, bucket_len: int):
         self.request = request
@@ -220,6 +220,12 @@ class _Entry:
         # bytes this entry holds of the cache-aware admission budget
         # (nonzero only for followers admitted past a full queue)
         self.parked_admit_bytes = 0
+        # the cross-bucket pricer refused this entry at least once
+        # (ISSUE 13): the inline admission gate then treats it as
+        # admission-can't-serve-it, so the loop drains and normal
+        # batch formation takes over — max_wait stays a bounded
+        # fallback even under pricer refusals
+        self.cross_refused = False
         self.mark_enqueued()
 
     def resolve(self, response: FoldResponse):
@@ -406,6 +412,15 @@ class Scheduler:
         self._n_rows_dead_steps = 0
         self._row_steps_live = 0
         self._row_steps_total = 0
+        # cross-bucket admission (ISSUE 13): freed rows serving shorter
+        # buckets' pending work at the host shape, priced per admit
+        self._n_cross_admissions = 0
+        self._n_cross_refusals = 0
+        # per-bucket EWMA of measured step-executable seconds — what
+        # the AdmissionPricer converts loop extension into wall time
+        # with (worker/pool-thread writes, racy reads are fine for a
+        # pricing heuristic)
+        self._step_ewma: Dict[int, float] = {}
         # "a preemptor never preempts": per-thread reentrancy guard for
         # the between-recycles preemption window
         self._preempting = threading.local()
@@ -438,6 +453,12 @@ class Scheduler:
                 "serve_rows_occupied_fraction",
                 "live rows / batch rows of the step executed last, "
                 "sampled per recycle step")
+            self._c_cross_admissions = reg.counter(
+                "serve_cross_bucket_admissions_total",
+                "pending requests from a shorter bucket admitted into "
+                "a longer host batch's freed rows at the host shape "
+                "(cross-bucket continuous batching)",
+                ("host_bucket", "native_bucket"))
             # step mode needs TWO executables per (bucket, slice) —
             # init + step (THREE with continuous batching: + the
             # row-masked init_rows admission program); grow the LRU so
@@ -512,6 +533,16 @@ class Scheduler:
                 "serve_too_large_total",
                 "folds rejected by the HBM admission guard: footprint "
                 "exceeds the largest configured mesh slice")
+        # cross-bucket admission pricer (ISSUE 13): built after the
+        # mesh block so it shares the HBM model's pair/MSA cost terms
+        # when one is configured; None whenever the policy never asks
+        # for cross-bucket admission
+        self._admission_pricer: Optional[AdmissionPricer] = None
+        if recycle_policy is not None and recycle_policy.cross_bucket:
+            self._admission_pricer = AdmissionPricer(
+                memory=(None if mesh_policy is None
+                        else mesh_policy.memory),
+                max_pad_frac=recycle_policy.cross_bucket_max_pad_frac)
         self._c_drains = reg.counter(
             "serve_drains_total", "graceful drains started")
         self._c_failovers = reg.counter(
@@ -713,6 +744,20 @@ class Scheduler:
         asked for it."""
         return self._use_step_loop() and self.recycle_policy.continuous \
             and hasattr(self.executor, "run_init_rows")
+
+    def _use_cross_bucket(self) -> bool:
+        """True when freed rows may additionally admit pending work
+        from SHORTER buckets at the host shape (cross-bucket continuous
+        batching, ISSUE 13) — the continuous machinery plus a policy
+        that asked for it (the pricer exists iff it did)."""
+        return self._use_continuous() and self.recycle_policy.cross_bucket
+
+    def _eager_form_on(self) -> bool:
+        """Admission-aware batch formation (ISSUE 13): form an
+        under-filled batch immediately instead of waiting out max_wait,
+        counting on mid-loop row admission to top it up. Only
+        meaningful when admission can actually run."""
+        return self._use_continuous() and self.recycle_policy.eager_form
 
     # -- kernel selection (ISSUE 12) -------------------------------------
 
@@ -1433,7 +1478,11 @@ class Scheduler:
                 rows_dead_steps=self._n_rows_dead_steps,
                 rows_occupied_fraction=(
                     self._row_steps_live / row_steps if row_steps
-                    else 0.0))
+                    else 0.0),
+                # cross-bucket admission (ISSUE 13; zero/off keys kept
+                # when the feature is off so baselines compare)
+                cross_bucket_admissions=self._n_cross_admissions,
+                cross_bucket_refusals=self._n_cross_refusals)
         if self.kernel_policy is not None:
             with self._cond:
                 folds = {f"{kind}:{bucket}":
@@ -1692,9 +1741,13 @@ class Scheduler:
         batching paths so they cannot drift."""
         cfg = self.config
         oldest = min(e.enqueued_at for e in entries)
+        # eager formation (ISSUE 13): with mid-loop admission available
+        # to top an under-filled batch up, any entry at all makes the
+        # bucket ready — max_wait becomes a fallback, not a floor
         ready = (len(entries) >= cfg.max_batch_size
                  or (now - oldest) * 1000.0 >= cfg.max_wait_ms
-                 or stopping)
+                 or stopping
+                 or self._eager_form_on())
         if not ready:
             return None
         # higher priority folds first; FIFO within a priority level
@@ -1966,10 +2019,18 @@ class Scheduler:
                 under the planned pattern — or DENSE when the plan
                 degenerates to nearly-all-live. Planning trouble keeps
                 the static mask (an observability loss, never a
-                serving one)."""
+                serving one). Per-row REAL lengths ride along (via the
+                live position->row map) so dead rows — and the padding
+                region of a shorter admitted fold (ISSUE 13) — plan as
+                dead blocks, never as garbage-live ones."""
                 try:
+                    row_lengths = [0] * cfg.max_batch_size
+                    for pos in range(len(active)):
+                        row_lengths[rows[pos]] = \
+                            active[pos].request.length
                     planned = self.kernel_policy.contact_spec_for(
-                        bucket_len, np.asarray(st.distogram))
+                        bucket_len, np.asarray(st.distogram),
+                        lengths=row_lengths)
                 except Exception:
                     return kspec, False
                 self._c_kernel_replans.inc()
@@ -2023,9 +2084,18 @@ class Scheduler:
                         "rows_total": cfg.max_batch_size}
                 if step_kernel is not None:
                     step_kw["kernel"] = step_kernel
+                t_step = time.monotonic()
                 state = self._run_step_guarded(
                     lambda st=state, rr=r, kw=step_kw:
                     self.executor.run_step(batch, st, rr, **kw))
+                # per-bucket step-seconds EWMA: what the cross-bucket
+                # AdmissionPricer converts loop extension into wall
+                # time with (and the native-delay projection's
+                # loop-drain term)
+                dt_step = time.monotonic() - t_step
+                prev_s = self._step_ewma.get(bucket_len)
+                self._step_ewma[bucket_len] = dt_step if prev_s is None \
+                    else 0.5 * prev_s + 0.5 * dt_step
                 ages = [a + 1 for a in ages]
                 self._n_recycles_exec += 1
                 self._c_recycles.inc()
@@ -2041,6 +2111,15 @@ class Scheduler:
                     self._n_rows_dead_steps += dead
                     self._c_rows_dead_steps.inc(dead)
                 self._g_rows_occupied.set(live / cfg.max_batch_size)
+                # occupancy-weighted TOKEN accounting (ISSUE 13): the
+                # formation-time padding_waste only prices the founders'
+                # grid; this prices what each executed step actually
+                # carried — live rows' real residues over the full
+                # (B, L) grid — so admitted rows (and the padding a
+                # cross-bucket admit accepts) are observable
+                self.metrics.record_step_occupancy(
+                    sum(e.request.length for e in active),
+                    cfg.max_batch_size * bucket_len)
                 if fetch_steps:
                     coords_np = np.asarray(state.coords)
                     conf_np = np.asarray(state.confidence)
@@ -2185,7 +2264,7 @@ class Scheduler:
             for e in survivors:
                 self._resolve_entry(e, FoldResponse(
                     request_id=e.request.request_id, status="error",
-                    bucket_len=bucket_len, error=repr(exc),
+                    bucket_len=e.bucket_len, error=repr(exc),
                     attempts=e.attempts))
             return
         if self._breaker is not None:
@@ -2281,6 +2360,148 @@ class Scheduler:
             self._depth += 1
             self._cond.notify_all()
 
+    def _native_delay_s(self, native_bucket: int, now: float,
+                        inline: bool, remaining_host_steps: int,
+                        host_step_s: float) -> float:
+        """Caller holds `_cond`. Projected seconds until
+        `native_bucket`'s pending work folds through normal batch
+        formation — the latency a cross-bucket admission buys back,
+        and the number the AdmissionPricer weighs padded compute
+        against. Three terms, max-combined:
+
+        - the batch-formation window: time left until the bucket's
+          oldest entry ages past max_wait (zero when the bucket
+          already holds a full batch, or under eager formation);
+        - inline loops: only this worker forms batches and IT is held
+          by the running loop, so the loop's remaining steps gate
+          everything (this term is why inline cross-bucket admission
+          prices favorably exactly when the native alternative would
+          wait out the whole drain anyway);
+        - leased loops: when no slice of the native shape is free, the
+          soonest capacity we can PROVE will free is this loop's own
+          slice at drain — the same remaining-steps bound (other
+          leases may free sooner, but a lower bound here only makes
+          the pricer conservative about stealing from a bucket that
+          could form right now).
+        """
+        pend = self._pending.get(native_bucket) or []
+        wait_left = 0.0
+        if pend and len(pend) < self.config.max_batch_size \
+                and not self._eager_form_on():
+            oldest = min(e.enqueued_at for e in pend)
+            wait_left = max(0.0, self.config.max_wait_ms / 1000.0
+                            - (now - oldest))
+        if inline:
+            return max(wait_left, remaining_host_steps * host_step_s)
+        if self._allocator is not None \
+                and not self._allocator.can_allocate(
+                    self.mesh_policy.shape_for(native_bucket)):
+            return max(wait_left, remaining_host_steps * host_step_s)
+        return wait_left
+
+    def _cross_admissible(self, e: _Entry, host_bucket: int,
+                          batch_msa_depth: int, now: float) -> bool:
+        """THE cross-bucket admissibility predicate — ONE copy shared
+        by the inline yield gate and `_take_cross_candidate`'s scan so
+        they can never drift: an entry the take would skip (bisection
+        group, backoff-gated retry, pad-frac guard, MSA deeper than
+        the batch, already pricer-refused) must make the gate YIELD
+        the worker, or it would starve behind a loop that keeps
+        refilling past it. `cross_refused` is one-shot on purpose: a
+        refusal commits the entry to the drain + native-formation
+        fallback (and bounds the refusal counter at one per entry)
+        rather than re-pricing it every gap."""
+        return (e.group is None and e.not_before <= now
+                and not e.cross_refused
+                and 1.0 - e.request.length / float(host_bucket)
+                <= self.recycle_policy.cross_bucket_max_pad_frac
+                and not (self.config.msa_depth is None
+                         and e.request.msa is not None
+                         and int(e.request.msa.shape[0])
+                         > batch_msa_depth))
+
+    def _take_cross_candidate(self, host_bucket: int,
+                              batch_msa_depth: int,
+                              ages: List[int],
+                              admitted_this_round: bool,
+                              inline: bool):
+        """Cross-bucket admission take (ISSUE 13): pop the best PRICED
+        candidate from the SHORTER buckets' pending queues, or None.
+        Candidates are considered in the same deadline/priority/FIFO
+        order (and under the same eligibility rules) as the same-bucket
+        take, across every bucket below the host's; each is priced by
+        the AdmissionPricer against its own native-bucket delay
+        projection, and refusals stay pending (normal formation — or a
+        later, cheaper gap — serves them). Returns (entry, decision).
+        """
+        pricer = self._admission_pricer
+        cfg = self.config
+        now = time.monotonic()
+        host_step_s = self._step_ewma.get(host_bucket, 0.0)
+        num_recycles = cfg.num_recycles
+        # steps the host loop still runs regardless of this admission:
+        # a row admitted earlier this round restarts at age 0, so the
+        # loop already owes the full depth and the candidate rides it
+        # for free
+        remaining = num_recycles if admitted_this_round else \
+            max(0, num_recycles - (min(ages) if ages else 0))
+        taken = None
+        with self._cond:
+            if not self._running and not self._drain:
+                return None
+            while self._incoming:
+                entry = self._incoming.popleft()
+                self._pending.setdefault(entry.bucket_len,
+                                         []).append(entry)
+            cands = []
+            for native, pend in self._pending.items():
+                if native >= host_bucket:
+                    continue
+                for e in pend:
+                    # shared predicate with the inline yield gate
+                    # (group/backoff/pad/MSA/one-shot refusal); the
+                    # expired-deadline skip stays take-only — the
+                    # worker's shed sweep owns those
+                    if not self._cross_admissible(e, host_bucket,
+                                                  batch_msa_depth, now):
+                        continue
+                    if e.deadline is not None and e.deadline <= now:
+                        continue
+                    k = (e.deadline is None, e.deadline or 0.0,
+                         -e.request.priority, e.enqueued_at)
+                    cands.append((k, e, native))
+            cands.sort(key=lambda t: t[0])
+            for _, e, native in cands:
+                delay = self._native_delay_s(native, now, inline,
+                                             remaining, host_step_s)
+                slack = None if e.deadline is None \
+                    else e.deadline - now
+                decision = pricer.price(
+                    native_len=native, host_len=host_bucket,
+                    length=e.request.length,
+                    batch_size=cfg.max_batch_size,
+                    msa_depth=(cfg.msa_depth
+                               if cfg.msa_depth is not None
+                               else batch_msa_depth),
+                    candidate_steps=num_recycles,
+                    remaining_host_steps=remaining,
+                    native_delay_s=delay, deadline_slack_s=slack,
+                    host_step_s=host_step_s)
+                if decision.admit:
+                    self._pending[native].remove(e)
+                    taken = (e, decision)
+                    break
+                e.cross_refused = True
+                self._n_cross_refusals += 1
+                e.trace.event("cross_bucket_refused",
+                              host_bucket=host_bucket,
+                              reason=decision.reason,
+                              pad_frac=round(decision.pad_frac, 4))
+        if taken is None:
+            return None
+        self._resolve_removed([taken[0]])
+        return taken
+
     def _admitted_batch(self, batch: dict, bucket_len: int,
                         placements: List[Tuple[int, _Entry]]) -> dict:
         """Fresh batch dict with each admitted request written into its
@@ -2352,8 +2573,17 @@ class Scheduler:
         starves behind it, so inline admission additionally yields —
         stops admitting, letting the loop drain within num_recycles
         steps — as soon as any OTHER bucket holds work past its
-        max_wait window. Mesh-leased loops run on pool threads and
-        leave the worker free, so they never need the gate.
+        max_wait window that admission itself cannot serve (under a
+        cross-bucket policy a shorter bucket's overdue entry that the
+        cross take will reach this gap no longer forces the yield —
+        see the gate comment below). Mesh-leased loops run on pool
+        threads and leave the worker free, so they never need the
+        gate.
+
+        With a CROSS-BUCKET policy (ISSUE 13), a round whose host
+        queue is dry falls through to `_take_cross_candidate`:
+        pending requests from SHORTER buckets may ride the freed rows
+        at the host shape, priced per admit.
 
         Mutates active/rows/ages/all_members in place for the admitted
         entries; returns (batch, state, admitted)."""
@@ -2368,31 +2598,71 @@ class Scheduler:
         if self._breaker is not None \
                 and not self._breaker.allow_execute():
             return batch, state, []
+        depth = 0 if batch.get("msa") is None \
+            else int(batch["msa"].shape[1])
         if inline:
             now = time.monotonic()
+            cross = self._use_cross_bucket()
             with self._cond:
+                # cross-bucket admission (ISSUE 13) can serve a SHORTER
+                # bucket's overdue entry right here in the loop, so it
+                # no longer forces the yield — but ONLY when the cross
+                # take will actually reach it this gap: the host
+                # bucket's own queue must be dry (same-bucket
+                # candidates fill rows first — with host pending the
+                # gate bails exactly like PR 11, so sustained
+                # same-bucket traffic can never starve other buckets)
+                # and the entry must pass THE SAME `_cross_admissible`
+                # predicate the take's scan applies (bisection group,
+                # backoff gate, pad-frac guard, MSA depth, one-shot
+                # pricer refusal) — an entry the take would skip must
+                # force the yield, or it starves behind a loop that
+                # keeps refilling past it. (A take-eligible entry
+                # outranked gap after gap by tighter-deadline cross
+                # candidates follows the system-wide deadline-first
+                # discipline, same as everywhere else work queues.)
+                host_pending = bool(self._pending.get(bucket_len))
                 for other, pend in self._pending.items():
                     if other == bucket_len:
                         continue
-                    if any((now - e.enqueued_at) * 1000.0
-                           >= cfg.max_wait_ms for e in pend):
-                        # another bucket is past its batch-formation
-                        # window and only this worker can serve it:
-                        # stop refilling so the loop ends and the
-                        # worker gets back to _form_batch
-                        return batch, state, []
-        depth = 0 if batch.get("msa") is None \
-            else int(batch["msa"].shape[1])
+                    for e in pend:
+                        if (now - e.enqueued_at) * 1000.0 \
+                                < cfg.max_wait_ms:
+                            continue
+                        servable = (cross and not host_pending
+                                    and other < bucket_len
+                                    and self._cross_admissible(
+                                        e, bucket_len, depth, now))
+                        if not servable:
+                            # only this worker can serve it: stop
+                            # refilling so the loop ends and the worker
+                            # gets back to _form_batch
+                            return batch, state, []
         placements: List[Tuple[int, _Entry]] = []
+        cross_admits: List[_Entry] = []
         while free:
+            decision = None
             e = self._take_admission_candidate(bucket_len, depth)
+            if e is None and self._use_cross_bucket():
+                # this bucket's own queue is dry but rows are still
+                # free: a pending request from a SHORTER bucket may
+                # ride them at the host shape — if the pricer says the
+                # padding beats its native-bucket queue delay
+                # (ISSUE 13)
+                taken = self._take_cross_candidate(
+                    bucket_len, depth, ages, bool(placements), inline)
+                if taken is not None:
+                    e, decision = taken
             if e is None:
                 break
-            # HBM guard, mirroring submit(): an unpinned msa_depth
-            # prices the request's own depth. The policy (or its
-            # budget) may have tightened since this entry passed the
-            # door — a refused candidate goes back to pending and the
-            # round stops (its siblings would refuse identically).
+            # HBM guard, mirroring submit() but RE-PRICED AT THE HOST
+            # SHAPE (a cross-bucket candidate joins the host batch's
+            # footprint, not its native bucket's): an unpinned
+            # msa_depth prices the request's own depth. The policy (or
+            # its budget) may have tightened since this entry passed
+            # the door — a refused candidate goes back to its NATIVE
+            # pending queue (normal formation serves it) and the round
+            # stops (its siblings would refuse identically).
             if self.mesh_policy is not None:
                 guard_msa = cfg.msa_depth
                 if guard_msa is None:
@@ -2402,8 +2672,8 @@ class Scheduler:
                         bucket_len, cfg.max_batch_size, guard_msa,
                         carry_recyclables=True, continuous=True):
                     e.trace.event("row_admission_refused_hbm",
-                                  gap=gap)
-                    self._readmit_pending(bucket_len, e)
+                                  gap=gap, host_bucket=bucket_len)
+                    self._readmit_pending(e.bucket_len, e)
                     break
             key = None
             if self.cache is not None:
@@ -2422,7 +2692,10 @@ class Scheduler:
                         request_id=e.request.request_id, status="ok",
                         coords=cached.coords.copy(),
                         confidence=cached.confidence.copy(),
-                        bucket_len=bucket_len,
+                        # the entry's NATIVE bucket (== the loop's for
+                        # same-bucket candidates; a cross-bucket one
+                        # must not report the host's)
+                        bucket_len=e.bucket_len,
                         latency_s=time.monotonic() - e.enqueued_at,
                         source="cache")
                     e.resolve(resp)
@@ -2446,6 +2719,13 @@ class Scheduler:
                         self.metrics.record_coalesced()
                         continue
             placements.append((free.pop(0), e))
+            if decision is not None:
+                cross_admits.append(e)
+                e.trace.event("cross_bucket_admitted",
+                              native_bucket=e.bucket_len,
+                              host_bucket=bucket_len,
+                              reason=decision.reason,
+                              pad_frac=round(decision.pad_frac, 4))
         if not placements:
             return batch, state, []
         admitted = [e for _, e in placements]
@@ -2461,10 +2741,23 @@ class Scheduler:
             active.append(e)
             rows.append(row)
             ages.append(0)
-            e.trace.event("row_admitted", gap=gap, row=row)
+            e.trace.event("row_admitted", gap=gap, row=row,
+                          native_bucket=e.bucket_len)
+            # per-admit pad fraction at the host edge: the padding an
+            # admission accepted in exchange for a live row (ISSUE 13;
+            # same-bucket admits land in the low bins, cross-bucket
+            # ones are the distribution's whole point)
+            self.metrics.record_admit(
+                1.0 - e.request.length / float(bucket_len))
         all_members.extend(admitted)
         self._n_row_admissions += len(admitted)
         self._c_row_admissions.inc(len(admitted))
+        if cross_admits:
+            self._n_cross_admissions += len(cross_admits)
+            for e in cross_admits:
+                self._c_cross_admissions.inc(
+                    host_bucket=str(bucket_len),
+                    native_bucket=str(e.bucket_len))
         new_batch = self._admitted_batch(batch, bucket_len, placements)
         row_mask = np.zeros((cfg.max_batch_size,), bool)
         for row, _ in placements:
@@ -2475,6 +2768,16 @@ class Scheduler:
         # warmup pre-compiled) — a contact-planned step spec describes
         # the founders' contacts, not a newly admitted target's
         admit_kw = {} if kernel is None else {"kernel": kernel}
+        if self._use_cross_bucket():
+            # admit spans tagged with the admitted rows' native buckets
+            # (ISSUE 13 obs): only under a cross-bucket policy, where
+            # the executor is known to speak the kwarg (custom stubs
+            # without it keep working under plain continuous)
+            admit_kw["span_attrs"] = {
+                "host_bucket": bucket_len,
+                "native_bucket": ",".join(
+                    str(b) for b in sorted({e.bucket_len
+                                            for e in admitted}))}
         state = self._run_step_guarded(
             lambda: self.executor.run_init_rows(
                 new_batch, state, row_mask, trace=admit_trace,
@@ -2486,12 +2789,18 @@ class Scheduler:
         """Terminal "ok" resolution for one step-loop element at
         `recycles` executed iterations (early-converged or final).
         Returns False when the output failed non-finite validation
-        (the entry then went through _resolve_nonfinite instead)."""
+        (the entry then went through _resolve_nonfinite instead).
+        Metrics and the response report the entry's own NATIVE bucket
+        (`e.bucket_len`) — identical to the loop's `bucket_len` for
+        every founder and same-bucket admit, but a CROSS-bucket
+        admitted fold (ISSUE 13) must land in its native bucket's
+        latency histogram, or the short-fold p99 the feature exists to
+        improve would be invisible (filed under the host bucket)."""
         n = e.request.length
         if self.retry is not None and not (
                 np.isfinite(coords_row[:n]).all()
                 and np.isfinite(conf_row[:n]).all()):
-            self._resolve_nonfinite(e, bucket_len)
+            self._resolve_nonfinite(e, e.bucket_len)
             return False
         coords = coords_row[:n].copy()
         confidence = conf_row[:n].copy()
@@ -2505,11 +2814,11 @@ class Scheduler:
             except Exception:
                 pass
         latency = now - e.enqueued_at
-        self.metrics.record_served(bucket_len, latency)
+        self.metrics.record_served(e.bucket_len, latency)
         self._resolve_entry(e, FoldResponse(
             request_id=e.request.request_id, status="ok",
             coords=coords, confidence=confidence,
-            bucket_len=bucket_len, latency_s=latency,
+            bucket_len=e.bucket_len, latency_s=latency,
             attempts=e.attempts, recycles=recycles))
         return True
 

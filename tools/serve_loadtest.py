@@ -186,6 +186,28 @@ def parse_args(argv=None):
                          "padding until the batch's last survivor "
                          "finishes; the report adds rows_occupied_"
                          "fraction / row_admissions / rows_dead_steps")
+    ap.add_argument("--cross-bucket", action="store_true",
+                    help="cross-bucket continuous batching (ISSUE 13, "
+                         "implies --continuous): a freed row whose own "
+                         "bucket's queue is dry admits a pending "
+                         "request from a SHORTER bucket at the host "
+                         "shape — priced per admit (padded step cost "
+                         "x loop extension vs projected native-bucket "
+                         "queue delay, deadline urgency tiebreak). The "
+                         "report adds cross_bucket_admissions / "
+                         "cross_bucket_refusals / "
+                         "padding_waste_admitted / admit_pad_fraction")
+    ap.add_argument("--cross-bucket-max-pad-frac", type=float,
+                    default=0.75,
+                    help="hard guard: refuse a cross-bucket candidate "
+                         "whose pad fraction at the host edge "
+                         "(1 - length/host_edge) exceeds this")
+    ap.add_argument("--eager-form", action="store_true",
+                    help="admission-aware batch formation (ISSUE 13, "
+                         "implies --continuous): form an under-filled "
+                         "batch immediately instead of waiting out "
+                         "max_wait, counting on mid-loop row admission "
+                         "to top it up")
     ap.add_argument("--min-recycles", type=int, default=0,
                     help="recycles every element must run before "
                          "early exit may fire")
@@ -350,7 +372,12 @@ def _build_recycle_policy(args):
                          min_recycles=args.min_recycles,
                          preempt=not args.no_preempt,
                          stream=args.stream,
-                         continuous=getattr(args, "continuous", False))
+                         continuous=getattr(args, "continuous", False),
+                         cross_bucket=getattr(args, "cross_bucket",
+                                              False),
+                         cross_bucket_max_pad_frac=getattr(
+                             args, "cross_bucket_max_pad_frac", 0.75),
+                         eager_form=getattr(args, "eager_form", False))
 
 
 def _build_kernel_policy(args, policy):
@@ -541,6 +568,8 @@ def _build_tiny_model(args, jax, jnp, policy):
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.cross_bucket or args.eager_form:
+        args.continuous = True       # both ride the continuous batcher
     if args.continuous:
         args.recycle_sched = True    # continuous batching IS step mode
     import __graft_entry__
@@ -819,6 +848,15 @@ def main(argv=None) -> int:
         report["row_admissions"] = rec["row_admissions"]
         report["rows_dead_steps"] = rec["rows_dead_steps"]
         report["continuous"] = bool(args.continuous)
+        # cross-bucket trade observability (ISSUE 13): identical keys
+        # with --cross-bucket off, so the smoke's same-bucket-only
+        # baseline comparison reads the same stats from both runs
+        report["cross_bucket"] = bool(args.cross_bucket)
+        report["cross_bucket_admissions"] = rec["cross_bucket_admissions"]
+        report["cross_bucket_refusals"] = rec["cross_bucket_refusals"]
+        report["padding_waste_admitted"] = round(
+            snap["padding_waste_admitted"], 4)
+        report["admit_pad_fraction"] = snap["admit_pad_fraction"]
         if calibrated_tol is not None:
             report["converge_tol_calibrated"] = calibrated_tol
         from alphafold2_tpu.utils.profiling import percentile
@@ -936,6 +974,16 @@ def main(argv=None) -> int:
                       f"{args.converge_tol} never admitted a row "
                       f"(recycle stats {rec})", file=sys.stderr)
                 return 1
+        if recycle_policy is not None and args.cross_bucket \
+                and snap["recycle"]["cross_bucket_admissions"] == 0:
+            # a mixed-bucket workload that never admitted across
+            # buckets means the cross-bucket batcher is dead weight —
+            # fail loudly (independent of convergence injection: freed
+            # rows also come from under-filled formation)
+            print(f"SMOKE FAIL: --cross-bucket never admitted "
+                  f"across buckets (recycle stats {snap['recycle']})",
+                  file=sys.stderr)
+            return 1
         extra = (f", {cache_snap['hits']} cache hits, "
                  f"{cache_snap['coalesced']} coalesced"
                  if cache_on else "")
@@ -953,6 +1001,12 @@ def main(argv=None) -> int:
                 extra += (f", rows occupied "
                           f"{report['rows_occupied_fraction']} "
                           f"({report['row_admissions']} row admissions)")
+            if args.cross_bucket:
+                extra += (f", {report['cross_bucket_admissions']} "
+                          f"cross-bucket admits "
+                          f"({report['cross_bucket_refusals']} refused, "
+                          f"waste admitted "
+                          f"{report['padding_waste_admitted']})")
         print(f"SMOKE OK: {snap['served']} folds, 0 shed/errors{extra}",
               file=sys.stderr)
     return 0
@@ -1593,7 +1647,10 @@ def _run_procs(args) -> int:
             min_recycles=args.min_recycles,
             preempt=not args.no_preempt,
             stream=args.stream,
-            continuous=args.continuous)))
+            continuous=args.continuous,
+            cross_bucket=args.cross_bucket,
+            cross_bucket_max_pad_frac=args.cross_bucket_max_pad_frac,
+            eager_form=args.eager_form)))
     print(f"procfleet: starting {n} replica processes under {run_dir}",
           file=sys.stderr)
     try:

@@ -106,6 +106,21 @@
 #      folds/hour (skipped when clamped to CPU, where the masked-dense
 #      fallback serves and only routing + numerics are meaningful).
 #      The kernel-selection tripwire.
+#  12. cross-bucket continuous batching (--cross-bucket --eager-form,
+#      RecyclePolicy(cross_bucket)): a skewed mixed-bucket workload
+#      (3:1 short vs flagship-bucket) with measured skewed
+#      convergence, run TWICE on the identical schedule — the PR-11
+#      same-bucket-only continuous baseline, then --cross-bucket
+#      --eager-form (freed flagship rows admit pending SHORT folds at
+#      the host shape, priced per admit; thin queues form eagerly and
+#      let admission top them up). FAILS unless cross-bucket
+#      admissions actually fired, rows occupied is STRICTLY above the
+#      baseline, the SHORT bucket's p99 is STRICTLY below the
+#      baseline's, every request resolves ok in both runs
+#      (admitted-row numerics pinned byte-equal-to-host-shape in
+#      tests/test_crossbucket.py), and obs_report --check is clean
+#      with native_bucket-tagged admit spans present. The
+#      cross-bucket-batching tripwire.
 #   7. multi-chip mesh serving (--mesh-policy, serve.MeshPolicy) under
 #      XLA_FLAGS=--xla_force_host_platform_device_count=8: a mixed
 #      short+long workload where the long bucket is pinned to a 4-chip
@@ -138,7 +153,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 DURATION="${SMOKE_DURATION_S:-30}"
-PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8,9,10,11}"
+PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8,9,10,11,12}"
 
 phase_on() {
     case ",${PHASES}," in
@@ -714,4 +729,111 @@ print(f"KERNEL SMOKE OK: {bs_served} folds through blocksparse "
       f"{sparse['folds_per_hour']} vs dense {base['folds_per_hour']}"
       f"{note}", file=sys.stderr)
 EOF2
+fi
+
+# phase 12: cross-bucket continuous batching (ISSUE 13) — a skewed
+# mixed-bucket workload (3:1 short vs flagship-bucket) at THIN
+# concurrency with a meaningful max_wait window (the regime the
+# feature owns: flagship loops run under-filled while short folds
+# trickle in), run TWICE on the identical schedule: the PR-11
+# same-bucket-only continuous baseline, then with --cross-bucket
+# --eager-form. The cross run must admit across buckets (the priced
+# padding-vs-dead-row trade actually firing), hold rows occupied
+# strictly above the baseline (freed/never-filled flagship rows carry
+# short folds instead of padding dead), and beat the baseline's
+# SHORT-fold p99 (shorts ride the running loop or form eagerly
+# instead of waiting out max_wait behind it), with zero bad outcomes
+# in both runs and orphan-free native_bucket-tagged admit spans in
+# the waterfall. No deadlines on purpose: deadline traffic is served
+# by preemption (phase 8); this phase isolates the admission trade.
+if phase_on 12; then
+rm -f /tmp/serve_smoke_xb_traces.jsonl
+
+xb_phase() {  # $1 = report path, extra args follow
+    local out="$1"; shift
+    timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+        python tools/serve_loadtest.py \
+        --smoke \
+        --requests 64 \
+        --lengths 12,12,12,28 \
+        --buckets 16,32 \
+        --msa-depth 3 \
+        --max-batch 4 \
+        --max-wait-ms 150 \
+        --concurrency 4 \
+        --num-recycles 3 \
+        --continuous \
+        "$@" > "$out"
+    cat "$out"
+}
+
+xb_phase /tmp/serve_smoke_xb_base.json \
+    --metrics-path /tmp/serve_smoke_xb_base.jsonl
+xb_phase /tmp/serve_smoke_xb.json \
+    --cross-bucket --eager-form \
+    --metrics-path /tmp/serve_smoke_xb.jsonl \
+    --trace-path /tmp/serve_smoke_xb_traces.jsonl \
+    --prom-path /tmp/serve_smoke_xb.prom
+
+timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/obs_report.py /tmp/serve_smoke_xb_traces.jsonl \
+    --check --prom /tmp/serve_smoke_xb.prom
+
+env -u PYTHONPATH python - <<'EOF'
+import json, sys
+base = json.load(open("/tmp/serve_smoke_xb_base.json"))
+xb = json.load(open("/tmp/serve_smoke_xb.json"))
+problems = []
+if xb.get("cross_bucket_admissions", 0) <= 0:
+    problems.append("no cross-bucket admissions fired")
+if base.get("cross_bucket_admissions", 0):
+    problems.append(f"baseline (cross-bucket off) admitted "
+                    f"{base['cross_bucket_admissions']} across buckets")
+if xb["rows_occupied_fraction"] <= base["rows_occupied_fraction"]:
+    problems.append(
+        f"cross-bucket rows occupied {xb['rows_occupied_fraction']} <= "
+        f"baseline {base['rows_occupied_fraction']}")
+short = str(min(int(b) for b in xb["bucket_edges"]))
+xb_p99 = xb["latency_by_bucket"][short]["p99_s"]
+base_p99 = base["latency_by_bucket"][short]["p99_s"]
+if xb_p99 >= base_p99:
+    problems.append(f"short-fold p99 {xb_p99} >= baseline {base_p99}")
+xb_p50 = xb["latency_by_bucket"][short]["p50_s"]
+base_p50 = base["latency_by_bucket"][short]["p50_s"]
+if xb_p50 >= base_p50:
+    # the baseline's max_wait formation floor should dominate its
+    # whole short-fold distribution, not just the tail
+    problems.append(f"short-fold p50 {xb_p50} >= baseline {base_p50}")
+for rep in (base, xb):
+    bad = rep["shed"] + rep["errors"] + rep["rejected"] + \
+        len(rep["failures"])
+    if bad or rep["served"] == 0:
+        problems.append(f"{bad} bad outcomes / {rep['served']} served "
+                        f"in {'xb' if rep is xb else 'base'} run")
+admit_tagged = 0
+for line in open("/tmp/serve_smoke_xb_traces.jsonl"):
+    try:
+        rec = json.loads(line)
+    except ValueError:
+        continue
+    for s in rec.get("spans", ()):
+        if s.get("name") == "admit" and \
+                (s.get("attrs") or {}).get("native_bucket"):
+            admit_tagged += 1
+if admit_tagged == 0:
+    problems.append("no native_bucket-tagged admit spans in the "
+                    "cross-bucket traces")
+if problems:
+    print("CROSS-BUCKET SMOKE FAIL: " + "; ".join(problems),
+          file=sys.stderr)
+    sys.exit(1)
+print(f"CROSS-BUCKET SMOKE OK: {xb['cross_bucket_admissions']} "
+      f"cross-bucket admits ({xb['cross_bucket_refusals']} refused), "
+      f"rows occupied {xb['rows_occupied_fraction']} > "
+      f"{base['rows_occupied_fraction']}, short-fold p99 {xb_p99} < "
+      f"{base_p99} (p50 {xb_p50} < {base_p50}), waste admitted "
+      f"{xb['padding_waste_admitted']} (formation said "
+      f"{xb['padding_waste']}), {admit_tagged} "
+      f"native_bucket-tagged admit spans", file=sys.stderr)
+EOF
 fi
