@@ -195,6 +195,56 @@ def fold_init_rows(model, params, seq, row_mask, state: FoldStepState,
     return jax.tree_util.tree_map(sel, fresh, state)
 
 
+def snapshot_step_state(state):
+    """Host-side snapshot of a step-loop carry (ISSUE 14: the carry-
+    checkpointing half of the scheduler's step-loop fault domain).
+    Device leaves are fetched to numpy WITH their sharding recorded, so
+    `restore_step_state` can re-upload a mesh-sharded carry back onto
+    the exact slice it left; non-array leaves (custom test-executor
+    states are opaque objects) are kept by reference — they are
+    host-side already and step stubs mint fresh state objects per
+    iteration, so the reference stays immutable. The snapshot survives
+    an executor rebuild: nothing in it references the executor or its
+    compiled programs."""
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    snap = []
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array):
+            snap.append(("dev", np.asarray(leaf),
+                         getattr(leaf, "sharding", None)))
+        else:
+            snap.append(("ref", leaf, None))
+    return treedef, snap
+
+
+def restore_step_state(snapshot):
+    """Re-upload a `snapshot_step_state` checkpoint: device leaves go
+    back through their recorded sharding (falling back to a fresh
+    default-device `jnp.array` when the sharding no longer applies —
+    e.g. after an executor rebuild changed device objects), reference
+    leaves pass through untouched. The restored carry is byte-equal to
+    the snapshotted one — a resumed step loop continues exactly where
+    the checkpoint left it."""
+    treedef, snap = snapshot
+    leaves = []
+    for kind, val, sharding in snap:
+        if kind != "dev":
+            leaves.append(val)
+            continue
+        arr = None
+        if sharding is not None:
+            try:
+                arr = jax.device_put(val, sharding)
+            except Exception:
+                arr = None       # stale sharding: default placement
+        if arr is None:
+            arr = jnp.array(val)
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def fold_step(model, params, seq, recyclables: Recyclables, msa=None,
               mask=None, msa_mask=None, kernel=None,
               **extra) -> FoldStepState:

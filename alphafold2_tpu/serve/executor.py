@@ -488,14 +488,19 @@ class FoldExecutor:
             ctx = use_mesh(mesh) if mesh is not None \
                 else contextlib.nullcontext()
             with ctx:
-                return self._invoke(fn, args, batch)
+                return self._invoke(fn, args, batch, variant=variant,
+                                    recycle=attrs.get("recycle"))
 
-    def _invoke(self, fn, args, batch) -> FoldResult:
+    def _invoke(self, fn, args, batch, variant: str = "fold",
+                recycle=None) -> FoldResult:
         if self.faults is not None:
             # injected exceptions/latency fire BEFORE the device
             # call (a chaos fault must not waste real accelerator
-            # time); NaN-poison rows are patched in after
-            self.faults.on_executor_run(batch)
+            # time); NaN-poison rows are patched in after. The fault
+            # hook is step-aware (ISSUE 14): the variant + recycle
+            # index let a chaos plan hit a SPECIFIC recycle depth
+            self.faults.on_executor_run(batch, variant=variant,
+                                        recycle=recycle)
         result = fn(*args)
         result = jax.block_until_ready(result)
         if self.faults is not None:

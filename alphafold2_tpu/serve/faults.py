@@ -10,9 +10,19 @@ resilience layer (serve/resilience.py) must absorb:
 - executor exceptions: each `executor.run` raises
   `TransientExecutorError` with probability `exec_error_rate` (the
   retry path) — injected BEFORE the device call, so an injected fault
-  never wastes real accelerator time;
+  never wastes real accelerator time. The hook is STEP-AWARE
+  (ISSUE 14): the executor passes the ExecKey variant
+  ("fold"/"init"/"step"/"init_rows") and, for step executions, the
+  recycle index, so `step_fail_at={recycle: rate}` can hit a SPECIFIC
+  recycle depth mid-loop deterministically (the carry-checkpointing
+  resume path), and `snapshot()` tags injection counts by variant;
 - latency spikes: probability `exec_latency_rate` of sleeping
   `exec_latency_s` inside `executor.run` (the watchdog path);
+- featurize faults: `FeaturePool(faults=)` calls `on_featurize` before
+  each featurize execution — probability `featurize_error_rate` of
+  raising (the error must fan out to every coalesced waiter without
+  wedging the pool) and `featurize_latency_rate` of sleeping
+  `featurize_latency_s` (the feature-deadline path);
 - poison inputs: sequences registered via `add_poison(seq)` are
   recognized IN THE ASSEMBLED BATCH by content (padded row prefix +
   mask length), so the fault follows the request through batching,
@@ -54,14 +64,22 @@ from alphafold2_tpu.serve.resilience import TransientExecutorError
 
 class FaultInjected(RuntimeError):
     """A deliberately injected DETERMINISTIC failure (poison input):
-    never classified transient, so it exercises the bisection path."""
+    never classified transient, so it exercises the bisection path.
+    When the injection site can attribute the failure to specific
+    batch rows it sets `.rows` (a list of batch row indices) — the
+    scheduler's per-row poison isolation (RetryPolicy(row_isolation))
+    reads it to retire exactly those rows; failures without row
+    attribution fall back to whole-batch bisection."""
+
+    rows = None
 
 
 class FaultPlan:
     """Seeded chaos configuration threaded through serving components."""
 
-    KINDS = ("exec_error", "exec_latency", "poison_raise", "poison_nan",
-             "peer_error", "cache_corrupt")
+    KINDS = ("exec_error", "exec_latency", "step_fail", "poison_raise",
+             "poison_nan", "peer_error", "cache_corrupt",
+             "featurize_error", "featurize_latency")
 
     def __init__(self, seed: int = 0,
                  exec_error_rate: float = 0.0,
@@ -69,11 +87,22 @@ class FaultPlan:
                  exec_latency_s: float = 0.0,
                  peer_error_rate: float = 0.0,
                  corrupt_rate: float = 0.0,
+                 step_fail_at: Optional[dict] = None,
+                 featurize_error_rate: float = 0.0,
+                 featurize_latency_rate: float = 0.0,
+                 featurize_latency_s: float = 0.0,
                  registry: Optional[MetricsRegistry] = None):
+        self.step_fail_at = {int(k): float(v)
+                             for k, v in (step_fail_at or {}).items()}
         for name, rate in (("exec_error_rate", exec_error_rate),
                            ("exec_latency_rate", exec_latency_rate),
                            ("peer_error_rate", peer_error_rate),
-                           ("corrupt_rate", corrupt_rate)):
+                           ("corrupt_rate", corrupt_rate),
+                           ("featurize_error_rate", featurize_error_rate),
+                           ("featurize_latency_rate",
+                            featurize_latency_rate),
+                           *((f"step_fail_at[{k}]", v)
+                             for k, v in self.step_fail_at.items())):
             if not 0.0 <= rate <= 1.0:
                 raise ValueError(f"{name} must be in [0, 1], got {rate}")
         self.seed = int(seed)
@@ -82,14 +111,24 @@ class FaultPlan:
         self.exec_latency_s = float(exec_latency_s)
         self.peer_error_rate = float(peer_error_rate)
         self.corrupt_rate = float(corrupt_rate)
+        self.featurize_error_rate = float(featurize_error_rate)
+        self.featurize_latency_rate = float(featurize_latency_rate)
+        self.featurize_latency_s = float(featurize_latency_s)
         self._lock = threading.Lock()
         self._armed = False
         # one independent stream per site, seeded from (seed, site) so
         # sites never perturb each other's sequences
         self._rngs = {site: random.Random(f"{self.seed}:{site}")
-                      for site in ("exec", "latency", "peer", "corrupt")}
+                      for site in ("exec", "latency", "peer", "corrupt",
+                                   "step", "featurize",
+                                   "featurize_lat")}
         self._poison: List[dict] = []    # {"seq": np1d, "mode": str}
         self.injected = {k: 0 for k in self.KINDS}
+        # (kind, ExecKey variant) -> count: which executable the fault
+        # actually hit — a mid-loop "step" injection and a formation
+        # "init" injection recover through different machinery, and
+        # the chaos report must be able to tell them apart (ISSUE 14)
+        self.injected_by_variant: dict = {}
         self._m_injected = (registry or get_registry()).counter(
             "serve_faults_injected_total",
             "chaos-harness injections by kind", ("kind",))
@@ -135,9 +174,13 @@ class FaultPlan:
                 return False
             return self._rngs[site].random() < rate
 
-    def _count(self, kind: str, n: int = 1):
+    def _count(self, kind: str, n: int = 1,
+               variant: Optional[str] = None):
         with self._lock:
             self.injected[kind] += n
+            if variant is not None:
+                per = self.injected_by_variant.setdefault(variant, {})
+                per[kind] = per.get(kind, 0) + n
         self._m_injected.inc(n, kind=kind)
 
     def _poison_rows(self, batch: dict, mode: str) -> List[int]:
@@ -167,22 +210,56 @@ class FaultPlan:
 
     # -- injection sites -------------------------------------------------
 
-    def on_executor_run(self, batch: dict):
-        """Called by FoldExecutor.run before the device call. May sleep
-        (latency spike) or raise (poison / transient fault)."""
+    def on_executor_run(self, batch: dict, variant: str = "fold",
+                        recycle: Optional[int] = None):
+        """Called by FoldExecutor before the device call. May sleep
+        (latency spike) or raise (poison / transient fault). `variant`
+        is the ExecKey variant actually executing ("fold", "init",
+        "step", "init_rows" — step-mode executors pass it; legacy
+        callers default to "fold") and `recycle` the step's iteration
+        index, so `step_fail_at={recycle: rate}` can inject a
+        transient fault at a SPECIFIC recycle depth mid-loop
+        (ISSUE 14) and snapshot() tags counts by variant."""
         rows = self._poison_rows(batch, "raise")
         if rows:
-            self._count("poison_raise")
-            raise FaultInjected(
+            self._count("poison_raise", variant=variant)
+            exc = FaultInjected(
                 f"poison_input: injected deterministic failure for "
-                f"batch rows {rows}")
+                f"batch rows {rows} in {variant!r}")
+            # content-addressed chaos KNOWS the rows: attribute them so
+            # per-row poison isolation can retire exactly the offenders
+            exc.rows = list(rows)
+            raise exc
+        if self.step_fail_at and variant == "step" \
+                and recycle is not None \
+                and self._hit("step",
+                              self.step_fail_at.get(int(recycle), 0.0)):
+            self._count("step_fail", variant=variant)
+            raise TransientExecutorError(
+                f"injected mid-loop transient fault at recycle "
+                f"{recycle}")
         if self._hit("latency", self.exec_latency_rate):
-            self._count("exec_latency")
+            self._count("exec_latency", variant=variant)
             time.sleep(self.exec_latency_s)
         if self._hit("exec", self.exec_error_rate):
-            self._count("exec_error")
+            self._count("exec_error", variant=variant)
             raise TransientExecutorError(
                 "injected transient executor fault")
+
+    def on_featurize(self, key: Optional[str] = None):
+        """Called by FeaturePool workers before each featurize
+        execution (the CPU stage had zero chaos coverage before
+        ISSUE 14). May sleep (featurize latency spike — the
+        feature-deadline path) or raise (featurize failure — the pool
+        must fan it out to every coalesced waiter without wedging)."""
+        if self._hit("featurize_lat", self.featurize_latency_rate):
+            self._count("featurize_latency")
+            time.sleep(self.featurize_latency_s)
+        if self._hit("featurize", self.featurize_error_rate):
+            self._count("featurize_error")
+            raise FaultInjected(
+                f"injected featurize failure"
+                + (f" for key {key[:16]}..." if key else ""))
 
     def mutate_result(self, batch: dict, result):
         """Called by FoldExecutor.run after the device call: NaN-mode
@@ -222,6 +299,14 @@ class FaultPlan:
                     "rates": {"exec_error": self.exec_error_rate,
                               "exec_latency": self.exec_latency_rate,
                               "peer_error": self.peer_error_rate,
-                              "corrupt": self.corrupt_rate},
+                              "corrupt": self.corrupt_rate,
+                              "featurize_error":
+                                  self.featurize_error_rate,
+                              "featurize_latency":
+                                  self.featurize_latency_rate},
+                    "step_fail_at": dict(self.step_fail_at),
                     "poison_registered": len(self._poison),
-                    "injected": dict(self.injected)}
+                    "injected": dict(self.injected),
+                    "injected_by_variant": {
+                        v: dict(per) for v, per in
+                        sorted(self.injected_by_variant.items())}}

@@ -190,6 +190,11 @@ class FeaturePool:
     config_digest: feature-key config namespace; defaults to
         `featurizer_config_digest()` (pass your own when overriding
         featurize_fn — different featurizers must not share keys).
+    faults: optional serve.faults.FaultPlan — chaos hook fired before
+        each featurize execution (injected exceptions fan out to every
+        coalesced waiter exactly like a real featurize failure;
+        injected latency exercises the feature-deadline path). None
+        (default) costs nothing.
 
     Duplicate raw traffic dedups at this tier independently of fold
     traffic: an in-flight featurize of the same feature key coalesces
@@ -204,11 +209,13 @@ class FeaturePool:
                  latency_s: float = 0.0,
                  featurize_fn: Optional[Callable] = None,
                  config_digest: Optional[str] = None,
+                 faults=None,
                  registry: Optional[MetricsRegistry] = None):
         if workers < 1:
             raise ValueError("FeaturePool needs at least 1 worker")
         self.workers = int(workers)
         self.cache = cache
+        self.faults = faults
         self.latency_s = float(latency_s)
         self.featurize_fn = featurize_fn or featurize_raw
         self.config_digest = (featurizer_config_digest()
@@ -362,6 +369,11 @@ class FeaturePool:
         try:
             t_work = time.monotonic()
             try:
+                if self.faults is not None:
+                    # chaos hook (ISSUE 14): an injected featurize
+                    # failure takes the SAME path a real one does —
+                    # _settle_error fans it to every coalesced waiter
+                    self.faults.on_featurize(key)
                 if self.latency_s > 0:
                     time.sleep(self.latency_s)
                 feats = self.featurize_fn(raw)

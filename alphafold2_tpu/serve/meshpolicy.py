@@ -326,11 +326,19 @@ class AdmissionPricer:
 
 @dataclass
 class SliceLease:
-    """One acquired device slice; hold it for the duration of a batch."""
+    """One acquired device slice; hold it for the duration of a batch.
+
+    `held` tracks whether THIS lease currently owns its span (the
+    allocator flips it on acquire/release): release() is idempotent
+    against it, so an exception between a preemption yield's release
+    and its re-acquire can never make a failure-path release free a
+    span that another batch has since leased (ISSUE 14 — every
+    failure path releases exactly what it holds, nothing more)."""
 
     devices: List[object]
     shape: MeshShape
     start: int                       # first device index in the pool
+    held: bool = True
 
     @property
     def label(self) -> str:
@@ -429,19 +437,31 @@ class DeviceSliceAllocator:
         the same chips). Waits indefinitely — the holder released
         everything before waiting, so there is no cycle to deadlock
         on, and whoever borrowed the span releases it after a bounded
-        batch."""
+        batch. Returns the SAME lease object re-armed (`held` flips
+        back on), so every reference a caller's finally-block holds
+        releases the span that is actually leased — a new object here
+        would leave the original reference pointing at a dead lease
+        and strand the re-acquired span on any later failure path
+        (ISSUE 14)."""
         size = chips_of(lease.shape)
         with self._cond:
             while any(self._busy[lease.start:lease.start + size]):
                 self._cond.wait()
             for k in range(lease.start, lease.start + size):
                 self._busy[k] = True
-        return SliceLease(self.devices[lease.start:lease.start + size],
-                          lease.shape, lease.start)
+            lease.held = True
+        return lease
 
     def release(self, lease: SliceLease):
+        """Idempotent: releasing a lease that is not currently held
+        (already released for a preemption yield, or double-released
+        by racing failure paths) is a no-op — it must never free a
+        span another batch has since acquired."""
         size = chips_of(lease.shape)
         with self._cond:
+            if not lease.held:
+                return
+            lease.held = False
             for k in range(lease.start, lease.start + size):
                 self._busy[k] = False
             self._cond.notify_all()

@@ -117,6 +117,31 @@ class RetryPolicy:
         that flip the scheduler into degraded mode; 0 disables the
         circuit breaker.
     breaker_cooldown_s: open -> half-open delay.
+    checkpoint_every: step-loop carry checkpointing (ISSUE 14; only
+        meaningful under a RecyclePolicy). Every N recycles — and at
+        every row-admission gap, where the host fetch is already
+        paid — the scheduler snapshots the FoldStepState carry plus
+        each row's age to host memory; a transient step failure or
+        watchdog fire mid-loop then RESUMES the survivors at their
+        checkpointed ages (executor rebuilt first when the watchdog
+        fired) instead of requeueing everyone to recycle 0, bounding
+        progress loss at `checkpoint_every` recycles per failure. A
+        resume is byte-equal to the uninterrupted loop when the
+        checkpoint sits at the failure step. 0 (default) disables
+        checkpointing: every failure path is byte-for-byte the PR-5
+        requeue-from-zero behavior.
+    row_isolation: per-row poison isolation in the step loop
+        (ISSUE 14). A per-step non-finite scan retires ONLY the
+        offending row the moment its output goes non-finite (strike
+        toward `nan_poison_threshold` via the keyed Quarantine), and
+        a deterministic failure that attributes itself to specific
+        batch rows (`FaultInjected.rows` — content-addressed chaos
+        does; real XLA errors do not) quarantines and retires exactly
+        those rows while the survivors keep stepping uninterrupted —
+        the freed rows refill via continuous admission like any early
+        exit. Batch bisection stays the fallback for the opaque path
+        and for unattributed deterministic failures. False (default)
+        keeps the PR-5 whole-cohort behavior.
     transient_types / transient_markers: extra classification — any
         exception instance of a listed type, or whose repr contains a
         marker substring (case-insensitive), is treated as transient.
@@ -133,6 +158,8 @@ class RetryPolicy:
     watchdog_s: Optional[float] = None
     breaker_threshold: int = 0
     breaker_cooldown_s: float = 5.0
+    checkpoint_every: int = 0
+    row_isolation: bool = False
     transient_types: Tuple[type, ...] = ()
     transient_markers: Tuple[str, ...] = (
         "transient", "resource_exhausted", "deadline_exceeded",
@@ -152,6 +179,8 @@ class RetryPolicy:
             # catch the CLI convention "0 = off" leaking in here: a
             # 0-second deadline would fail EVERY batch instantly
             raise ValueError("watchdog_s must be > 0 (None disables)")
+        if self.checkpoint_every < 0:
+            raise ValueError("checkpoint_every must be >= 0 (0 disables)")
         self._rng = random.Random(self.seed)
 
     def is_transient(self, exc: BaseException) -> bool:

@@ -247,6 +247,30 @@ class _Entry:
                          else self.enqueued_at + self.request.deadline_s)
 
 
+class _StepCheckpoint:
+    """Host-side snapshot of a running step loop (ISSUE 14): the
+    FoldStepState carry (predict.snapshot_step_state form), a COPY of
+    the batch tensors' host mirror, and the loop membership (entries +
+    position->row map + per-row ages) at loop step `step`. Everything
+    is host memory owned by this object alone — it survives executor
+    rebuilds and later admission rounds mutating the live mirror — so
+    a transient failure or watchdog fire can re-upload it and resume
+    the survivors at their checkpointed ages instead of requeueing the
+    loop to recycle 0."""
+
+    __slots__ = ("state", "host", "rows", "ages", "active", "step",
+                 "kernel")
+
+    def __init__(self, state, host, rows, ages, active, step, kernel):
+        self.state = state
+        self.host = host
+        self.rows = rows
+        self.ages = ages
+        self.active = active
+        self.step = step
+        self.kernel = kernel
+
+
 class Scheduler:
     """Dynamic batching fold server over one FoldExecutor.
 
@@ -390,6 +414,31 @@ class Scheduler:
             self._c_nonfinite = reg.counter(
                 "serve_nonfinite_outputs_total",
                 "fold outputs rejected by non-finite validation")
+        # step-loop fault domains (ISSUE 14): carry checkpointing +
+        # per-row poison isolation. Counters minted only when a knob is
+        # on, so `retry=` without them stays byte-for-byte PR-5 —
+        # including the registry's metric-name set
+        self._n_checkpoints = 0
+        self._n_ckpt_resumes = 0
+        self._n_recycles_lost = 0
+        self._n_row_isolations = 0
+        if retry is not None and (getattr(retry, "checkpoint_every", 0)
+                                  or getattr(retry, "row_isolation",
+                                             False)):
+            self._c_ckpt_resumes = reg.counter(
+                "serve_checkpoint_resumes_total",
+                "step loops resumed at their checkpointed ages after a "
+                "transient failure or watchdog fire")
+            self._c_recycles_lost = reg.counter(
+                "serve_recycles_lost_total",
+                "recycle steps re-executed because they landed between "
+                "the last checkpoint and a failure (the bounded "
+                "progress loss of checkpoint recovery)")
+            self._c_row_isolations = reg.counter(
+                "serve_row_poison_isolations_total",
+                "batch rows retired alone by per-row poison isolation "
+                "(non-finite scan or row-attributed deterministic "
+                "failure) while their batch mates kept folding")
         # step-mode recycle scheduling (before the mesh block: the LRU
         # autosizing below must know whether each (bucket, slice) needs
         # one executable or the init+step pair)
@@ -1450,6 +1499,22 @@ class Scheduler:
                 "watchdog_s": self.retry.watchdog_s,
                 "max_attempts": self.retry.max_attempts,
             }
+            # ISSUE-14 keys appear only when a step-loop fault-domain
+            # knob is on: `retry=` without them keeps the PR-5
+            # resilience section byte-identical
+            if getattr(self.retry, "checkpoint_every", 0) \
+                    or getattr(self.retry, "row_isolation", False):
+                stats["resilience"].update({
+                    "checkpoint_every":
+                        getattr(self.retry, "checkpoint_every", 0),
+                    "row_isolation":
+                        bool(getattr(self.retry, "row_isolation",
+                                     False)),
+                    "checkpoints": self._n_checkpoints,
+                    "checkpoint_resumes": self._n_ckpt_resumes,
+                    "recycles_lost": self._n_recycles_lost,
+                    "row_poison_isolations": self._n_row_isolations,
+                })
         if self.mesh_policy is not None:
             with self._cond:
                 folds = {label: {"batches": self._mesh_batches[label],
@@ -1773,18 +1838,27 @@ class Scheduler:
             # default device, never lose the batch
             self._execute(bucket_len, entries)
             return
-        self._set_busy_gauge()
-        with self._cond:
-            self._inflight_execs += 1
+        # EVERYTHING between acquire and the pool handoff is guarded
+        # (ISSUE 14 audit): an exception from the gauge or the inflight
+        # bookkeeping would otherwise strand the slice forever — the
+        # lease must be released on every path that fails to hand it to
+        # _execute_on_lease's try/finally
+        counted = False
         try:
+            self._set_busy_gauge()
+            with self._cond:
+                self._inflight_execs += 1
+            counted = True
             self._mesh_pool.submit(self._execute_on_lease, bucket_len,
                                    entries, lease)
         except BaseException:
-            # pool unavailable (shutdown race): fall back inline
+            # pool unavailable (shutdown race) or bookkeeping trouble:
+            # fall back inline
             self._release_lease(lease)
-            with self._cond:
-                self._inflight_execs -= 1
-                self._cond.notify_all()
+            if counted:
+                with self._cond:
+                    self._inflight_execs -= 1
+                    self._cond.notify_all()
             self._execute(bucket_len, entries)
 
     def _execute_on_lease(self, bucket_len: int, entries: List[_Entry],
@@ -1989,6 +2063,21 @@ class Scheduler:
             and not continuous
         any_nonfinite = False
         r = 0                          # loop-level step count
+        # step-loop fault domains (ISSUE 14): carry checkpointing +
+        # per-row poison isolation, both off unless the RetryPolicy
+        # asked — with the knobs off every local below is inert and
+        # the loop is byte-for-byte the PR-13 behavior
+        retry = self.retry
+        ckpt_every = 0 if retry is None \
+            else int(getattr(retry, "checkpoint_every", 0) or 0)
+        row_isolate = retry is not None \
+            and getattr(retry, "row_isolation", False)
+        ckpt = None                    # last _StepCheckpoint
+        resumes = 0                    # checkpoint resumes this loop
+        resume_probe = False           # next successful step is the
+        #                                breaker's half-open probe
+        t_attempt = t0                 # start of the executor call a
+        #                                watchdog span would cover
         # entries already left the queue: any unresolved exception here
         # would orphan tickets — same guard discipline as _execute
         try:
@@ -2007,10 +2096,33 @@ class Scheduler:
             init_kw = {} if kspec is None else {"kernel": kspec}
             step_kernel = kspec
             contact_planned = False
-            state = self._run_step_guarded(
-                lambda: self.executor.run_init(
-                    batch, trace=batch_trace, devices=devices,
-                    mesh_shape=mesh_shape, **init_kw))
+            state = None
+            while active:
+                try:
+                    t_attempt = time.monotonic()
+                    state = self._run_step_guarded(
+                        lambda: self.executor.run_init(
+                            batch, trace=batch_trace, devices=devices,
+                            mesh_shape=mesh_shape, **init_kw))
+                    break
+                except Exception as exc:
+                    # per-row poison isolation at the FIRST pass: a
+                    # row-attributed deterministic failure retires only
+                    # the offending founders; the scrubbed batch
+                    # re-inits the innocents (bisection stays the
+                    # fallback for unattributed failures)
+                    scrubbed = self._isolate_poison_rows(
+                        exc, batch, active, rows, ages)
+                    if scrubbed is None:
+                        raise
+                    batch = scrubbed
+            if state is None:
+                # every founder was isolated poison: nothing to fold
+                self._finish_step_batch(bucket_len, entries,
+                                        all_members, lease, kspec,
+                                        contact_planned, any_nonfinite,
+                                        waste, t0)
+                return
 
             def _plan_contact(st, members):
                 """Re-plan the step mask from the batch's OWN pair
@@ -2050,196 +2162,318 @@ class Scheduler:
                 step_kernel, contact_planned = _plan_contact(state,
                                                              active)
             # the per-step device-to-host fetch exists for convergence
-            # deltas and streaming; a preemption-only policy needs
-            # neither, so it pays one fetch at the end like the opaque
+            # deltas and streaming (and the per-step non-finite scan of
+            # row isolation); a preemption-only policy needs none of
+            # them, so it pays one fetch at the end like the opaque
             # path instead of copying the padded batch every recycle
-            fetch_steps = policy.converge_tol > 0 or policy.stream
+            fetch_steps = policy.converge_tol > 0 or policy.stream \
+                or row_isolate
             coords_np = conf_np = None
             if fetch_steps:
                 coords_np = np.asarray(state.coords)
                 conf_np = np.asarray(state.confidence)
+                if row_isolate and self._scan_nonfinite_rows(
+                        active, rows, ages, coords_np, conf_np):
+                    any_nonfinite = True
                 self._stream_progress(active, rows, coords_np, conf_np,
                                       ages)
+            if ckpt_every and active:
+                # checkpoint 0: a failure at the very first step already
+                # resumes at the init state instead of requeueing
+                ckpt = self._checkpoint_loop(state, batch, active, rows,
+                                             ages, 0, step_kernel)
             # every surviving row has age < num_recycles (full-depth
             # rows retire inside the loop), so the condition only
             # gates entry: num_recycles == 0 skips straight to the
-            # final retirement below, exactly like the opaque path
-            while active and min(ages) < num_recycles:
-                if policy.preempt:
-                    lease = self._maybe_preempt(active, lease, r,
-                                                bucket_len)
-                r += 1
-                prev_coords, prev_conf = coords_np, conf_np
-                step_trace = (MultiTrace([e.trace for e in active])
-                              if self.tracer.enabled else NULL_TRACE)
-                step_kw = dict(trace=step_trace, devices=devices,
-                               mesh_shape=mesh_shape)
-                if continuous:
-                    # per-step occupancy rides the recycle span so the
-                    # obs_report occupancy line can read it back (the
-                    # kwarg only exists on row-admission-capable
-                    # executors, which _use_continuous vetted)
-                    step_kw["span_attrs"] = {
-                        "rows_live": len(active),
-                        "rows_total": cfg.max_batch_size}
-                if step_kernel is not None:
-                    step_kw["kernel"] = step_kernel
-                t_step = time.monotonic()
-                state = self._run_step_guarded(
-                    lambda st=state, rr=r, kw=step_kw:
-                    self.executor.run_step(batch, st, rr, **kw))
-                # per-bucket step-seconds EWMA: what the cross-bucket
-                # AdmissionPricer converts loop extension into wall
-                # time with (and the native-delay projection's
-                # loop-drain term)
-                dt_step = time.monotonic() - t_step
-                prev_s = self._step_ewma.get(bucket_len)
-                self._step_ewma[bucket_len] = dt_step if prev_s is None \
-                    else 0.5 * prev_s + 0.5 * dt_step
-                ages = [a + 1 for a in ages]
-                self._n_recycles_exec += 1
-                self._c_recycles.inc()
-                # row-occupancy ledger, sampled per executed step: a
-                # step costs the same whether a row is live or dead,
-                # which is exactly the waste continuous admission
-                # exists to eliminate
-                live = len(active)
-                self._row_steps_live += live
-                self._row_steps_total += cfg.max_batch_size
-                dead = cfg.max_batch_size - live
-                if dead > 0:
-                    self._n_rows_dead_steps += dead
-                    self._c_rows_dead_steps.inc(dead)
-                self._g_rows_occupied.set(live / cfg.max_batch_size)
-                # occupancy-weighted TOKEN accounting (ISSUE 13): the
-                # formation-time padding_waste only prices the founders'
-                # grid; this prices what each executed step actually
-                # carried — live rows' real residues over the full
-                # (B, L) grid — so admitted rows (and the padding a
-                # cross-bucket admit accepts) are observable
-                self.metrics.record_step_occupancy(
-                    sum(e.request.length for e in active),
-                    cfg.max_batch_size * bucket_len)
-                if fetch_steps:
-                    coords_np = np.asarray(state.coords)
-                    conf_np = np.asarray(state.confidence)
-                    self._stream_progress(active, rows, coords_np,
-                                          conf_np, ages)
-                else:
-                    # fetchless policy: a snapshot fetched for an
-                    # earlier retirement is one step stale NOW — the
-                    # ripe pass below must re-fetch, never serve a
-                    # surviving row its previous iteration's state
+            # final retirement below, exactly like the opaque path.
+            # The loop runs inside a FAULT ENVELOPE (ISSUE 14): a
+            # row-attributed deterministic failure retires ONLY the
+            # offending rows and retries the step; a transient
+            # failure or watchdog fire resumes the survivors from the
+            # last checkpoint at their checkpointed ages; anything
+            # else falls through to the classic outer handler
+            # (requeue-to-zero / bisection / error)
+            step_done = True       # no step attempt pending yet: a
+            #                        failure now lost no step progress
+            while True:
+                try:
+                    while active and min(ages) < num_recycles:
+                        if policy.preempt:
+                            lease = self._maybe_preempt(active, lease,
+                                                        r, bucket_len)
+                        r += 1
+                        step_done = False
+                        prev_coords, prev_conf = coords_np, conf_np
+                        step_trace = (
+                            MultiTrace([e.trace for e in active])
+                            if self.tracer.enabled else NULL_TRACE)
+                        step_kw = dict(trace=step_trace,
+                                       devices=devices,
+                                       mesh_shape=mesh_shape)
+                        if continuous:
+                            # per-step occupancy rides the recycle span
+                            # so the obs_report occupancy line can read
+                            # it back (the kwarg only exists on
+                            # row-admission-capable executors, which
+                            # _use_continuous vetted)
+                            step_kw["span_attrs"] = {
+                                "rows_live": len(active),
+                                "rows_total": cfg.max_batch_size}
+                        if step_kernel is not None:
+                            step_kw["kernel"] = step_kernel
+                        t_step = time.monotonic()
+                        t_attempt = t_step
+                        state = self._run_step_guarded(
+                            lambda st=state, rr=r, kw=step_kw:
+                            self.executor.run_step(batch, st, rr, **kw))
+                        step_done = True   # a failure from here on
+                        #   (admission, planning) lost no step: the
+                        #   recycles_lost ledger must count r, not r-1
+                        if resume_probe:
+                            # the resumed loop's first successful step
+                            # IS the breaker's half-open probe: the
+                            # device just proved it can execute again
+                            resume_probe = False
+                            if self._breaker is not None:
+                                self._breaker.record_success()
+                        # per-bucket step-seconds EWMA: what the
+                        # cross-bucket AdmissionPricer converts loop
+                        # extension into wall time with (and the
+                        # native-delay projection's loop-drain term)
+                        dt_step = time.monotonic() - t_step
+                        prev_s = self._step_ewma.get(bucket_len)
+                        self._step_ewma[bucket_len] = \
+                            dt_step if prev_s is None \
+                            else 0.5 * prev_s + 0.5 * dt_step
+                        ages = [a + 1 for a in ages]
+                        self._n_recycles_exec += 1
+                        self._c_recycles.inc()
+                        # row-occupancy ledger, sampled per executed
+                        # step: a step costs the same whether a row is
+                        # live or dead, which is exactly the waste
+                        # continuous admission exists to eliminate
+                        live = len(active)
+                        self._row_steps_live += live
+                        self._row_steps_total += cfg.max_batch_size
+                        dead = cfg.max_batch_size - live
+                        if dead > 0:
+                            self._n_rows_dead_steps += dead
+                            self._c_rows_dead_steps.inc(dead)
+                        self._g_rows_occupied.set(
+                            live / cfg.max_batch_size)
+                        # occupancy-weighted TOKEN accounting
+                        # (ISSUE 13): the formation-time padding_waste
+                        # only prices the founders' grid; this prices
+                        # what each executed step actually carried —
+                        # live rows' real residues over the full (B, L)
+                        # grid — so admitted rows (and the padding a
+                        # cross-bucket admit accepts) are observable
+                        self.metrics.record_step_occupancy(
+                            sum(e.request.length for e in active),
+                            cfg.max_batch_size * bucket_len)
+                        if fetch_steps:
+                            coords_np = np.asarray(state.coords)
+                            conf_np = np.asarray(state.confidence)
+                            if row_isolate and \
+                                    self._scan_nonfinite_rows(
+                                        active, rows, ages, coords_np,
+                                        conf_np):
+                                # per-step non-finite scan (ISSUE 14):
+                                # a poisoned row retires the moment its
+                                # output goes non-finite — its batch
+                                # mates keep stepping and its freed row
+                                # is admissible like any early exit
+                                any_nonfinite = True
+                                if not active:
+                                    break
+                            self._stream_progress(active, rows,
+                                                  coords_np, conf_np,
+                                                  ages)
+                        else:
+                            # fetchless policy: a snapshot fetched for
+                            # an earlier retirement is one step stale
+                            # NOW — the ripe pass below must re-fetch,
+                            # never serve a surviving row its previous
+                            # iteration's state
+                            coords_np = conf_np = None
+                        # retirement against each row's OWN age:
+                        # full-depth rows are final (their state IS the
+                        # fold result); converged rows past their
+                        # min_recycles floor retire early. A full-depth
+                        # row never counts as an early retirement even
+                        # if its last delta also converged.
+                        ripe = {i for i in range(len(active))
+                                if ages[i] >= num_recycles}
+                        conv: List[int] = []
+                        if policy.converge_tol > 0 \
+                                and prev_coords is not None:
+                            elig = [i for i in range(len(active))
+                                    if i not in ripe
+                                    and ages[i] >= policy.min_recycles]
+                            if elig:
+                                deltas = element_deltas(
+                                    prev_coords, prev_conf, coords_np,
+                                    conf_np,
+                                    [active[i].request.length
+                                     for i in elig],
+                                    rows=[rows[i] for i in elig])
+                                for i, d in zip(elig, deltas):
+                                    if d <= policy.converge_tol:
+                                        conv.append(i)
+                                        active[i].trace.event(
+                                            "recycle_converged",
+                                            recycle=ages[i], delta=d)
+                        retired = sorted(ripe | set(conv))
+                        if retired:
+                            if coords_np is None:
+                                # fetchless policy retiring full-depth
+                                # rows: one fetch, exactly like the
+                                # opaque path's end
+                                coords_np = np.asarray(state.coords)
+                                conf_np = np.asarray(state.confidence)
+                            now = time.monotonic()
+                            for i in retired:
+                                e = active[i]
+                                if i not in ripe:
+                                    self._n_retired_early += 1
+                                if not self._retire_entry(
+                                        e, bucket_len,
+                                        coords_np[rows[i]],
+                                        conf_np[rows[i]],
+                                        ages[i], now):
+                                    any_nonfinite = True
+                            gone = set(retired)
+                            keep = [i for i in range(len(active))
+                                    if i not in gone]
+                            active = [active[i] for i in keep]
+                            rows = [rows[i] for i in keep]
+                            ages = [ages[i] for i in keep]
+                            if not active:
+                                if r < num_recycles:
+                                    # fully-converged batch: remaining
+                                    # steps are skipped outright
+                                    skipped = steps_saved(num_recycles,
+                                                          r)
+                                    self._n_recycles_skipped += skipped
+                                    self._c_recycles_skipped.inc(
+                                        skipped)
+                                break
+                            if can_repack:
+                                # re-pack the survivor batch: survivors
+                                # become a dense row prefix of both the
+                                # carried state and the batch tensors
+                                # (and the executor's placement cache
+                                # is dropped with the old batch dict)
+                                state, idx_list = repack_rows(
+                                    state, rows, cfg.max_batch_size)
+                                batch = repack_batch(batch, idx_list)
+                                sel = np.asarray(rows)
+                                coords_np, conf_np = coords_np[sel], \
+                                    conf_np[sel]
+                                rows = list(range(len(active)))
+                            # (not can_repack: rows retire in place —
+                            # the position -> row map already shrank
+                            # above)
+                        admitted = []
+                        if continuous and active:
+                            if lease is None:
+                                # inline path: this IS the worker
+                                # thread, and a continuously refilled
+                                # loop would keep it here indefinitely
+                                # — drain fresh submissions and run the
+                                # worker's shed sweep from the gap so
+                                # expired tickets (which admission
+                                # skips by design) never hang behind a
+                                # long-lived loop
+                                with self._cond:
+                                    while self._incoming:
+                                        e_in = self._incoming.popleft()
+                                        self._pending.setdefault(
+                                            e_in.bucket_len,
+                                            []).append(e_in)
+                                self._shed_expired()
+                            batch, state, admitted = self._admit_rows(
+                                bucket_len, batch, state, active, rows,
+                                ages, all_members, devices, mesh_shape,
+                                inline=lease is None, gap=r,
+                                kernel=kspec)
+                            if admitted and contact_planned:
+                                # admitted rows' first pass just landed
+                                # in the distogram: re-plan so the mask
+                                # covers THEIR contacts too, not just
+                                # the founders'. A FAILED re-plan keeps
+                                # the current contact spec (still valid
+                                # for survivor rows) rather than
+                                # silently widening back to the static
+                                # mask while the batch stays accounted
+                                # as contact-planned.
+                                new_kernel, ok = _plan_contact(state,
+                                                               admitted)
+                                if ok:
+                                    step_kernel = new_kernel
+                            if admitted and fetch_steps:
+                                # refresh the prev snapshot NOW: an
+                                # admitted row's first delta must
+                                # compare its own post-init state,
+                                # never the pre-admission occupant of
+                                # the same physical row
+                                coords_np = np.asarray(state.coords)
+                                conf_np = np.asarray(state.confidence)
+                                self._stream_progress(
+                                    admitted, rows[-len(admitted):],
+                                    coords_np, conf_np,
+                                    [0] * len(admitted))
+                        if ckpt_every and active and \
+                                (admitted or r % ckpt_every == 0):
+                            # cadence checkpoints, plus one at every
+                            # admission gap: a resume must never
+                            # restore a pre-admission carry out from
+                            # under rows that now hold admitted work
+                            # (a failed checkpoint keeps the previous
+                            # one — resume then requeues the admitted
+                            # entries as orphans, losing progress but
+                            # never tickets)
+                            ckpt = self._checkpoint_loop(
+                                state, batch, active, rows, ages, r,
+                                step_kernel) or ckpt
+                    break     # loop drained clean: leave the envelope
+                except Exception as exc:
+                    scrubbed = self._isolate_poison_rows(
+                        exc, batch, active, rows, ages)
+                    if scrubbed is not None:
+                        # the failed attempt never executed: undo its
+                        # step count (unless the step had completed and
+                        # a post-step site raised) and retry with the
+                        # offending rows retired + scrubbed from the
+                        # batch tensors. The checkpoint must follow the
+                        # scrub, or a later resume would restore the
+                        # poison and re-raise forever.
+                        batch = scrubbed
+                        r = max(0, r - (0 if step_done else 1))
+                        step_done = True
+                        if ckpt_every and active:
+                            ckpt = self._checkpoint_loop(
+                                state, batch, active, rows, ages, r,
+                                step_kernel) or ckpt
+                        continue
+                    outcome = self._resume_or_requeue(
+                        exc, ckpt, all_members, bucket_len, resumes,
+                        r - (0 if step_done else 1), t_attempt)
+                    if outcome is None:
+                        raise     # classic handler (outer except)
+                    kind, payload = outcome
+                    if kind == "requeued":
+                        return    # survivors re-enter via the queue
+                    resumes += 1
+                    resume_probe = self._breaker is not None
+                    (state, batch, active, rows, ages,
+                     step_kernel) = payload
+                    r = ckpt.step
+                    step_done = True
                     coords_np = conf_np = None
-                # retirement against each row's OWN age: full-depth
-                # rows are final (their state IS the fold result);
-                # converged rows past their min_recycles floor retire
-                # early. A full-depth row never counts as an early
-                # retirement even if its last delta also converged.
-                ripe = {i for i in range(len(active))
-                        if ages[i] >= num_recycles}
-                conv: List[int] = []
-                if policy.converge_tol > 0 and prev_coords is not None:
-                    elig = [i for i in range(len(active))
-                            if i not in ripe
-                            and ages[i] >= policy.min_recycles]
-                    if elig:
-                        deltas = element_deltas(
-                            prev_coords, prev_conf, coords_np, conf_np,
-                            [active[i].request.length for i in elig],
-                            rows=[rows[i] for i in elig])
-                        for i, d in zip(elig, deltas):
-                            if d <= policy.converge_tol:
-                                conv.append(i)
-                                active[i].trace.event(
-                                    "recycle_converged",
-                                    recycle=ages[i], delta=d)
-                retired = sorted(ripe | set(conv))
-                if retired:
-                    if coords_np is None:
-                        # fetchless policy retiring full-depth rows:
-                        # one fetch, exactly like the opaque path's end
+                    if fetch_steps:
                         coords_np = np.asarray(state.coords)
                         conf_np = np.asarray(state.confidence)
-                    now = time.monotonic()
-                    for i in retired:
-                        e = active[i]
-                        if i not in ripe:
-                            self._n_retired_early += 1
-                        if not self._retire_entry(e, bucket_len,
-                                                  coords_np[rows[i]],
-                                                  conf_np[rows[i]],
-                                                  ages[i], now):
-                            any_nonfinite = True
-                    gone = set(retired)
-                    keep = [i for i in range(len(active))
-                            if i not in gone]
-                    active = [active[i] for i in keep]
-                    rows = [rows[i] for i in keep]
-                    ages = [ages[i] for i in keep]
-                    if not active:
-                        if r < num_recycles:
-                            # fully-converged batch: remaining steps
-                            # are skipped outright
-                            skipped = steps_saved(num_recycles, r)
-                            self._n_recycles_skipped += skipped
-                            self._c_recycles_skipped.inc(skipped)
-                        break
-                    if can_repack:
-                        # re-pack the survivor batch: survivors become
-                        # a dense row prefix of both the carried state
-                        # and the batch tensors (and the executor's
-                        # placement cache is dropped with the old
-                        # batch dict)
-                        state, idx_list = repack_rows(
-                            state, rows, cfg.max_batch_size)
-                        batch = repack_batch(batch, idx_list)
-                        sel = np.asarray(rows)
-                        coords_np, conf_np = coords_np[sel], \
-                            conf_np[sel]
-                        rows = list(range(len(active)))
-                    # (not can_repack: rows retire in place — the
-                    # position -> row map already shrank above)
-                if continuous and active:
-                    if lease is None:
-                        # inline path: this IS the worker thread, and a
-                        # continuously refilled loop would keep it here
-                        # indefinitely — drain fresh submissions and
-                        # run the worker's shed sweep from the gap so
-                        # expired tickets (which admission skips by
-                        # design) never hang behind a long-lived loop
-                        with self._cond:
-                            while self._incoming:
-                                e_in = self._incoming.popleft()
-                                self._pending.setdefault(
-                                    e_in.bucket_len, []).append(e_in)
-                        self._shed_expired()
-                    batch, state, admitted = self._admit_rows(
-                        bucket_len, batch, state, active, rows, ages,
-                        all_members, devices, mesh_shape,
-                        inline=lease is None, gap=r, kernel=kspec)
-                    if admitted and contact_planned:
-                        # admitted rows' first pass just landed in the
-                        # distogram: re-plan so the mask covers THEIR
-                        # contacts too, not just the founders'. A
-                        # FAILED re-plan keeps the current contact
-                        # spec (still valid for survivor rows) rather
-                        # than silently widening back to the static
-                        # mask while the batch stays accounted as
-                        # contact-planned.
-                        new_kernel, ok = _plan_contact(state, admitted)
-                        if ok:
-                            step_kernel = new_kernel
-                    if admitted and fetch_steps:
-                        # refresh the prev snapshot NOW: an admitted
-                        # row's first delta must compare its own
-                        # post-init state, never the pre-admission
-                        # occupant of the same physical row
-                        coords_np = np.asarray(state.coords)
-                        conf_np = np.asarray(state.confidence)
-                        self._stream_progress(
-                            admitted, rows[-len(admitted):],
-                            coords_np, conf_np, [0] * len(admitted))
             if active:
                 # only reachable at num_recycles == 0: the init state
                 # is the final state for every founder row
@@ -2267,6 +2501,19 @@ class Scheduler:
                     bucket_len=e.bucket_len, error=repr(exc),
                     attempts=e.attempts))
             return
+        self._finish_step_batch(bucket_len, entries, all_members, lease,
+                                kspec, contact_planned, any_nonfinite,
+                                waste, t0)
+
+    def _finish_step_batch(self, bucket_len: int, entries: List[_Entry],
+                           all_members: List[_Entry],
+                           lease: Optional[SliceLease], kspec,
+                           contact_planned: bool, any_nonfinite: bool,
+                           waste: float, t0: float):
+        """Success-path accounting for one completed step loop (breaker
+        health, mesh/kernel counters, the batch JSONL record) — shared
+        by the normal drain and the all-founders-isolated early exit."""
+        cfg = self.config
         if self._breaker is not None:
             # same device-health semantics as the opaque path: a batch
             # with non-finite rows is suspect, a clean one is proof
@@ -2519,12 +2766,7 @@ class Scheduler:
         step loop. Device arrays are built with `jnp.array` (copy
         semantics), so mutating the mirror next round can never alias
         an array the executor still holds."""
-        import jax.numpy as jnp
-
-        host = batch.get("_host")
-        if host is None:
-            host = {k: (None if batch[k] is None else np.array(batch[k]))
-                    for k in ("seq", "mask", "msa", "msa_mask")}
+        host = self._host_mirror(batch)
         seq, mask = host["seq"], host["mask"]
         msa, msa_mask = host["msa"], host["msa_mask"]
         for row, e in placements:
@@ -2541,11 +2783,57 @@ class Scheduler:
                     m = min(req.msa.shape[0], msa.shape[1])
                     msa[row, :m, :n] = req.msa[:m]
                     msa_mask[row, :m, :n] = True
-        return {"seq": jnp.array(seq), "mask": jnp.array(mask),
-                "msa": None if msa is None else jnp.array(msa),
-                "msa_mask": (None if msa_mask is None
-                             else jnp.array(msa_mask)),
+        return self._batch_from_host(host)
+
+    @staticmethod
+    def _host_mirror(batch: dict) -> dict:
+        """The numpy mirror of one assembled batch's canonical input
+        keys: the cached "_host" copy when an earlier admission/scrub/
+        checkpoint already paid the device fetch, else one fresh fetch
+        cached onto the batch dict — cadence checkpoints of a loop
+        whose batch never changes pay ONE fetch per loop, not one per
+        checkpoint. Safe to cache: the device tensors are immutable
+        between loop iterations (admission/scrub/repack all mint a
+        FRESH batch dict), and checkpoint snapshots copy the mirror
+        before storing it."""
+        host = batch.get("_host")
+        if host is None:
+            host = {k: (None if batch[k] is None else np.array(batch[k]))
+                    for k in ("seq", "mask", "msa", "msa_mask")}
+            batch["_host"] = host
+        return host
+
+    @staticmethod
+    def _batch_from_host(host: dict) -> dict:
+        """Fresh device batch dict from a host mirror — only the
+        canonical input keys plus the mirror itself, so the executor's
+        cached per-slice placement is dropped (same discipline as
+        repack_batch). `jnp.array` copies, so later mirror mutation
+        never aliases device arrays the executor still holds."""
+        import jax.numpy as jnp
+
+        return {"seq": jnp.array(host["seq"]),
+                "mask": jnp.array(host["mask"]),
+                "msa": (None if host["msa"] is None
+                        else jnp.array(host["msa"])),
+                "msa_mask": (None if host["msa_mask"] is None
+                             else jnp.array(host["msa_mask"])),
                 "_host": host}
+
+    def _scrub_batch_rows(self, batch: dict, scrub_rows) -> dict:
+        """Zero out the named physical rows (seq 0, mask False, MSA
+        cleared) and rebuild the batch dict: a content-addressed
+        deterministic failure (poison) cannot re-fire off a row whose
+        content is gone, and a dead row is exactly what continuous
+        admission refills (ISSUE 14 row isolation)."""
+        host = self._host_mirror(batch)
+        for row in scrub_rows:
+            host["seq"][row] = 0
+            host["mask"][row] = False
+            if host["msa"] is not None:
+                host["msa"][row] = 0
+                host["msa_mask"][row] = False
+        return self._batch_from_host(host)
 
     def _admit_rows(self, bucket_len: int, batch: dict, state,
                     active: List[_Entry], rows: List[int],
@@ -2778,10 +3066,39 @@ class Scheduler:
                 "native_bucket": ",".join(
                     str(b) for b in sorted({e.bucket_len
                                             for e in admitted}))}
-        state = self._run_step_guarded(
-            lambda: self.executor.run_init_rows(
-                new_batch, state, row_mask, trace=admit_trace,
-                devices=devices, mesh_shape=mesh_shape, **admit_kw))
+        while True:
+            try:
+                state = self._run_step_guarded(
+                    lambda: self.executor.run_init_rows(
+                        new_batch, state, row_mask, trace=admit_trace,
+                        devices=devices, mesh_shape=mesh_shape,
+                        **admit_kw))
+                break
+            except Exception as exc:
+                # per-row poison isolation at the ADMISSION pass
+                # (ISSUE 14): a poison request admitted mid-loop fails
+                # the row-masked init deterministically with its row
+                # attributed — quarantine and retire exactly that row,
+                # scrub it, and re-run the init for the remaining
+                # admitted rows (survivor rows pass through untouched
+                # either way; innocent admitted rows re-init from the
+                # same deterministic first pass). Anything else
+                # propagates to the loop's fault envelope.
+                scrubbed = self._isolate_poison_rows(
+                    exc, new_batch, active, rows, ages)
+                if scrubbed is None:
+                    raise
+                new_batch = scrubbed
+                placements = [(row, e) for row, e in placements
+                              if not e.ticket.done()]
+                admitted = [e for _, e in placements]
+                if not placements:
+                    # every admitted row was poison: the carried state
+                    # is untouched — the loop continues with survivors
+                    return new_batch, state, []
+                row_mask = np.zeros((cfg.max_batch_size,), bool)
+                for row, _ in placements:
+                    row_mask[row] = True
         return new_batch, state, admitted
 
     def _retire_entry(self, e: _Entry, bucket_len: int, coords_row,
@@ -2861,6 +3178,280 @@ class Scheduler:
         if watchdog_s is None:
             return call()
         return run_with_watchdog(call, watchdog_s)
+
+    # -- step-loop fault domains (ISSUE 14) ------------------------------
+
+    def _checkpoint_loop(self, state, batch, active, rows, ages,
+                         step: int, kernel) -> Optional[_StepCheckpoint]:
+        """Snapshot the running loop to host memory: the carry (with
+        shardings, so a mesh-sharded state re-uploads onto its slice),
+        a COPY of the batch host mirror (later admission rounds mutate
+        the live one in place), and the membership/row/age triple.
+        Snapshot trouble returns None — checkpointing is a recovery
+        optimization and must never fail a healthy loop; the caller
+        keeps the previous checkpoint."""
+        from alphafold2_tpu.predict import snapshot_step_state
+
+        try:
+            host = self._host_mirror(batch)
+            snap_host = {k: (None if v is None else np.array(v))
+                         for k, v in host.items()}
+            snap_state = snapshot_step_state(state)
+        except Exception:
+            return None
+        self._n_checkpoints += 1
+        return _StepCheckpoint(snap_state, snap_host, list(rows),
+                               list(ages), list(active), int(step),
+                               kernel)
+
+    def _scan_nonfinite_rows(self, active: List[_Entry],
+                             rows: List[int], ages: List[int],
+                             coords_np, conf_np) -> int:
+        """Per-step non-finite scan (RetryPolicy(row_isolation)): any
+        active row whose real residues carry non-finite coords or
+        confidence is retired NOW through the existing poison-strike
+        machinery (`_resolve_nonfinite` — quarantine at the policy
+        threshold) while its batch mates keep stepping. Mutates
+        active/rows/ages in place; returns the number of rows
+        isolated. Without the knob this never runs and detection stays
+        at retirement time, exactly the PR-5/11 behavior."""
+        bad = []
+        for i in range(len(active)):
+            n = active[i].request.length
+            if not (np.isfinite(coords_np[rows[i], :n]).all()
+                    and np.isfinite(conf_np[rows[i], :n]).all()):
+                bad.append(i)
+        if not bad:
+            return 0
+        for i in bad:
+            e = active[i]
+            self._n_row_isolations += 1
+            self._c_row_isolations.inc()
+            e.trace.event("row_poison_isolated", kind="nonfinite",
+                          row=rows[i], recycle=ages[i])
+            self._resolve_nonfinite(e, e.bucket_len)
+        gone = set(bad)
+        keep = [i for i in range(len(active)) if i not in gone]
+        active[:] = [active[i] for i in keep]
+        rows[:] = [rows[i] for i in keep]
+        ages[:] = [ages[i] for i in keep]
+        return len(bad)
+
+    def _isolate_poison_rows(self, exc: Exception, batch: dict,
+                             active: List[_Entry], rows: List[int],
+                             ages: List[int]) -> Optional[dict]:
+        """Per-row poison isolation for a row-attributed DETERMINISTIC
+        failure (ISSUE 14): when the exception names the batch rows it
+        came from (`exc.rows` — content-addressed chaos does; real XLA
+        errors do not and fall back to bisection), quarantine exactly
+        those entries (a deterministic single-row attribution IS the
+        proof — same standard as the batch-of-1 bisection terminal),
+        resolve them "poisoned", scrub their rows from the batch
+        tensors, and return the scrubbed batch for the caller to retry
+        the step with — the survivors never leave the loop. Returns
+        None when not applicable (knob off, transient, unattributed,
+        or the rows don't map to live entries)."""
+        retry = self.retry
+        if retry is None or not getattr(retry, "row_isolation", False):
+            return None
+        bad_rows = getattr(exc, "rows", None)
+        if not bad_rows or retry.is_transient(exc):
+            return None
+        bad = {int(x) for x in bad_rows}
+        positions = [i for i in range(len(active)) if rows[i] in bad]
+        if not positions:
+            return None
+        now = time.monotonic()
+        for i in positions:
+            e = active[i]
+            key = self._entry_key(e)
+            if key is not None:
+                self._quarantine.add(key, reason="poison_input")
+            self._n_row_isolations += 1
+            self._c_row_isolations.inc()
+            self.metrics.record_poisoned()
+            e.trace.event("row_poison_isolated", kind="raise",
+                          row=rows[i], recycle=ages[i])
+            self._resolve_entry(e, FoldResponse(
+                request_id=e.request.request_id, status="poisoned",
+                bucket_len=e.bucket_len, attempts=e.attempts,
+                latency_s=now - e.enqueued_at,
+                error=f"poison_input: row-attributed deterministic "
+                      f"failure isolated to batch row {rows[i]}, key "
+                      f"quarantined: {exc!r}"))
+        scrub = [rows[i] for i in positions]
+        gone = set(positions)
+        keep = [i for i in range(len(active)) if i not in gone]
+        active[:] = [active[i] for i in keep]
+        rows[:] = [rows[i] for i in keep]
+        ages[:] = [ages[i] for i in keep]
+        if self._breaker is not None:
+            # deterministic failure: the device RAN the batch — proof
+            # of health, same semantics as the bisection path
+            self._breaker.record_success()
+        return self._scrub_batch_rows(batch, scrub)
+
+    def _note_watchdog(self, entries: List[_Entry], t_run: float,
+                       now: float):
+        """Watchdog-fire bookkeeping shared by the classic batch
+        handler and the checkpoint-resume path: count it, span it,
+        rebuild the executor (a hung device call's compiled state is
+        not trustworthy)."""
+        self._n_watchdog_fires += 1
+        self._c_watchdog.inc()
+        if self.tracer.enabled:
+            for e in entries:
+                e.trace.add_span("watchdog", t_run, now,
+                                 timeout_s=self.retry.watchdog_s)
+                e.trace.event("watchdog_fired")
+        self._rebuild_executor()
+
+    def _resume_or_requeue(self, exc: Exception,
+                           ckpt: Optional[_StepCheckpoint],
+                           all_members: List[_Entry], bucket_len: int,
+                           resumes: int, completed: int,
+                           t_attempt: float):
+        """Recovery decision for one TRANSIENT step-loop failure under
+        carry checkpointing (ISSUE 14). Three outcomes:
+
+        - None: not applicable (knob off, no checkpoint yet,
+          deterministic failure, resume budget spent, stopping, or the
+          breaker is already open) — the caller re-raises into the
+          classic handler, byte-for-byte the PR-5 recovery;
+        - ("resumed", (state, batch, active, rows, ages, kernel)): the
+          checkpoint re-uploaded; survivors continue at their
+          checkpointed ages (bounded progress loss — the steps between
+          checkpoint and failure, counted in
+          `serve_recycles_lost_total`). Entries that joined the loop
+          AFTER the checkpoint (admission raced the failure) re-enter
+          via the queue so no ticket is ever lost. On a watchdog fire
+          the executor was rebuilt first.
+        - ("requeued", None): the checkpoint could not be restored (or
+          the rebuilt executor lost step mode) — survivors took the
+          classic requeue-to-zero path right here; the caller just
+          returns.
+        """
+        retry = self.retry
+        if retry is None or ckpt is None \
+                or not getattr(retry, "checkpoint_every", 0):
+            return None
+        if not retry.is_transient(exc):
+            return None
+        if resumes + 1 >= retry.max_attempts:
+            return None          # budget spent: classic handler
+        with self._cond:
+            if not self._running:
+                return None      # stopping: every ticket resolves now
+        if self._breaker is not None \
+                and not self._breaker.allow_execute():
+            return None          # open breaker: honor the pause via
+        #                          the requeue path's formation gate
+        keep = [i for i in range(len(ckpt.active))
+                if not ckpt.active[i].ticket.done()]
+        if not keep:
+            return None
+        survivors = [ckpt.active[i] for i in keep]
+        now = time.monotonic()
+        fired = isinstance(exc, WatchdogTimeout)
+        # `completed` = step iterations that finished before the
+        # failure (the caller subtracts the in-flight attempt when the
+        # step itself raised); everything past the checkpoint re-runs
+        lost = max(0, int(completed) - ckpt.step)
+        if fired:
+            # a hung device call's compiled state is not trustworthy —
+            # rebuild BEFORE the restore below touches the device:
+            # uploading the checkpoint through the wedged client would
+            # re-create the very hang the watchdog just recovered from,
+            # this time outside its guard (restore_step_state's
+            # default-device fallback expects the post-rebuild world)
+            self._note_watchdog(survivors, t_attempt, now)
+            if not self._step_capable:
+                # the rebuilt executor lost step mode (custom factory):
+                # requeue-to-zero over EVERY unresolved member — with
+                # the classic path's exhaustion split, since
+                # _handle_batch_failure can't run (it would rebuild and
+                # count this watchdog a second time)
+                if self._breaker is not None:
+                    self._breaker.record_failure()
+                self._requeue_or_exhaust(
+                    bucket_len,
+                    [e for e in all_members if not e.ticket.done()],
+                    exc, now)
+                return ("requeued", None)
+        from alphafold2_tpu.predict import restore_step_state
+        try:
+            res_trace = (MultiTrace([e.trace for e in survivors])
+                         if self.tracer.enabled else NULL_TRACE)
+            with res_trace.span("resume", recycle=ckpt.step, lost=lost,
+                                attempt=resumes + 1):
+                state = restore_step_state(ckpt.state)
+                host = {k: (None if v is None else np.array(v))
+                        for k, v in ckpt.host.items()}
+                batch = self._batch_from_host(host)
+        except Exception:
+            if fired:
+                # the watchdog is already counted and the executor
+                # rebuilt: the classic handler would do both a second
+                # time, so the requeue-to-zero fallback runs here
+                if self._breaker is not None:
+                    self._breaker.record_failure()
+                self._requeue_or_exhaust(
+                    bucket_len,
+                    [e for e in all_members if not e.ticket.done()],
+                    exc, now)
+                return ("requeued", None)
+            # restore trouble with nothing counted yet: hand the
+            # UNTOUCHED exception to the classic handler — it owns the
+            # breaker/exhaustion bookkeeping of the requeue-to-zero
+            # path, and nothing double-counts
+            return None
+        # committed: the classic handler will never see this failure,
+        # so the breaker must learn about it HERE — a resume recovers
+        # progress, it must not blind degraded-mode detection (same
+        # transient-indicts / deterministic-never semantics as
+        # _handle_batch_failure; the resumed loop's first successful
+        # step records the offsetting success)
+        if self._breaker is not None:
+            self._breaker.record_failure()
+        # entries that joined after the checkpoint (a raced admission)
+        # are not in the restored membership: requeue them — progress
+        # lost, tickets never — and DROP them from the loop membership,
+        # so a later failure of this same loop can never requeue them a
+        # second time (a double queue reference would double-serve one
+        # ticket)
+        ids = {id(e) for e in survivors}
+        orphans = [e for e in all_members
+                   if not e.ticket.done() and id(e) not in ids]
+        if orphans:
+            gone = {id(e) for e in orphans}
+            for e in orphans:
+                e.trace.event("resume_orphan_requeued")
+            self._requeue(orphans, bucket_len, now)
+            all_members[:] = [e for e in all_members
+                              if id(e) not in gone]
+        for e in survivors:
+            e.attempts += 1
+            e.trace.event("checkpoint_resume", recycle=ckpt.step,
+                          lost=lost, error=repr(exc))
+        self._n_ckpt_resumes += 1
+        self._c_ckpt_resumes.inc()
+        if lost:
+            self._n_recycles_lost += lost
+            self._c_recycles_lost.inc(lost)
+        self.metrics.record_retried(len(survivors))
+        if self._breaker is not None:
+            self._breaker.begin_probe()   # no-op unless half-open: the
+        #                                   resumed loop IS the probe
+        delay = retry.delay_s(resumes + 1, rng=self._retry_rng)
+        if delay > 0:
+            # known trade: on a leased slice this backoff idles the
+            # held chips for up to backoff_max_s — still strictly
+            # cheaper than the classic path's full restart-from-zero,
+            # and bounded by the per-loop resume budget
+            time.sleep(delay)
+        return ("resumed", (state, batch, survivors,
+                            [ckpt.rows[i] for i in keep],
+                            [ckpt.ages[i] for i in keep], ckpt.kernel))
 
     def _maybe_preempt(self, active: List[_Entry],
                        lease: Optional[SliceLease], gap: int,
@@ -3093,6 +3684,35 @@ class Scheduler:
             return call()
         return run_with_watchdog(call, watchdog_s)
 
+    def _requeue_or_exhaust(self, bucket_len: int,
+                            entries: List[_Entry], exc: Exception,
+                            now: float):
+        """The transient requeue-to-zero tail shared by the classic
+        handler and the checkpoint-resume fallback: entries past their
+        retry budget error-resolve with `retry_exhausted`, the rest
+        re-enqueue with backoff and the usual retry bookkeeping."""
+        retry = self.retry
+        survivors = [e for e in entries
+                     if e.attempts < retry.max_attempts]
+        for e in entries:
+            if e.attempts >= retry.max_attempts:
+                self.metrics.record_error()
+                self._resolve_entry(e, FoldResponse(
+                    request_id=e.request.request_id, status="error",
+                    bucket_len=bucket_len, attempts=e.attempts,
+                    error=f"retry_exhausted after {e.attempts} "
+                          f"attempts: {exc!r}"))
+        if survivors:
+            delay = retry.delay_s(max(e.attempts for e in survivors),
+                                  rng=self._retry_rng)
+            self._n_retries += len(survivors)
+            self._c_retries.inc(len(survivors))
+            self.metrics.record_retried(len(survivors))
+            for e in survivors:
+                e.trace.event("retry_scheduled", delay_s=delay,
+                              attempts=e.attempts, error=repr(exc))
+            self._requeue(survivors, bucket_len, now + delay)
+
     def _handle_batch_failure(self, bucket_len: int,
                               entries: List[_Entry], exc: Exception,
                               t_run: float) -> bool:
@@ -3106,14 +3726,7 @@ class Scheduler:
         now = time.monotonic()
         fired = isinstance(exc, WatchdogTimeout)
         if fired:
-            self._n_watchdog_fires += 1
-            self._c_watchdog.inc()
-            if self.tracer.enabled:
-                for e in entries:
-                    e.trace.add_span("watchdog", t_run, now,
-                                     timeout_s=retry.watchdog_s)
-                    e.trace.event("watchdog_fired")
-            self._rebuild_executor()
+            self._note_watchdog(entries, t_run, now)
         transient = retry.is_transient(exc)
         if self._breaker is not None:
             # a deterministic failure proves the device RAN the batch:
@@ -3124,8 +3737,6 @@ class Scheduler:
             if not self._running:
                 return False     # stopping: every ticket resolves NOW
         if transient:
-            survivors = [e for e in entries
-                         if e.attempts < retry.max_attempts]
             exhausted = [e for e in entries
                          if e.attempts >= retry.max_attempts]
             if exhausted and retry.bisect and len(entries) > 1:
@@ -3138,24 +3749,7 @@ class Scheduler:
                 self._bisect(bucket_len, entries,
                              not_before=now + delay)
                 return True
-            for e in exhausted:
-                self.metrics.record_error()
-                self._resolve_entry(e, FoldResponse(
-                    request_id=e.request.request_id, status="error",
-                    bucket_len=bucket_len, attempts=e.attempts,
-                    error=f"retry_exhausted after {e.attempts} "
-                          f"attempts: {exc!r}"))
-            if survivors:
-                delay = retry.delay_s(
-                    max(e.attempts for e in survivors),
-                    rng=self._retry_rng)
-                self._n_retries += len(survivors)
-                self._c_retries.inc(len(survivors))
-                self.metrics.record_retried(len(survivors))
-                for e in survivors:
-                    e.trace.event("retry_scheduled", delay_s=delay,
-                                  attempts=e.attempts, error=repr(exc))
-                self._requeue(survivors, bucket_len, now + delay)
+            self._requeue_or_exhaust(bucket_len, entries, exc, now)
             return True
         # deterministic failure: isolate the poison
         if not retry.bisect:

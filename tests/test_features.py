@@ -642,8 +642,11 @@ class TestMemoryAwarePreemption:
             sched._pending_tightest_bucket = 16
         entry = _Entry(FoldRequest(seq=np.zeros(8, np.int32)), 16)
         out = sched._maybe_preempt([entry], lease, gap=1, bucket_len=16)
-        assert out is not lease           # re-acquired lease object
-        assert out.start == lease.start   # ... over the SAME span
+        # the SAME lease object, re-armed over the same span (ISSUE 14:
+        # acquire_span used to mint a new object, stranding the span on
+        # failure paths that held the original reference)
+        assert out is lease and out.held
+        assert out.start == lease.start
         assert sched._n_preemptions == 1
         assert sched._n_preempt_hbm_refusals == 0
         alloc.release(out)

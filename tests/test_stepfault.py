@@ -1,0 +1,846 @@
+"""Step-loop fault-domain tests (ISSUE 14): carry checkpointing +
+resume-at-age recovery (byte-equality to the uninterrupted loop,
+bounded recycles_lost, watchdog-rebuild resume), per-row poison
+isolation (raise-mode attribution, the per-step non-finite scan,
+quarantine persistence, the knob-off bisection fallback), step-aware +
+featurize chaos sites, lease safety on every failure path (idempotent
+release, the acquire->handoff audit), the checkpoint-off scrubbed-stats
+identity pin, and the loadtest flag surface.
+
+Scheduler tests run against scripted step-capable stubs (no XLA) so the
+failure SCHEDULING is under test — same discipline as
+tests/test_resilience.py; real-executor coverage (resume byte-equality,
+mesh-lease isolation) rides the tiny Alphafold2 config from
+tests/test_continuous.py.
+"""
+
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu import Alphafold2
+from alphafold2_tpu.data.synthetic import synthetic_requests
+from alphafold2_tpu.obs.registry import MetricsRegistry
+from alphafold2_tpu.serve import (BucketPolicy, FaultInjected, FaultPlan,
+                                  FeaturePool, FoldExecutor, FoldRequest,
+                                  MeshPolicy, PipelineScheduler,
+                                  RawFoldRequest, RecyclePolicy,
+                                  RetryPolicy, Scheduler, SchedulerConfig,
+                                  ServeMetrics, TransientExecutorError)
+from alphafold2_tpu.serve.meshpolicy import DeviceSliceAllocator
+
+MSA_DEPTH = 3
+
+
+# -- scripted step-capable executor -----------------------------------
+
+
+class _StepStub:
+    """Step/admission-capable scripted executor (the _ContStub shape
+    from tests/test_continuous.py) with fault scripting: transient
+    raises at chosen recycle indices, content-addressed raise-mode
+    poison with row attribution (the FaultInjected.rows contract),
+    NaN-mode poison rows, and a one-shot sleep for the watchdog path.
+    Coords are a pure function of each row's step count, so a resumed
+    loop must reproduce the uninterrupted run exactly."""
+
+    def __init__(self, fail_at=None, poison_token=None,
+                 poison_mode="raise", nan_from_age=1, sleep_at=None,
+                 sleep_s=0.0, calls=None, step_s=0.005,
+                 poison_sites=("init", "init_rows", "step")):
+        self.fail_at = dict(fail_at or {})   # recycle -> raises left
+        self.poison_token = poison_token
+        self.poison_mode = poison_mode
+        self.poison_sites = tuple(poison_sites)
+        self.nan_from_age = nan_from_age
+        self.sleep_at = dict(sleep_at or {})  # recycle -> sleeps left
+        self.sleep_s = sleep_s
+        self.calls = calls if calls is not None else []
+        self.step_s = step_s
+        self.reached = threading.Event()
+        self.release = threading.Event()
+        self.gate_at = None
+        self._lock = threading.Lock()
+
+    # - fault scripting -
+
+    def _poison_rows(self, batch):
+        if self.poison_token is None or self.poison_mode != "raise":
+            return []
+        seq = np.asarray(batch["seq"])
+        mask = np.asarray(batch["mask"])
+        return [i for i in range(seq.shape[0])
+                if mask[i].any() and seq[i, 0] == self.poison_token]
+
+    def _maybe_poison(self, batch, site):
+        if site not in self.poison_sites:
+            return
+        rows = self._poison_rows(batch)
+        if rows:
+            exc = FaultInjected(
+                f"poison_input: scripted failure rows {rows} at {site}")
+            exc.rows = rows
+            raise exc
+
+    # - executor surface -
+
+    def _mk_state(self, ids, counts, b, n):
+        coords = np.zeros((b, n, 3), np.float32)
+        for i, c in enumerate(counts):
+            coords[i] = float(c)
+        if self.poison_token is not None and self.poison_mode == "nan":
+            for i in range(b):
+                if ids[i] == self.poison_token \
+                        and counts[i] >= self.nan_from_age:
+                    coords[i] = np.nan
+        return SimpleNamespace(
+            coords=coords,
+            confidence=np.zeros((b, n), np.float32),
+            recyclables=None, ids=np.array(ids), counts=np.array(counts))
+
+    def run_init(self, batch, trace=None, devices=None,
+                 mesh_shape=None):
+        seq = np.asarray(batch["seq"])
+        b, n = seq.shape
+        with self._lock:
+            self.calls.append(("init", [int(i) for i in seq[:, 0]]))
+        self._maybe_poison(batch, "init")
+        return self._mk_state(seq[:, 0], [0] * b, b, n)
+
+    def run_init_rows(self, batch, state, row_mask, trace=None,
+                      devices=None, mesh_shape=None, span_attrs=None):
+        seq = np.asarray(batch["seq"])
+        b, n = seq.shape
+        mask = np.asarray(row_mask)
+        with self._lock:
+            self.calls.append(
+                ("init_rows", [int(i) for i in seq[:, 0][mask]]))
+        self._maybe_poison(batch, "init_rows")
+        ids = state.ids.copy()
+        counts = state.counts.copy()
+        ids[mask] = seq[:, 0][mask]
+        counts[mask] = 0
+        return self._mk_state(ids, counts, b, n)
+
+    def run_step(self, batch, state, recycle_index, trace=None,
+                 devices=None, mesh_shape=None, span_attrs=None):
+        b, n = np.asarray(batch["seq"]).shape
+        with self._lock:
+            self.calls.append(("step", int(recycle_index)))
+            gated = self.gate_at is not None \
+                and recycle_index == self.gate_at
+            if gated:
+                self.gate_at = None
+        if gated:
+            self.reached.set()
+            assert self.release.wait(timeout=60)
+        self._maybe_poison(batch, "step")
+        with self._lock:
+            if self.fail_at.get(int(recycle_index), 0) > 0:
+                self.fail_at[int(recycle_index)] -= 1
+                raise TransientExecutorError(
+                    f"scripted transient at recycle {recycle_index}")
+            slept = self.sleep_at.get(int(recycle_index), 0) > 0
+            if slept:
+                self.sleep_at[int(recycle_index)] -= 1
+        if slept:
+            time.sleep(self.sleep_s)
+        counts = [int(c) + 1 for c in state.counts]
+        time.sleep(self.step_s)
+        return self._mk_state(state.ids, counts, b, n)
+
+    def run(self, batch, num_recycles, **kw):        # opaque fallback
+        st = self.run_init(batch)
+        for r in range(1, num_recycles + 1):
+            st = self.run_step(batch, st, r)
+        return SimpleNamespace(coords=st.coords,
+                               confidence=st.confidence)
+
+    def stats(self):
+        return {"calls": len(self.calls)}
+
+
+def _stub_sched(stub, num_recycles, policy=None, retry=None, max_batch=2,
+                buckets=(32,), **kw):
+    kw.setdefault("metrics", ServeMetrics(registry=MetricsRegistry()))
+    kw.setdefault("registry", MetricsRegistry())
+    policy = policy or RecyclePolicy(converge_tol=0.0)
+    return Scheduler(
+        stub, BucketPolicy(buckets),
+        SchedulerConfig(max_batch_size=max_batch, max_wait_ms=5.0,
+                        num_recycles=num_recycles, msa_depth=0,
+                        poll_ms=2.0),
+        recycle_policy=policy, retry=retry, **kw)
+
+
+def _req(token, length=12, **kw):
+    return FoldRequest(seq=np.full(length, token, np.int32), **kw)
+
+
+def _retry(**kw):
+    kw.setdefault("max_attempts", 4)
+    kw.setdefault("backoff_base_s", 0.0)
+    kw.setdefault("jitter", 0.0)
+    return RetryPolicy(**kw)
+
+
+def _mk_batch(tokens, length=16, max_batch=2):
+    reqs = [_req(t, length=length - 4) for t in tokens]
+    return BucketPolicy((length,)).assemble(reqs, length, max_batch)[0]
+
+
+# -- units ------------------------------------------------------------
+
+
+@pytest.mark.quick
+class TestKnobUnits:
+    def test_retry_policy_defaults_off_and_validated(self):
+        rp = RetryPolicy()
+        assert rp.checkpoint_every == 0
+        assert rp.row_isolation is False
+        with pytest.raises(ValueError):
+            RetryPolicy(checkpoint_every=-1)
+
+    def test_fault_plan_rate_validation(self):
+        with pytest.raises(ValueError):
+            FaultPlan(step_fail_at={1: 1.5})
+        with pytest.raises(ValueError):
+            FaultPlan(featurize_error_rate=2.0)
+
+
+class TestStepAwareFaultPlan:
+    def test_step_fail_at_hits_specific_recycle_only(self):
+        plan = FaultPlan(seed=7, step_fail_at={1: 1.0}).arm()
+        batch = _mk_batch([3])
+        plan.on_executor_run(batch, variant="step", recycle=0)
+        plan.on_executor_run(batch, variant="init")
+        plan.on_executor_run(batch, variant="fold")
+        with pytest.raises(TransientExecutorError):
+            plan.on_executor_run(batch, variant="step", recycle=1)
+        snap = plan.snapshot()
+        assert snap["injected"]["step_fail"] == 1
+        assert snap["step_fail_at"] == {1: 1.0}
+        assert snap["injected_by_variant"] == {"step": {"step_fail": 1}}
+
+    def test_counts_tagged_by_executing_variant(self):
+        plan = FaultPlan(seed=0, exec_error_rate=1.0).arm()
+        batch = _mk_batch([3])
+        for variant in ("init", "step", "init_rows"):
+            with pytest.raises(TransientExecutorError):
+                plan.on_executor_run(batch, variant=variant, recycle=1)
+        per = plan.snapshot()["injected_by_variant"]
+        assert set(per) == {"init", "step", "init_rows"}
+        assert all(v == {"exec_error": 1} for v in per.values())
+
+    def test_poison_raise_attributes_batch_rows(self):
+        plan = FaultPlan(seed=0).arm()
+        poison = _req(9, length=12)
+        plan.add_poison(np.asarray(poison.seq), mode="raise")
+        batch = _mk_batch([3, 9])
+        with pytest.raises(FaultInjected) as ei:
+            plan.on_executor_run(batch, variant="step", recycle=2)
+        assert ei.value.rows == [1]
+
+
+class TestFeaturizeFaults:
+    def test_featurize_error_fans_out_without_wedging(self):
+        """An injected featurize failure resolves the leader AND every
+        coalesced waiter as error; disarming the plan afterwards, the
+        SAME pool serves fresh work — nothing wedged."""
+        reg = MetricsRegistry()
+        plan = FaultPlan(seed=0, featurize_error_rate=1.0,
+                         registry=reg).arm()
+        pool = FeaturePool(workers=1, latency_s=0.05, faults=plan,
+                           registry=reg)
+        sched = _stub_sched(_StepStub(), 1, registry=reg)
+        seq = "MKVLAARNDC"
+        with PipelineScheduler(sched, pool) as pipe:
+            tickets = [pipe.submit_raw(RawFoldRequest(seq))
+                       for _ in range(3)]
+            resps = [t.result(timeout=30) for t in tickets]
+            assert all(r.status == "error" for r in resps)
+            assert all("featurize" in r.error for r in resps)
+            plan.disarm()
+            ok = pipe.submit_raw(
+                RawFoldRequest(seq)).result(timeout=30)
+        assert ok.ok
+        assert plan.snapshot()["injected"]["featurize_error"] >= 1
+        assert pool.snapshot()["errors"] == 3
+
+    def test_featurize_latency_exercises_deadline_path(self):
+        plan = FaultPlan(seed=0, featurize_latency_rate=1.0,
+                         featurize_latency_s=0.2).arm()
+        reg = MetricsRegistry()
+        pool = FeaturePool(workers=1, faults=plan, registry=reg)
+        sched = _stub_sched(_StepStub(), 1, registry=reg)
+        with PipelineScheduler(sched, pool) as pipe:
+            resp = pipe.submit_raw(RawFoldRequest(
+                "MKVLAARNDC", deadline_s=0.02)).result(timeout=30)
+        assert resp.status == "shed"
+        assert "feature_deadline_exceeded" in resp.error
+        assert plan.snapshot()["injected"]["featurize_latency"] == 1
+
+
+# -- carry checkpointing / resume-at-age ------------------------------
+
+
+class TestCheckpointResume:
+    def test_transient_resumes_at_checkpointed_age(self):
+        """checkpoint_every=1 + a one-shot transient at recycle 2: the
+        loop resumes at the checkpoint (zero recycles lost), never
+        requeues to zero (exactly one init), every ticket ok with the
+        coords an uninterrupted run produces, and the breaker stays
+        closed — the successful resume IS the health proof."""
+        stub = _StepStub(fail_at={2: 1})
+        sched = _stub_sched(stub, 3, retry=_retry(checkpoint_every=1,
+                                                  breaker_threshold=2))
+        sched.start()
+        try:
+            t1, t2 = sched.submit(_req(1)), sched.submit(_req(2))
+            r1, r2 = t1.result(timeout=60), t2.result(timeout=60)
+        finally:
+            sched.stop()
+        assert r1.ok and r2.ok
+        assert r1.recycles == 3 and r2.recycles == 3
+        # coords are the step count: an uninterrupted 3-recycle run
+        np.testing.assert_array_equal(r1.coords,
+                                      np.full((12, 3), 3.0, np.float32))
+        res = sched.serve_stats()["resilience"]
+        assert res["checkpoint_resumes"] == 1
+        assert res["recycles_lost"] == 0
+        assert res["checkpoints"] >= 3
+        assert res["breaker"]["state"] == "closed"
+        inits = [c for c in stub.calls if c[0] == "init"]
+        assert len(inits) == 1                 # never restarted at zero
+        # the failed attempt re-executed exactly once: steps 1,2,2,3
+        assert [c[1] for c in stub.calls if c[0] == "step"] \
+            == [1, 2, 2, 3]
+
+    def test_checkpoint_cadence_bounds_progress_loss(self):
+        """checkpoint_every=2 with the failure two steps past the
+        checkpoint: exactly the steps since the checkpoint re-execute
+        (recycles_lost == 1 <= checkpoint_every), never the whole
+        loop."""
+        stub = _StepStub(fail_at={4: 1})
+        sched = _stub_sched(stub, 5, retry=_retry(checkpoint_every=2))
+        sched.start()
+        try:
+            r = sched.submit(_req(1)).result(timeout=60)
+        finally:
+            sched.stop()
+        assert r.ok and r.recycles == 5
+        res = sched.serve_stats()["resilience"]
+        assert res["checkpoint_resumes"] == 1
+        assert 0 < res["recycles_lost"] <= 2
+        assert res["recycles_lost"] == 1       # ckpt at r=2, fail at 4
+        assert [c[1] for c in stub.calls if c[0] == "step"] \
+            == [1, 2, 3, 4, 3, 4, 5]
+
+    def test_checkpoint_off_requeues_to_zero(self):
+        """The off switch: the same transient without checkpoint_every
+        takes the PR-5 path — survivors requeue and restart at recycle
+        0 (a second init), and serve_stats carries NO ISSUE-14 keys."""
+        stub = _StepStub(fail_at={2: 1})
+        sched = _stub_sched(stub, 3, retry=_retry())
+        sched.start()
+        try:
+            r = sched.submit(_req(1)).result(timeout=60)
+        finally:
+            sched.stop()
+        assert r.ok and r.recycles == 3
+        res = sched.serve_stats()["resilience"]
+        assert "checkpoint_resumes" not in res
+        assert "recycles_lost" not in res
+        assert res["retries"] == 1
+        inits = [c for c in stub.calls if c[0] == "init"]
+        assert len(inits) == 2                 # restarted from zero
+        assert [c[1] for c in stub.calls if c[0] == "step"] \
+            == [1, 2, 1, 2, 3]
+
+    def test_restore_failure_falls_back_to_requeue(self):
+        """Checkpoint restore trouble must never hang a ticket: the
+        recovery degrades to the classic requeue-to-zero path — a
+        second init, retries counted, zero resumes claimed."""
+        stub = _StepStub(fail_at={2: 1})
+        sched = _stub_sched(stub, 3, retry=_retry(checkpoint_every=1))
+        orig = sched._batch_from_host
+        boom = {"left": 1}
+
+        def flaky(host):
+            if boom["left"]:
+                boom["left"] -= 1
+                raise RuntimeError("restore trouble")
+            return orig(host)
+
+        sched._batch_from_host = flaky
+        sched.start()
+        try:
+            r = sched.submit(_req(1)).result(timeout=60)
+        finally:
+            sched.stop()
+        assert r.ok and r.recycles == 3
+        res = sched.serve_stats()["resilience"]
+        assert res["checkpoint_resumes"] == 0
+        assert res["retries"] == 1
+        assert len([c for c in stub.calls if c[0] == "init"]) == 2
+
+    def test_watchdog_fire_rebuilds_then_resumes(self):
+        """A mid-loop hang: the watchdog fires, the executor is
+        REBUILT via executor_factory, and the resumed loop continues
+        on the fresh executor from the checkpointed ages — one init
+        total across both executors."""
+        calls = []
+        stub = _StepStub(sleep_at={2: 1}, sleep_s=1.5, calls=calls)
+        factory = lambda: _StepStub(calls=calls)       # noqa: E731
+        sched = _stub_sched(stub, 3,
+                            retry=_retry(checkpoint_every=1,
+                                         watchdog_s=0.2),
+                            executor_factory=factory)
+        sched.start()
+        try:
+            r = sched.submit(_req(1)).result(timeout=60)
+        finally:
+            sched.stop()
+        assert r.ok and r.recycles == 3
+        res = sched.serve_stats()["resilience"]
+        assert res["watchdog_fires"] == 1
+        assert res["executor_rebuilds"] == 1
+        assert res["checkpoint_resumes"] == 1
+        assert len([c for c in calls if c[0] == "init"]) == 1
+
+    def test_resume_byte_equal_uninterrupted_real_executor(self):
+        """ISSUE-14 acceptance at the numerics level: a REAL fold
+        interrupted by a transient at recycle 2 under checkpoint_every=1
+        serves final coords BYTE-equal to the fault-free run."""
+        model = Alphafold2(dim=32, depth=1, heads=2, dim_head=16,
+                           predict_coords=True,
+                           structure_module_depth=1)
+        n = 16
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, n), jnp.int32),
+            msa=jnp.zeros((1, MSA_DEPTH, n), jnp.int32),
+            mask=jnp.ones((1, n), bool),
+            msa_mask=jnp.ones((1, MSA_DEPTH, n), bool))
+
+        class OneShotFail(FoldExecutor):
+            fired = False
+
+            def run_step(self, batch, state, recycle_index, **kw):
+                if not OneShotFail.fired and recycle_index == 2:
+                    OneShotFail.fired = True
+                    raise TransientExecutorError("scripted mid-loop")
+                return super().run_step(batch, state, recycle_index,
+                                        **kw)
+
+        req = synthetic_requests(jax.random.PRNGKey(3), num=1,
+                                 lengths=(12,), msa_depth=MSA_DEPTH)[0]
+
+        def run_one(ex_cls, retry):
+            ex = ex_cls(model, params, max_entries=8)
+            sched = Scheduler(
+                ex, BucketPolicy((16,)),
+                SchedulerConfig(max_batch_size=2, max_wait_ms=5.0,
+                                num_recycles=3, msa_depth=MSA_DEPTH),
+                recycle_policy=RecyclePolicy(converge_tol=0.0),
+                retry=retry, metrics=ServeMetrics(
+                    registry=MetricsRegistry()),
+                registry=MetricsRegistry())
+            with sched:
+                r = sched.submit(FoldRequest(
+                    seq=req.seq, msa=req.msa)).result(timeout=300)
+            return r, sched
+
+        faulted, sched = run_one(OneShotFail,
+                                 _retry(checkpoint_every=1))
+        clean, _ = run_one(FoldExecutor, None)
+        assert OneShotFail.fired
+        assert faulted.ok and clean.ok, (faulted.error, clean.error)
+        res = sched.serve_stats()["resilience"]
+        assert res["checkpoint_resumes"] == 1
+        assert res["recycles_lost"] == 0
+        np.testing.assert_array_equal(faulted.coords, clean.coords)
+        np.testing.assert_array_equal(faulted.confidence,
+                                      clean.confidence)
+
+
+# -- per-row poison isolation -----------------------------------------
+
+
+class TestRowIsolation:
+    def test_raise_mode_poison_retires_only_offending_row(self):
+        """A row-attributed deterministic failure mid-loop quarantines
+        and retires exactly the poison row; its batch mate never leaves
+        the loop (one init, no bisection), the freed row refills via
+        continuous admission, and a later duplicate of the poison fails
+        fast with ZERO executor calls."""
+        stub = _StepStub(poison_token=9, poison_sites=("step",))
+        stub.gate_at = 2
+        sched = _stub_sched(
+            stub, 4, policy=RecyclePolicy(converge_tol=0.0,
+                                          continuous=True),
+            retry=_retry(row_isolation=True))
+        sched.start()
+        try:
+            t1 = sched.submit(_req(1))
+            tp = sched.submit(_req(9))
+            assert stub.reached.wait(timeout=60)
+            t3 = sched.submit(_req(3))           # pending mid-loop
+            time.sleep(0.05)
+            stub.release.set()
+            r1 = t1.result(timeout=60)
+            rp = tp.result(timeout=60)
+            r3 = t3.result(timeout=60)
+            calls_before = len(stub.calls)
+            rdup = sched.submit(_req(9)).result(timeout=60)
+        finally:
+            sched.stop()
+        assert r1.ok and r1.recycles == 4
+        assert rp.status == "poisoned"
+        assert "row-attributed" in rp.error
+        # the innocent survivor's result is byte-equal to a fault-free
+        # run (coords == its own step count everywhere)
+        np.testing.assert_array_equal(r1.coords,
+                                      np.full((12, 3), 4.0, np.float32))
+        # the freed row served the pending fold like any early exit
+        assert r3.ok and r3.recycles == 4
+        res = sched.serve_stats()["resilience"]
+        assert res["row_poison_isolations"] == 1
+        assert res["bisections"] == 0
+        assert len([c for c in stub.calls if c[0] == "init"]) == 1
+        assert ("init_rows", [3]) in stub.calls
+        # quarantine fail-fast: no executor work for the duplicate
+        assert rdup.status == "poisoned"
+        assert len(stub.calls) == calls_before
+
+    def test_nonfinite_scan_isolates_row_midloop(self):
+        """The per-step non-finite scan: a row whose output goes NaN at
+        age 1 retires THAT step as poisoned (threshold 1) while its
+        batch mate runs to full depth untouched."""
+        stub = _StepStub(poison_token=9, poison_mode="nan",
+                         nan_from_age=1)
+        sched = _stub_sched(
+            stub, 4, retry=_retry(row_isolation=True,
+                                  nan_poison_threshold=1))
+        sched.start()
+        try:
+            t1 = sched.submit(_req(1))
+            tp = sched.submit(_req(9))
+            r1 = t1.result(timeout=60)
+            rp = tp.result(timeout=60)
+        finally:
+            sched.stop()
+        assert r1.ok and r1.recycles == 4
+        np.testing.assert_array_equal(r1.coords,
+                                      np.full((12, 3), 4.0, np.float32))
+        assert rp.status == "poisoned"
+        assert "nonfinite" in rp.error
+        res = sched.serve_stats()["resilience"]
+        assert res["row_poison_isolations"] == 1
+        assert res["nonfinite_outputs"] == 1
+        # isolation happened at the FIRST bad step, not at retirement:
+        # the loop ran its full 4 steps exactly once
+        assert [c[1] for c in stub.calls if c[0] == "step"] \
+            == [1, 2, 3, 4]
+
+    def test_knob_off_falls_back_to_bisection(self):
+        """Without row_isolation the same attributed failure takes the
+        PR-5 path: the cohort leaves the loop and bisection converges
+        on the poison (extra executions), innocents still ok."""
+        stub = _StepStub(poison_token=9)
+        sched = _stub_sched(stub, 2, retry=_retry())
+        sched.start()
+        try:
+            t1 = sched.submit(_req(1))
+            tp = sched.submit(_req(9))
+            r1 = t1.result(timeout=60)
+            rp = tp.result(timeout=60)
+        finally:
+            sched.stop()
+        assert r1.ok
+        assert rp.status == "poisoned"
+        res = sched.serve_stats()["resilience"]
+        assert "row_poison_isolations" not in res
+        assert res["bisections"] >= 1
+        assert len([c for c in stub.calls if c[0] == "init"]) > 1
+
+    def test_quarantine_strike_persists_via_path(self, tmp_path):
+        """A row-isolation quarantine written to quarantine_path
+        survives a restart: the next scheduler fails the poison fast
+        with zero executor calls."""
+        qpath = str(tmp_path / "quarantine.jsonl")
+        stub = _StepStub(poison_token=9)
+        sched = _stub_sched(stub, 2, retry=_retry(row_isolation=True),
+                            quarantine_path=qpath)
+        sched.start()
+        try:
+            tp = sched.submit(_req(9))
+            t1 = sched.submit(_req(1))
+            assert tp.result(timeout=60).status == "poisoned"
+            assert t1.result(timeout=60).ok
+        finally:
+            sched.stop()
+        stub2 = _StepStub()
+        sched2 = _stub_sched(stub2, 2,
+                             retry=_retry(row_isolation=True),
+                             quarantine_path=qpath)
+        sched2.start()
+        try:
+            r = sched2.submit(_req(9)).result(timeout=60)
+        finally:
+            sched2.stop()
+        assert r.status == "poisoned"
+        assert stub2.calls == []               # zero executor calls
+
+    @pytest.mark.skipif(len(jax.devices()) < 2,
+                        reason="needs >= 2 devices")
+    def test_mesh_lease_isolation_innocent_byte_equal(self):
+        """Raise-mode poison on a 1x2 mesh lease: the poison row is
+        isolated through the real FaultPlan attribution, the innocent
+        batch mate serves coords byte-equal to folding alone on the
+        same mesh, and the slice comes back (allocator occupancy 0)."""
+        model = Alphafold2(dim=32, depth=1, heads=2, dim_head=16,
+                           predict_coords=True,
+                           structure_module_depth=1)
+        n = 16
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, n), jnp.int32),
+            msa=jnp.zeros((1, MSA_DEPTH, n), jnp.int32),
+            mask=jnp.ones((1, n), bool),
+            msa_mask=jnp.ones((1, MSA_DEPTH, n), bool))
+        a, p = synthetic_requests(jax.random.PRNGKey(5), num=2,
+                                  lengths=(12, 10),
+                                  msa_depth=MSA_DEPTH)
+
+        def mk(faults, retry):
+            ex = FoldExecutor(model, params, max_entries=8,
+                              faults=faults)
+            sched = Scheduler(
+                ex, BucketPolicy((16,)),
+                SchedulerConfig(max_batch_size=2, max_wait_ms=20.0,
+                                num_recycles=2, msa_depth=MSA_DEPTH),
+                recycle_policy=RecyclePolicy(converge_tol=0.0),
+                retry=retry,
+                mesh_policy=MeshPolicy({16: 2},
+                                       devices=jax.devices()[:2]),
+                metrics=ServeMetrics(registry=MetricsRegistry()),
+                registry=MetricsRegistry())
+            return sched
+
+        plan = FaultPlan(seed=0)
+        plan.add_poison(np.asarray(p.seq), mode="raise")
+        sched = mk(plan, _retry(row_isolation=True))
+        sched.warmup()
+        plan.arm()
+        sched.start()
+        try:
+            ta = sched.submit(FoldRequest(seq=a.seq, msa=a.msa))
+            tp = sched.submit(FoldRequest(seq=p.seq, msa=p.msa))
+            ra = ta.result(timeout=300)
+            rp = tp.result(timeout=300)
+        finally:
+            sched.stop()
+        assert ra.ok, ra.error
+        assert rp.status == "poisoned"
+        stats = sched.serve_stats()
+        assert stats["resilience"]["row_poison_isolations"] >= 1
+        assert stats["mesh"]["allocator"]["busy_devices"] == 0
+        alone = mk(None, None)
+        alone.warmup()
+        with alone:
+            ra2 = alone.submit(
+                FoldRequest(seq=a.seq, msa=a.msa)).result(timeout=300)
+        np.testing.assert_array_equal(ra.coords, ra2.coords)
+        np.testing.assert_array_equal(ra.confidence, ra2.confidence)
+
+
+# -- lease safety -----------------------------------------------------
+
+
+class TestLeaseSafety:
+    def test_release_idempotent_and_span_reacquire_rearm(self):
+        """The SliceLease.held contract: double release is a no-op
+        (never frees a span someone else now holds), and acquire_span
+        re-arms the SAME object so every finally-block reference
+        releases what is actually leased."""
+        alloc = DeviceSliceAllocator(list(range(4)))
+        lease = alloc.acquire((1, 2))
+        assert lease is not None and lease.held
+        alloc.release(lease)
+        assert not lease.held and alloc.busy_devices == 0
+        # double release: no-op even after the span is re-leased
+        other = alloc.acquire((1, 2))
+        alloc.release(lease)
+        assert alloc.busy_devices == 2          # other's span survives
+        alloc.release(other)
+        # a preemption-style yield + blocking re-acquire re-arms the
+        # same lease object
+        lease2 = alloc.acquire((1, 2))
+        alloc.release(lease2)
+        back = alloc.acquire_span(lease2)
+        assert back is lease2 and lease2.held
+        alloc.release(lease2)
+        assert alloc.busy_devices == 0
+
+    @pytest.mark.skipif(len(jax.devices()) < 2,
+                        reason="needs >= 2 devices")
+    def test_midloop_failures_never_leak_slice(self):
+        """The ISSUE-14 audit regression: after a transient-with-resume
+        loop AND a hard (unclassified) mid-loop failure on a leased
+        slice, allocator occupancy returns to zero."""
+        # transient + checkpoint resume on the lease
+        stub = _StepStub(fail_at={1: 1})
+        sched = _stub_sched(
+            stub, 2, retry=_retry(checkpoint_every=1),
+            mesh_policy=MeshPolicy({32: 2}, devices=jax.devices()[:2]))
+        sched.start()
+        try:
+            r = sched.submit(_req(1)).result(timeout=60)
+        finally:
+            sched.stop()
+        assert r.ok
+        assert sched.serve_stats()["resilience"][
+            "checkpoint_resumes"] == 1
+        assert sched._allocator.busy_devices == 0
+        # hard failure, no retry policy: tickets error, slice back
+        stub2 = _StepStub()
+        stub2.fail_hard = True
+
+        def boom(*a, **k):
+            raise ValueError("hard mid-loop failure")
+
+        stub2.run_step = boom
+        sched2 = _stub_sched(
+            stub2, 2,
+            mesh_policy=MeshPolicy({32: 2}, devices=jax.devices()[:2]))
+        sched2.start()
+        try:
+            r2 = sched2.submit(_req(1)).result(timeout=60)
+        finally:
+            sched2.stop()
+        assert r2.status == "error"
+        assert sched2._allocator.busy_devices == 0
+
+    @pytest.mark.skipif(len(jax.devices()) < 2,
+                        reason="needs >= 2 devices")
+    def test_dispatch_bookkeeping_failure_releases_slice(self):
+        """An exception between allocator acquire and the pool handoff
+        (the audited window) releases the lease and still folds the
+        batch inline — no stranded slice, no lost ticket."""
+        stub = _StepStub()
+        sched = _stub_sched(
+            stub, 2,
+            mesh_policy=MeshPolicy({32: 2}, devices=jax.devices()[:2]))
+        fired = []
+        orig = sched._set_busy_gauge
+
+        def flaky():
+            if not fired:
+                fired.append(1)
+                raise RuntimeError("gauge trouble")
+            return orig()
+
+        sched._set_busy_gauge = flaky
+        sched.start()
+        try:
+            r = sched.submit(_req(1)).result(timeout=60)
+        finally:
+            sched.stop()
+        assert fired
+        assert r.ok
+        assert sched._allocator.busy_devices == 0
+
+
+# -- off-by-default identity ------------------------------------------
+
+
+class TestOffIdentity:
+    def test_knobless_retry_scrubbed_stats_and_metric_names_identical(
+            self):
+        """`retry=` without the ISSUE-14 knobs is byte-for-byte the
+        PR-5 surface: scrubbed serve_stats() identical to a policy
+        that never mentioned the fields, and the metric-name set
+        contains none of the new counters (they are minted only when a
+        knob is on)."""
+        def scrub(obj):
+            if isinstance(obj, dict):
+                return {k: scrub(v) for k, v in sorted(obj.items())
+                        if k != "traces" and not k.endswith("_s")}
+            if isinstance(obj, list):
+                return [scrub(v) for v in obj]
+            return obj
+
+        def run_one(retry):
+            reg = MetricsRegistry()
+            sched = _stub_sched(_StepStub(), 2, retry=retry,
+                                registry=reg,
+                                metrics=ServeMetrics(registry=reg))
+            with sched:
+                for tok in (1, 2, 3):
+                    assert sched.submit(_req(tok)).result(
+                        timeout=60).ok
+            return scrub(sched.serve_stats()), set(reg.snapshot())
+
+        explicit_off, names_off = run_one(
+            RetryPolicy(max_attempts=3, jitter=0.0,
+                        checkpoint_every=0, row_isolation=False))
+        never_heard, names_base = run_one(
+            RetryPolicy(max_attempts=3, jitter=0.0))
+        assert json.dumps(explicit_off, sort_keys=True, default=str) \
+            == json.dumps(never_heard, sort_keys=True, default=str)
+        assert names_off == names_base
+        new = {"serve_checkpoint_resumes_total",
+               "serve_recycles_lost_total",
+               "serve_row_poison_isolations_total"}
+        assert not (new & names_base)
+        # ... and flipping a knob on mints them
+        reg = MetricsRegistry()
+        _stub_sched(_StepStub(), 2,
+                    retry=_retry(checkpoint_every=1,
+                                 row_isolation=True),
+                    registry=reg, metrics=ServeMetrics(registry=reg))
+        assert new <= set(reg.snapshot())
+
+
+# -- loadtest flag surface --------------------------------------------
+
+
+class TestLoadtestFlags:
+    def test_stepfault_flags_fast(self, tmp_path, capsys):
+        """Tier-1 flag-rot tripwire: --chaos-step-at /
+        --checkpoint-every / --row-isolation compose with --continuous
+        on a real (tiny) run, and the report carries the recovery-cost
+        fields."""
+        import sys
+        sys.path.insert(0, "tools")
+        try:
+            import serve_loadtest
+        finally:
+            sys.path.pop(0)
+        rc = serve_loadtest.main([
+            "--requests", "8", "--concurrency", "4",
+            "--lengths", "12", "--buckets", "16",
+            "--msa-depth", str(MSA_DEPTH), "--max-batch", "2",
+            "--max-wait-ms", "5", "--num-recycles", "2",
+            "--continuous", "--dim", "32", "--depth", "1",
+            "--chaos", "--chaos-exec-rate", "0.0",
+            "--chaos-step-at", "1=0.25", "--checkpoint-every", "1",
+            "--row-isolation", "--retry", "on",
+            "--metrics-path", str(tmp_path / "m.jsonl")])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out.strip()
+                            .splitlines()[-1])
+        assert "checkpoint_resumes" in report
+        assert "recycles_lost" in report
+        assert "row_poison_isolations" in report
+        assert report["chaos"]["step_fail_at"] == {"1": 0.25}
+        assert report["resilience"]["checkpoint_every"] == 1
+        assert report["resilience"]["row_isolation"] is True
+        # the raise-mode poison sentinel was isolated or bisected to
+        # quarantine either way — never an innocent casualty
+        assert report["poisoned"] == 1

@@ -64,13 +64,19 @@ from alphafold2_tpu.utils.profiling import percentile  # noqa: E402
 # it is an admitted request's first accelerator pass, so the
 # accelerator-time rule below accepts it alongside fold/compile, and
 # its sibling recycle spans carry rows_live/rows_total attrs the
-# occupancy line reads back.
+# occupancy line reads back;
+# resume (carry-checkpoint recovery: re-uploading the last checkpoint
+# after a transient mid-loop failure so survivors continue at their
+# checkpointed ages, tagged with the resume-point recycle and the
+# recycles lost) with ISSUE 14 — it sits between the watchdog window
+# it recovers from and writeback.
 # --check's orphan-span rules apply to all of them unchanged, which is
 # how the chaos smokes prove recovery cost is fully accounted.
 STAGE_ORDER = ("featurize", "submit", "forward", "rpc", "queue",
                "parked", "retry", "drain", "batch_form", "shard",
                "compile", "fold", "recycle", "admit", "watchdog",
-               "writeback", "peer_fetch", "cache_lookup", "write")
+               "resume", "writeback", "peer_fetch", "cache_lookup",
+               "write")
 
 # span/trace boundary slack: start_s, dur_s, and duration_s are each
 # INDEPENDENTLY rounded to 1e-6 when emitted, so a span auto-closed at

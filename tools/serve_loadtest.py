@@ -288,6 +288,24 @@ def parse_args(argv=None):
                     help="scheduler RetryPolicy (failure-domain "
                          "hardening); auto = on iff --chaos")
     ap.add_argument("--retry-max-attempts", type=int, default=4)
+    ap.add_argument("--checkpoint-every", type=int, default=0,
+                    help="step-loop carry checkpointing (ISSUE 14, "
+                         "needs --recycle-sched): snapshot the carry "
+                         "+ per-row ages every N recycles (and at "
+                         "admission gaps) so a transient mid-loop "
+                         "failure resumes survivors at their "
+                         "checkpointed ages instead of requeueing to "
+                         "recycle 0; the report adds "
+                         "checkpoint_resumes / recycles_lost. 0 = off "
+                         "(the PR-5 requeue-from-zero recovery)")
+    ap.add_argument("--row-isolation", action="store_true",
+                    help="per-row poison isolation in the step loop "
+                         "(ISSUE 14): a per-step non-finite scan and "
+                         "row-attributed deterministic failures "
+                         "retire ONLY the offending row while batch "
+                         "mates keep folding (bisection stays the "
+                         "fallback); the report adds "
+                         "row_poison_isolations")
     ap.add_argument("--watchdog-s", type=float, default=0.0,
                     help="per-batch executor watchdog deadline; 0 = off")
     ap.add_argument("--breaker-threshold", type=int, default=0,
@@ -311,7 +329,37 @@ def parse_args(argv=None):
     ap.add_argument("--chaos-peer-rate", type=float, default=0.0,
                     help="P(injected peer transport failure) per fetch "
                          "(fleet mode)")
+    ap.add_argument("--chaos-step-at", default="",
+                    help="mid-loop step faults (ISSUE 14): "
+                         "'RECYCLE=RATE[,RECYCLE=RATE]' — each step "
+                         "execution at that recycle index fails "
+                         "transiently with that probability (e.g. "
+                         "'1=0.25'), hitting the recycle loop exactly "
+                         "where checkpoint resume recovers")
+    ap.add_argument("--chaos-featurize-rate", type=float, default=0.0,
+                    help="P(injected featurize failure) per featurize "
+                         "execution (feature-pipeline mode); errors "
+                         "must fan out to coalesced waiters")
     return ap.parse_args(argv)
+
+
+def _parse_step_fail_at(spec: str) -> dict:
+    """'1=0.25,2=0.1' -> {1: 0.25, 2: 0.1} (the FaultPlan step_fail_at
+    form); empty -> {}. A typo'd schedule must fail loudly at boot
+    (same contract as MeshPolicy.parse), naming the flag and the form."""
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        recycle, _, rate = part.partition("=")
+        try:
+            out[int(recycle)] = float(rate)
+        except ValueError:
+            raise ValueError(
+                f"--chaos-step-at: malformed entry {part!r} — expected "
+                f"RECYCLE=RATE[,RECYCLE=RATE...], e.g. 1=0.25,2=0.1")
+    return out
 
 
 def _build_resilience(args):
@@ -326,7 +374,11 @@ def _build_resilience(args):
             exec_latency_rate=args.chaos_latency_rate,
             exec_latency_s=args.chaos_latency_s,
             peer_error_rate=args.chaos_peer_rate,
-            corrupt_rate=args.chaos_corrupt_rate)
+            corrupt_rate=args.chaos_corrupt_rate,
+            step_fail_at=_parse_step_fail_at(
+                getattr(args, "chaos_step_at", "")),
+            featurize_error_rate=getattr(args, "chaos_featurize_rate",
+                                         0.0))
     retry = None
     if args.retry == "on" or (args.retry == "auto" and args.chaos):
         retry = serve.RetryPolicy(
@@ -334,7 +386,9 @@ def _build_resilience(args):
             backoff_base_s=0.02, backoff_max_s=0.5,
             seed=args.chaos_seed,
             watchdog_s=args.watchdog_s or None,
-            breaker_threshold=args.breaker_threshold)
+            breaker_threshold=args.breaker_threshold,
+            checkpoint_every=getattr(args, "checkpoint_every", 0),
+            row_isolation=getattr(args, "row_isolation", False))
     return plan, retry
 
 
@@ -881,6 +935,13 @@ def main(argv=None) -> int:
                       "evictions", "bytes_resident", "entries_resident")}
     if retry is not None:
         report["resilience"] = snap["resilience"]
+        # step-loop fault-domain headline numbers (ISSUE 14; zero when
+        # the knobs are off, so smoke comparisons read one key set)
+        res = snap["resilience"]
+        report["checkpoint_resumes"] = res.get("checkpoint_resumes", 0)
+        report["recycles_lost"] = res.get("recycles_lost", 0)
+        report["row_poison_isolations"] = res.get(
+            "row_poison_isolations", 0)
     if plan is not None:
         report["chaos"] = dict(plan.snapshot(),
                                poison_mode=args.chaos_poison_mode,
@@ -890,7 +951,7 @@ def main(argv=None) -> int:
 
     if args.smoke and args.chaos:
         return _check_chaos_smoke(args, snap, failures, poison_results,
-                                  retry is not None)
+                                  retry is not None, plan=plan)
     if args.smoke:
         bad = snap["shed"] + snap["errors"] + snap["rejected"] \
             + len(failures)
@@ -1013,11 +1074,16 @@ def main(argv=None) -> int:
 
 
 def _check_chaos_smoke(args, snap, failures, poison_results,
-                       retry_on: bool) -> int:
+                       retry_on: bool, plan=None) -> int:
     """Chaos tripwire (serve_smoke.sh phase 5): under seeded faults the
     hardened scheduler must leave ZERO collateral damage — every ticket
     terminal, every innocent request ok, each poison request quarantined
-    within the bisection bound, and nothing hung."""
+    within the bisection bound, and nothing hung. With step-loop carry
+    checkpointing on (ISSUE 14, --checkpoint-every), recovery cost is
+    additionally bounded: measured recycles_lost must stay within
+    checkpoint_every x the transient failures actually injected (the
+    requeue-from-zero baseline loses ~num_recycles x survivors
+    instead)."""
     import math
 
     problems = []
@@ -1065,16 +1131,39 @@ def _check_chaos_smoke(args, snap, failures, poison_results,
                 problems.append(
                     f"poison {pr['request_id']} took {pr['attempts']} "
                     f"batch executions > log2(max_batch)+1 = {bound}")
+    if retry_on and getattr(args, "checkpoint_every", 0):
+        # bounded recovery (ISSUE 14): each transient mid-loop failure
+        # may cost at most checkpoint_every recycles of progress; the
+        # injected-fault counts are the failure census
+        res = snap["resilience"]
+        injected = (plan.snapshot()["injected"] if plan is not None
+                    else {})
+        n_fail = (injected.get("exec_error", 0)
+                  + injected.get("step_fail", 0)
+                  + res.get("watchdog_fires", 0))
+        bound = args.checkpoint_every * max(1, n_fail)
+        if res.get("recycles_lost", 0) > bound:
+            problems.append(
+                f"recycles_lost {res.get('recycles_lost')} > "
+                f"checkpoint_every x failures = {bound} "
+                f"({n_fail} injected/watchdog failures)")
     if problems:
         print("SMOKE FAIL (chaos): " + "; ".join(problems),
               file=sys.stderr)
         return 1
     inj = snap.get("resilience", {})
+    extra = ""
+    if retry_on and (getattr(args, "checkpoint_every", 0)
+                     or getattr(args, "row_isolation", False)):
+        extra = (f", {inj.get('checkpoint_resumes', 0)} checkpoint "
+                 f"resumes ({inj.get('recycles_lost', 0)} recycles "
+                 f"lost), {inj.get('row_poison_isolations', 0)} row "
+                 f"poison isolations")
     print(f"SMOKE OK (chaos): {snap['served']} folds under injected "
           f"faults, {snap['retried']} retries, "
           f"{inj.get('bisections', 0)} bisections, "
-          f"{snap['poisoned']} poisoned, 0 innocent casualties",
-          file=sys.stderr)
+          f"{snap['poisoned']} poisoned, 0 innocent casualties"
+          f"{extra}", file=sys.stderr)
     return 0
 
 
@@ -1122,12 +1211,17 @@ def _run_features(args) -> int:
 
     latency_s = args.feature_latency_ms / 1000.0
     pipelined = args.feature_pool > 0
+    # featurize chaos (ISSUE 14): --chaos threads the plan into the
+    # pool, so --chaos-featurize-rate exercises the CPU stage's error
+    # fan-out / deadline paths over a real workload
+    plan, retry = _build_resilience(args)
     pool_obj = None
     if pipelined:
         pool_obj = serve.FeaturePool(
             workers=args.feature_pool,
             cache=FeatureCache(),
-            latency_s=latency_s)
+            latency_s=latency_s,
+            faults=plan)
     tracer = None
     if args.trace_path:
         from alphafold2_tpu import obs
@@ -1142,12 +1236,15 @@ def _run_features(args) -> int:
         num_recycles=args.num_recycles, msa_depth=args.msa_depth)
     scheduler = serve.Scheduler(executor, policy, config, metrics,
                                 model_tag="serve_loadtest",
-                                tracer=tracer, feature_pool=pool_obj)
+                                tracer=tracer, feature_pool=pool_obj,
+                                retry=retry)
 
     warmup_timer = StepTimer()
     with warmup_timer.measure():
         compiles = scheduler.warmup()
     scheduler.start()
+    if plan is not None:
+        plan.arm()
 
     # raw prototypes: detokenize back to AA strings (tokenize is an
     # exact inverse over the synthetic token range), so the run
@@ -1218,6 +1315,12 @@ def _run_features(args) -> int:
             with lock:
                 statuses[resp.status] = statuses.get(resp.status, 0) + 1
             if not resp.ok:
+                if plan is not None and resp.error \
+                        and "injected featurize" in resp.error:
+                    # chaos-injected featurize failure: the expected
+                    # outcome under --chaos-featurize-rate (counted in
+                    # statuses + the chaos section), not a harness bug
+                    continue
                 with lock:
                     failures.append(f"{resp.status}: {resp.error}")
             elif resp.coords.shape != (raw.length, 3) or \
@@ -1266,6 +1369,8 @@ def _run_features(args) -> int:
         "rejected": snap["rejected"],
         "failures": failures[:8],
     }
+    if plan is not None:
+        report["chaos"] = plan.snapshot()
     if feat is not None:
         cache_snap = feat.get("cache", {})
         report["featurize"] = {
@@ -1295,10 +1400,11 @@ def _run_features(args) -> int:
     bad = snap["shed"] + snap["errors"] + snap["rejected"] + len(failures)
     if bad or snap["served"] == 0:
         problems.append(f"{bad} bad outcomes, {snap['served']} served")
-    if pipelined and feat is not None:
+    if pipelined and feat is not None and plan is None:
         # zero duplicate featurize work: every unique key featurizes
         # exactly once — duplicates either coalesced in flight or hit
-        # the cache, never re-executed
+        # the cache, never re-executed (not checkable under chaos:
+        # injected featurize failures legitimately end a key's attempt)
         if feat["executions"] != len(unique_keys):
             problems.append(
                 f"{feat['executions']} featurize executions != "

@@ -121,6 +121,25 @@
 #      tests/test_crossbucket.py), and obs_report --check is clean
 #      with native_bucket-tagged admit spans present. The
 #      cross-bucket-batching tripwire.
+#  13. chaos under continuous batching (ISSUE 14, --chaos-step-at +
+#      --checkpoint-every + --row-isolation): the phase-10-shaped
+#      continuous workload with ~15% injected MID-LOOP transient
+#      step faults at recycles 1-3 plus one raise-mode poison, run
+#      TWICE on the identical chaos schedule — the PR-5
+#      requeue-from-zero recovery baseline, then with step-loop fault
+#      domains on (carry checkpointing at every recycle + per-row
+#      poison isolation). FAILS unless BOTH arms leave zero innocent
+#      casualties with every ticket terminal and the poison
+#      quarantined, the hardened arm actually RESUMED from checkpoints
+#      (checkpoint_resumes > 0) with measured recycles_lost within
+#      checkpoint_every x injected failures (enforced in-process by
+#      serve_loadtest --smoke --chaos; the baseline's requeue path
+#      pays ~num_recycles x survivors instead, visible as retries with
+#      zero resumes), the poison cost zero innocent restarts in the
+#      hardened arm (row_poison_isolations > 0, bisections == 0), and
+#      obs_report --check is clean over the chaos traces with resume
+#      spans present in the waterfall. The step-loop-fault-domain
+#      tripwire.
 #   7. multi-chip mesh serving (--mesh-policy, serve.MeshPolicy) under
 #      XLA_FLAGS=--xla_force_host_platform_device_count=8: a mixed
 #      short+long workload where the long bucket is pinned to a 4-chip
@@ -153,7 +172,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 DURATION="${SMOKE_DURATION_S:-30}"
-PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8,9,10,11,12}"
+PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8,9,10,11,12,13}"
 
 phase_on() {
     case ",${PHASES}," in
@@ -835,5 +854,114 @@ print(f"CROSS-BUCKET SMOKE OK: {xb['cross_bucket_admissions']} "
       f"{xb['padding_waste_admitted']} (formation said "
       f"{xb['padding_waste']}), {admit_tagged} "
       f"native_bucket-tagged admit spans", file=sys.stderr)
+EOF
+fi
+
+# phase 13: chaos under continuous batching (ISSUE 14) — the
+# phase-10-shaped continuous workload with 15% injected mid-loop
+# transient step faults (recycles 1-3) + one raise-mode poison on
+# the identical seeded chaos schedule, run TWICE: the PR-5
+# requeue-from-zero recovery baseline, then with step-loop fault
+# domains on (--checkpoint-every 1 --row-isolation). Both arms must
+# leave zero innocent casualties (serve_loadtest --smoke --chaos
+# enforces terminal tickets / innocent ok-rate / quarantine / the
+# recycles_lost <= checkpoint_every x failures bound in-process); the
+# compare below additionally gates that the hardened arm actually
+# resumed (vs the baseline's retries-with-zero-resumes), isolated the
+# poison per-row without bisection, and left resume spans in an
+# orphan-free waterfall.
+if phase_on 13; then
+rm -f /tmp/serve_smoke_stepfault_traces.jsonl
+
+stepfault_phase() {  # $1 = report path, extra args follow
+    local out="$1"; shift
+    timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+        python tools/serve_loadtest.py \
+        --smoke \
+        --chaos \
+        --chaos-exec-rate 0 \
+        --chaos-step-at 1=0.15,2=0.15,3=0.15 \
+        --chaos-poison 1 \
+        --retry on \
+        --retry-max-attempts 6 \
+        --requests 48 \
+        --lengths 24 \
+        --buckets 32 \
+        --msa-depth 3 \
+        --max-batch 4 \
+        --max-wait-ms 10 \
+        --concurrency 8 \
+        --deadline-s 300 \
+        --num-recycles 3 \
+        --continuous \
+        "$@" > "$out"
+    cat "$out"
+}
+
+stepfault_phase /tmp/serve_smoke_stepfault_base.json \
+    --metrics-path /tmp/serve_smoke_stepfault_base.jsonl
+stepfault_phase /tmp/serve_smoke_stepfault.json \
+    --checkpoint-every 1 --row-isolation \
+    --metrics-path /tmp/serve_smoke_stepfault.jsonl \
+    --trace-path /tmp/serve_smoke_stepfault_traces.jsonl \
+    --prom-path /tmp/serve_smoke_stepfault.prom
+
+timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/obs_report.py /tmp/serve_smoke_stepfault_traces.jsonl \
+    --check --prom /tmp/serve_smoke_stepfault.prom
+
+env -u PYTHONPATH python - <<'EOF'
+import json, sys
+base = json.load(open("/tmp/serve_smoke_stepfault_base.json"))
+hard = json.load(open("/tmp/serve_smoke_stepfault.json"))
+problems = []
+# the hardened arm recovered by RESUMING, not restarting: mid-loop
+# faults actually fired and every one of them cost at most
+# checkpoint_every recycles (the in-process --smoke check bounded it)
+if hard.get("checkpoint_resumes", 0) <= 0:
+    problems.append("hardened arm never resumed from a checkpoint")
+if hard["chaos"]["injected"].get("step_fail", 0) <= 0:
+    problems.append("no mid-loop step faults were injected")
+# the poison cost zero innocent restarts: isolated per-row, never
+# bisected a cohort
+if hard.get("row_poison_isolations", 0) <= 0:
+    problems.append("poison was not isolated per-row")
+if hard["resilience"].get("bisections", 0):
+    problems.append(f"hardened arm bisected "
+                    f"{hard['resilience']['bisections']} cohorts")
+if hard.get("poisoned", 0) != 1 or base.get("poisoned", 0) != 1:
+    problems.append(f"expected exactly 1 quarantined poison per arm, "
+                    f"got {base.get('poisoned')} / "
+                    f"{hard.get('poisoned')}")
+# the baseline took the PR-5 path on the same chaos: requeues fired,
+# zero checkpoint machinery
+if base["resilience"].get("retries", 0) <= 0:
+    problems.append("baseline chaos never exercised the requeue path")
+if base.get("checkpoint_resumes", 0):
+    problems.append(f"baseline (knobs off) reported "
+                    f"{base['checkpoint_resumes']} resumes")
+resume_spans = 0
+for line in open("/tmp/serve_smoke_stepfault_traces.jsonl"):
+    try:
+        rec = json.loads(line)
+    except ValueError:
+        continue
+    for s in rec.get("spans", ()):
+        if s.get("name") == "resume":
+            resume_spans += 1
+if resume_spans == 0:
+    problems.append("no resume spans in the hardened arm's traces")
+if problems:
+    print("STEPFAULT SMOKE FAIL: " + "; ".join(problems),
+          file=sys.stderr)
+    sys.exit(1)
+n_fail = hard["chaos"]["injected"]["step_fail"]
+print(f"STEPFAULT SMOKE OK: {hard['checkpoint_resumes']} checkpoint "
+      f"resumes over {n_fail} injected mid-loop faults, "
+      f"{hard['recycles_lost']} recycles lost (bound "
+      f"{hard['resilience']['checkpoint_every']} x {n_fail}), "
+      f"{hard['row_poison_isolations']} row poison isolations / 0 "
+      f"bisections vs baseline {base['resilience']['retries']} "
+      f"requeue retries, {resume_spans} resume spans", file=sys.stderr)
 EOF
 fi
