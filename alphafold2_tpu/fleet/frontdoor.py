@@ -38,6 +38,13 @@ cache tier in `fleet/peer.py`). The protocol is deliberately tiny:
                                  — the passthrough needs no wiring here
                                  because both payloads come whole from
                                  the scheduler)
+    GET  /metrics                Prometheus text exposition 0.0.4 of
+                                 this process's MetricsRegistry
+                                 (obs/export.py) — the scrape surface
+                                 the SLO engine's slo_* gauges and
+                                 every serve_*/fleet_* series ride;
+                                 control-plane like /admin (served
+                                 through an induced partition)
     POST /admin/rollout          {"tag": t} -> bump RolloutState
     GET  /admin/stats            serve_stats() as JSON
     POST /admin/partition        {"duration_s": f} -> data-plane 503s
@@ -71,6 +78,7 @@ from urllib import parse as urlparse
 from alphafold2_tpu.fleet.rpc import (decode_raw_request, decode_request,
                                       encode_response, _HDR_TAG)
 from alphafold2_tpu.obs.registry import MetricsRegistry, get_registry
+from alphafold2_tpu.obs.trace import TraceContext
 
 
 class _TicketSlot:
@@ -131,7 +139,16 @@ class FrontDoorServer:
         # "extra" — the owning process adds what the scheduler cannot
         # see (peer-client counters, front-door snapshot)
         self.extra_stats = None
+        # optional zero-arg callable fired (best-effort) before each
+        # GET /metrics render — the owning process refreshes gauges a
+        # scrape should see fresh (the SLO engine's slo_* set, which
+        # otherwise only update when serve_stats() runs)
+        self.metrics_hook = None
         reg = metrics or get_registry()
+        # the registry GET /metrics exposes — the same one the rpc
+        # counter below reports into (the process default unless the
+        # owner isolated one)
+        self._registry = reg
         # distinct name from the client-side fleet_rpc_requests_total:
         # a procfleet replica both serves a front door and forwards via
         # HttpTransports on the same registry, and the registry dedups
@@ -235,6 +252,11 @@ class FrontDoorServer:
         path = parsed.path
         if path == "/healthz" and method == "GET":
             return self._healthz(h)
+        if path == "/metrics" and method == "GET":
+            # Prometheus scrape (ISSUE 15): control-plane like /admin —
+            # served through an induced partition, because the chaos
+            # window is exactly when an operator needs the numbers
+            return self._metrics(h)
         if path.startswith("/admin/"):
             return self._admin(h, method, path)
         if self.partition.is_set():
@@ -272,6 +294,26 @@ class FrontDoorServer:
         self._m_rpc.inc(route="healthz", outcome="ok")
         h._json(200, payload)
 
+    def _metrics(self, h):
+        """Prometheus text exposition of this process's registry (the
+        0.0.4 format obs.export.prometheus_text renders) — the registry
+        was previously only reachable as JSON through /admin/stats."""
+        from alphafold2_tpu.obs.export import prometheus_text
+
+        if self.metrics_hook is not None:
+            try:
+                self.metrics_hook()
+            except Exception:
+                pass      # a broken refresher never breaks the scrape
+        try:
+            text = prometheus_text(self._registry)
+        except Exception as exc:
+            self._m_rpc.inc(route="metrics", outcome="error")
+            return h._json(500, {"error": repr(exc)})
+        self._m_rpc.inc(route="metrics", outcome="ok")
+        h._reply(200, text.encode("utf-8"),
+                 content_type="text/plain; version=0.0.4")
+
     def _submit(self, h):
         from alphafold2_tpu.serve.scheduler import (DrainingError,
                                                     QueueFullError)
@@ -302,13 +344,35 @@ class FrontDoorServer:
         except ValueError as exc:
             self._m_rpc.inc(route="submit", outcome="bad_request")
             return h._json(400, {"error": str(exc)})
+        # cross-process trace continuation (ISSUE 15): a submit whose
+        # headers carry a TraceContext — a forwarded fold, a raw job
+        # routed by feature key, a traced driver — continues the
+        # SENDER's trace on this replica's tracer, so the fold stages
+        # here stitch under the sender's rpc span instead of starting
+        # a disconnected trace. No headers (or tracing off here) is
+        # byte-for-byte the old path.
+        trace = None
+        ctx = TraceContext.from_headers(h.headers)
+        if ctx is not None:
+            tracer = getattr(self.scheduler, "tracer", None)
+            if tracer is not None and getattr(tracer, "enabled", False):
+                trace = tracer.start_trace(request.request_id,
+                                           context=ctx)
         try:
-            ticket = (self.scheduler.submit_raw(request) if raw_body
-                      else self.scheduler.submit(request))
+            if trace is not None:
+                ticket = (self.scheduler.submit_raw(request, trace=trace)
+                          if raw_body
+                          else self.scheduler.submit(request,
+                                                     trace=trace))
+            else:
+                ticket = (self.scheduler.submit_raw(request) if raw_body
+                          else self.scheduler.submit(request))
         except DrainingError:
+            self._finish_trace(trace, "rejected", "draining")
             self._m_rpc.inc(route="submit", outcome="draining")
             return h._json(503, {"error": "draining"})
         except QueueFullError:
+            self._finish_trace(trace, "rejected", "queue full")
             self._m_rpc.inc(route="submit", outcome="queue_full")
             return h._json(429, {"error": "queue full"})
         except ValueError as exc:
@@ -316,11 +380,13 @@ class FrontDoorServer:
             # largest bucket): the CLIENT's error, 400 — never 500,
             # which failover layers would misread as a server fault
             # and retry across the whole fleet
+            self._finish_trace(trace, "rejected", str(exc))
             self._m_rpc.inc(route="submit", outcome="bad_request")
             return h._json(400, {"error": str(exc)})
         except RuntimeError as exc:
             # stopped scheduler: same caller story as draining —
             # this replica cannot take the work, go elsewhere
+            self._finish_trace(trace, "error", str(exc))
             self._m_rpc.inc(route="submit", outcome="unavailable")
             return h._json(503, {"error": str(exc)})
         slot = _TicketSlot(ticket)
@@ -400,6 +466,19 @@ class FrontDoorServer:
         self._m_rpc.inc(route="result", outcome="ok")
         h._reply(200, body, headers=headers,
                  content_type="application/octet-stream")
+
+    @staticmethod
+    def _finish_trace(trace, status: str, error: str):
+        """A continued trace refused at the door still owes the fleet
+        one terminal record (the scheduler usually finishes it, but the
+        pre-entry fail-fasts — bucket_for on an over-length sequence —
+        raise before it adopts the trace). finish() is idempotent, so
+        double cover costs nothing."""
+        if trace is not None:
+            try:
+                trace.finish(status, error=error)
+            except Exception:
+                pass
 
     @staticmethod
     def _latest_progress(slot):

@@ -175,6 +175,11 @@ class InProcessFleet:
                 # unified health: the peer probe payload carries the
                 # same breaker/queue/drain truth the front door serves
                 peer_server.health_source = scheduler.health
+                # served fetches emit continued trace records under
+                # the requester's peer_fetch hop (ISSUE 15) — the
+                # in-process harness shares the one tracer, so the
+                # stitched pair lands in the same JSONL
+                peer_server.tracer = tracer
             self.replicas.append(
                 FleetReplica(rid, scheduler, cache, peer_server, router))
 

@@ -54,7 +54,7 @@ from alphafold2_tpu.cache.store import CachedFold, decode_fold
 from alphafold2_tpu.fleet.registry import ReplicaRegistry, RolloutState
 from alphafold2_tpu.fleet.router import ConsistentHashRouter
 from alphafold2_tpu.obs.registry import MetricsRegistry, get_registry
-from alphafold2_tpu.obs.trace import NULL_TRACE
+from alphafold2_tpu.obs.trace import NULL_TRACE, TraceContext
 
 _TAG_HEADER = "X-Model-Tag"
 
@@ -91,7 +91,21 @@ class PeerCacheServer:
         # with the replica's FrontDoorServer so one event severs both
         # planes
         self.partition = partition
-        m_served = (metrics or get_registry()).counter(
+        # tracer: optional obs.Tracer (assignable after construction,
+        # like health_source). When set and a fetch carries a
+        # TraceContext, this server emits a tiny continued trace — one
+        # `peer_serve` span sharing the requester's trace id — so a
+        # peer-cache hit's two halves stitch into ONE fleet waterfall
+        # (ISSUE 15) instead of a client-side span with no server story
+        self.tracer = None
+        # metrics_hook: optional zero-arg callable run before each
+        # GET /metrics render (same contract as FrontDoorServer's) —
+        # wire it to SLOEngine.report so a scrape of THIS port reads
+        # gauges as fresh as the front-door port's
+        self.metrics_hook = None
+        reg = metrics or get_registry()
+        self._registry = reg      # GET /metrics exposes this registry
+        m_served = reg.counter(
             "fleet_peer_served_total",
             "peer-protocol fetches served by this process, by outcome",
             ("replica", "outcome"))
@@ -108,6 +122,16 @@ class PeerCacheServer:
             def _count(self, outcome: str):
                 m_served.inc(replica=server.replica_id, outcome=outcome)
 
+            @staticmethod
+            def _finish(trace, outcome: str, status: str):
+                if trace is None:
+                    return
+                try:
+                    trace.end("peer_serve", outcome=outcome)
+                    trace.finish(status, source="peer")
+                except Exception:
+                    pass      # obs, never the fetch path
+
             def _reply(self, code: int, body: bytes,
                        content_type: str = "application/octet-stream"):
                 self.send_response(code)
@@ -119,8 +143,34 @@ class PeerCacheServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                trace = None
                 try:
                     parsed = urlparse.urlsplit(self.path)
+                    if parsed.path == "/metrics":
+                        # the same scrape surface the front door grew
+                        # (ISSUE 15) — a cache-only deployment without
+                        # a front door is still scrapeable. Served
+                        # BEFORE the partition check, matching the
+                        # front door's rule: the chaos window is
+                        # exactly when an operator needs the numbers.
+                        # Render failures stay OFF the peer-fetch
+                        # error counter the chaos smokes gate on.
+                        from alphafold2_tpu.obs.export import \
+                            prometheus_text
+                        if server.metrics_hook is not None:
+                            try:
+                                server.metrics_hook()
+                            except Exception:
+                                pass
+                        try:
+                            text = prometheus_text(server._registry)
+                        except Exception:
+                            self._reply(500, b"metrics error",
+                                        "text/plain")
+                            return
+                        self._reply(200, text.encode(),
+                                    "text/plain; version=0.0.4")
+                        return
                     if server.partition is not None \
                             and server.partition.is_set():
                         # induced partition: unreachable on every
@@ -149,6 +199,16 @@ class PeerCacheServer:
                         self._reply(404, b"not found", "text/plain")
                         return
                     key = parsed.path[len("/cache/"):]
+                    # continued trace for the fetch (tracing-on fleets
+                    # only): one peer_serve span under the requester's
+                    # peer_fetch hop
+                    ctx = TraceContext.from_headers(self.headers)
+                    if ctx is not None and server.tracer is not None \
+                            and getattr(server.tracer, "enabled",
+                                        False):
+                        trace = server.tracer.start_trace(
+                            f"peer:{key[:24]}", context=ctx)
+                        trace.begin("peer_serve")
                     tag = urlparse.parse_qs(parsed.query).get(
                         "tag", [""])[0]
                     if server.rollout is not None \
@@ -157,20 +217,28 @@ class PeerCacheServer:
                         # replica disagree on the current weights —
                         # refuse, never guess (rollout invalidation)
                         self._count("stale_tag")
+                        self._finish(trace, "stale_tag", "rejected")
                         self._reply(409, b"model tag mismatch",
                                     "text/plain")
                         return
                     data = server.cache.read_raw(key)
                     if data is None:
                         self._count("miss")
+                        self._finish(trace, "miss", "miss")
                         self._reply(404, b"miss", "text/plain")
                         return
                     self._count("hit")
+                    self._finish(trace, "hit", "ok")
                     self._reply(200, data)
                 except Exception:
                     # a broken fetch must cost the REQUESTER a miss,
                     # never wedge the serving replica's handler thread
                     self._count("error")
+                    # a continued trace started before the failure
+                    # still owes the fleet its serving-side record —
+                    # the error outcome is the one an operator most
+                    # needs the server half of
+                    self._finish(trace, "error", "error")
                     try:
                         self._reply(500, b"peer error", "text/plain")
                     except Exception:
@@ -359,6 +427,13 @@ class PeerCacheClient:
         url = (f"http://{host}:{port}/cache/"
                f"{urlparse.quote(key, safe='')}"
                f"?tag={urlparse.quote(tag, safe='')}")
+        # cross-process stitching (ISSUE 15): the fetch carries the
+        # request trace's context so the owner's PeerCacheServer can
+        # emit a continued peer_serve record under this hop; the
+        # span_id lands on the peer_fetch event below so the fleet
+        # aggregator can match the two. Nothing on the wire when
+        # tracing is off.
+        ctx = trace.wire_context()
         t0 = time.monotonic()
         outcome, value = "error", None
         try:
@@ -367,7 +442,9 @@ class PeerCacheClient:
                 # handler below, so chaos exercises the real
                 # markdown/recovery machinery
                 self.faults.on_peer_fetch(owner)
-            with urlrequest.urlopen(url, timeout=self.timeout_s) as resp:
+            req = urlrequest.Request(
+                url, headers=ctx.to_headers() if ctx is not None else {})
+            with urlrequest.urlopen(req, timeout=self.timeout_s) as resp:
                 served_tag = resp.headers.get(_TAG_HEADER)
                 body = resp.read()
             if served_tag is not None and served_tag != tag:
@@ -394,5 +471,9 @@ class PeerCacheClient:
             self._note_transport_failure(owner)
         self._m_latency.observe(time.monotonic() - t0)
         self._m_fetch.inc(peer=owner, outcome=outcome)
-        trace.event("peer_fetch", peer=owner, outcome=outcome)
+        if ctx is not None:
+            trace.event("peer_fetch", peer=owner, outcome=outcome,
+                        span_id=ctx.parent_span_id)
+        else:
+            trace.event("peer_fetch", peer=owner, outcome=outcome)
         return value

@@ -121,7 +121,9 @@ class ProcFleet:
                  mesh_policy: str = "",
                  mesh_hbm_gb: float = 16.0,
                  recycle: Optional[dict] = None,
-                 feature_pool: Optional[dict] = None):
+                 feature_pool: Optional[dict] = None,
+                 slo: str = "",
+                 slo_window_s: float = 60.0):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self.run_dir = os.path.abspath(run_dir)
@@ -171,6 +173,13 @@ class ProcFleet:
                 # path; None = inline featurize (today's behavior)
                 feature_pool=(None if feature_pool is None
                               else dict(feature_pool)),
+                # optional SLO objectives (ISSUE 15): the
+                # obs.slo.SLOPolicy.parse spec string; each replica
+                # builds its own engine over its own registry, so the
+                # slo_* gauges ride its GET /metrics scrape and
+                # serve_stats()["slo"] reports its window
+                slo=str(slo),
+                slo_window_s=float(slo_window_s),
                 retry=bool(retry),
                 peers=[p for p in peer_rows
                        if p["replica_id"] != row["replica_id"]])
@@ -507,7 +516,11 @@ def replica_main(config: dict) -> int:
                 f"http://{peer['host']}:{peer['frontdoor_port']}",
                 rollout=rollout))
 
-    tracer = obs.Tracer(jsonl_path=config["trace_path"])
+    # origin-tagged tracer (ISSUE 15): globally unique trace ids +
+    # an `origin` field on every record, so N replicas' JSONL merges
+    # into one stitchable fleet set — and inbound submits carrying a
+    # TraceContext continue the sender's trace on this tracer
+    tracer = obs.Tracer(jsonl_path=config["trace_path"], origin=rid)
     retry = None
     if config.get("retry", True):
         retry = serve.RetryPolicy(max_attempts=4, backoff_base_s=0.02,
@@ -552,6 +565,14 @@ def replica_main(config: dict) -> int:
         carry_recyclables=recycle_policy is not None,
         continuous=bool(recycle_policy is not None
                         and recycle_policy.continuous))
+    # optional SLO engine (ISSUE 15): per-QoS-class objectives over
+    # this process's default registry — the same one every serve_*
+    # metric mirrors into and GET /metrics renders
+    slo_engine = None
+    if config.get("slo"):
+        slo_engine = obs.SLOEngine(obs.SLOPolicy.parse(
+            config["slo"],
+            window_s=float(config.get("slo_window_s", 60.0))))
     scheduler = serve.Scheduler(
         executor, policy,
         serve.SchedulerConfig(
@@ -563,7 +584,7 @@ def replica_main(config: dict) -> int:
         router=router, retry=retry,
         quarantine_path=os.path.join(state_dir, "quarantine.jsonl"),
         mesh_policy=mesh_policy, recycle_policy=recycle_policy,
-        feature_pool=feature_pool)
+        feature_pool=feature_pool, slo=slo_engine)
     rollout.subscribe(
         lambda tag, epoch: setattr(scheduler, "model_tag", tag))
 
@@ -582,6 +603,15 @@ def replica_main(config: dict) -> int:
                  "recoveries": client.recoveries},
         "frontdoor": frontdoor.snapshot(),
         "rollout": {"tag": rollout.tag, "epoch": rollout.epoch}}
+    # peer-cache fetches served here emit continued trace records
+    # under the requester's peer_fetch hop (ISSUE 15)
+    peer_server.tracer = tracer
+    if slo_engine is not None:
+        # a /metrics scrape refreshes the slo_* gauges first, so the
+        # scraped window is as fresh as a serve_stats() poll's —
+        # whichever of the two ports the scraper targets
+        frontdoor.metrics_hook = slo_engine.report
+        peer_server.metrics_hook = slo_engine.report
 
     scheduler.warmup()
     scheduler.start()

@@ -47,7 +47,7 @@ from urllib import request as urlrequest
 import numpy as np
 
 from alphafold2_tpu.obs.registry import MetricsRegistry, get_registry
-from alphafold2_tpu.obs.trace import NULL_TRACE
+from alphafold2_tpu.obs.trace import NULL_TRACE, TraceContext
 from alphafold2_tpu.serve.request import (FoldRequest, FoldResponse,
                                           FoldTicket)
 
@@ -84,7 +84,8 @@ def encode_request(request: FoldRequest) -> bytes:
     return buf.getvalue()
 
 
-def request_headers(request: FoldRequest, tag: str = "") -> dict:
+def request_headers(request: FoldRequest, tag: str = "",
+                    context: Optional[TraceContext] = None) -> dict:
     h = {_HDR_REQUEST_ID: request.request_id,
          _HDR_PRIORITY: str(int(request.priority)),
          _HDR_FORWARDED: "1" if request.forwarded else "0",
@@ -93,6 +94,11 @@ def request_headers(request: FoldRequest, tag: str = "") -> dict:
         h[_HDR_DEADLINE] = repr(float(request.deadline_s))
     if tag:
         h[_HDR_TAG] = tag
+    if context is not None:
+        # cross-process trace propagation (ISSUE 15): the receiving
+        # front door continues the SAME trace; absent when tracing is
+        # off, so the off-switch leaves the wire byte-identical
+        h.update(context.to_headers())
     return h
 
 
@@ -361,44 +367,78 @@ class HttpTransport:
     def submit(self, request: FoldRequest, trace=NULL_TRACE) -> FoldTicket:
         """One forwarding hop. Raises on submit-time transport failure
         (caller folds locally); otherwise returns a ticket the poll
-        thread resolves."""
+        thread resolves.
+
+        The `rpc` span covers the WHOLE exchange — submit POST through
+        terminal pickup — recorded as one completed interval (add_span)
+        at whichever end the exchange reaches, with an `outcome` attr:
+        "ok", "submit_error", "transport_death" (owner died/partitioned
+        /restarted mid-fold — stamped BEFORE the ticket resolves, so a
+        failover re-submission never inherits a dangling open span; the
+        ISSUE-15 orphan fix), "poll_exhausted", or "cancelled". With
+        tracing on, the request's TraceContext rides the submit headers
+        and the span carries the matching `span_id`, so the receiving
+        replica's continued trace stitches under exactly this span."""
+        ctx = trace.wire_context()
         body = encode_request(request)
-        headers = request_headers(request, tag=self._tag())
+        headers = request_headers(request, tag=self._tag(), context=ctx)
+        t0 = time.monotonic()
         try:
-            with trace.span("rpc", peer=self.base_url, route="submit"):
-                with self._post("/v1/submit", body, headers) as resp:
-                    payload = json.loads(resp.read().decode("utf-8"))
+            with self._post("/v1/submit", body, headers) as resp:
+                payload = json.loads(resp.read().decode("utf-8"))
             remote_ticket = payload["ticket"]
         except Exception:
+            self._end_rpc(trace, t0, "submit", ctx, "submit_error")
             self._m_rpc.inc(route="submit", outcome="error")
             raise
         self._m_rpc.inc(route="submit", outcome="ok")
-        return self._polled_ticket(remote_ticket, request)
+        return self._polled_ticket(remote_ticket, request, trace, t0,
+                                   "submit", ctx)
 
     def submit_raw(self, raw, trace=NULL_TRACE) -> FoldTicket:
         """One RAW forwarding hop (feature-key routing, ISSUE 10): the
         owner featurizes replica-side and folds. Same failure contract
-        as submit() — submit-time trouble raises (caller featurizes
-        locally), post-submit trouble resolves with the transport
-        marker (the feature pool then fails over to local
-        featurization)."""
+        (and rpc-span/trace-context lifecycle) as submit() —
+        submit-time trouble raises (caller featurizes locally),
+        post-submit trouble resolves with the transport marker (the
+        feature pool then fails over to local featurization)."""
+        ctx = trace.wire_context()
         body, headers = encode_raw_request(raw)
         tag = self._tag()
         if tag:
             headers[_HDR_TAG] = tag
+        if ctx is not None:
+            headers.update(ctx.to_headers())
+        t0 = time.monotonic()
         try:
-            with trace.span("rpc", peer=self.base_url,
-                            route="submit_raw"):
-                with self._post("/v1/submit", body, headers) as resp:
-                    payload = json.loads(resp.read().decode("utf-8"))
+            with self._post("/v1/submit", body, headers) as resp:
+                payload = json.loads(resp.read().decode("utf-8"))
             remote_ticket = payload["ticket"]
         except Exception:
+            self._end_rpc(trace, t0, "submit_raw", ctx, "submit_error")
             self._m_rpc.inc(route="submit_raw", outcome="error")
             raise
         self._m_rpc.inc(route="submit_raw", outcome="ok")
-        return self._polled_ticket(remote_ticket, raw)
+        return self._polled_ticket(remote_ticket, raw, trace, t0,
+                                   "submit_raw", ctx)
 
-    def _polled_ticket(self, remote_ticket: str, request) -> FoldTicket:
+    def _end_rpc(self, trace, t0: float, route: str,
+                 ctx: Optional[TraceContext], outcome: str):
+        """Record the exchange's rpc span, exactly once per exchange,
+        on every terminal path. add_span (a completed interval), never
+        begin/end: the span can't be orphaned open by a dead owner, and
+        a late poll-thread recording after the trace finished is
+        silently dropped instead of colliding with a failover
+        re-submission's fresh exchange."""
+        attrs = {"peer": self.base_url, "route": route,
+                 "outcome": outcome}
+        if ctx is not None:
+            attrs["span_id"] = ctx.parent_span_id
+        trace.add_span("rpc", t0, time.monotonic(), **attrs)
+
+    def _polled_ticket(self, remote_ticket: str, request, trace, t0,
+                       route: str,
+                       ctx: Optional[TraceContext]) -> FoldTicket:
         """Local ticket resolved by a daemon long-poll thread — the one
         pickup path both the token and raw submit hops share. `request`
         only needs a request_id (FoldRequest and RawFoldRequest both
@@ -408,7 +448,8 @@ class HttpTransport:
         # best-effort cancel so the parked result is dropped, not leaked
         ticket._timeout_callback = lambda: self.cancel(remote_ticket)
         threading.Thread(
-            target=self._poll, args=(remote_ticket, request, ticket),
+            target=self._poll,
+            args=(remote_ticket, request, ticket, trace, t0, route, ctx),
             name=f"rpc-poll-{request.request_id}", daemon=True).start()
         return ticket
 
@@ -419,14 +460,22 @@ class HttpTransport:
             error=f"{RPC_TRANSPORT_MARKER}: {detail}")
 
     def _poll(self, remote_ticket: str, request: FoldRequest,
-              ticket: FoldTicket):
+              ticket: FoldTicket, trace, t0: float, route: str,
+              ctx: Optional[TraceContext]):
         """Long-poll the owner until terminal; resolve the local ticket
-        exactly once, with the transport marker on any failure."""
+        exactly once, with the transport marker on any failure. The
+        exchange's rpc span is recorded (with its outcome) BEFORE the
+        ticket resolves, so any failover path the resolution triggers
+        re-submits against a trace whose dead-owner span is already
+        closed — never auto-closed at finish, never spanning the
+        retry."""
         deadline = time.monotonic() + self.poll_budget_s
         misses = 0
         while time.monotonic() < deadline:
             if ticket.done():
-                return               # cancelled locally meanwhile
+                # cancelled locally meanwhile (result-timeout path)
+                self._end_rpc(trace, t0, route, ctx, "cancelled")
+                return
             url = (f"{self.base_url}/v1/result/"
                    f"{urlparse.quote(remote_ticket, safe='')}"
                    f"?wait_s={self.poll_wait_s}")
@@ -446,19 +495,23 @@ class HttpTransport:
                 # 404 = the owner restarted and forgot the ticket; both
                 # cases mean the transport lost the fold, not the fold
                 # failed — failover-eligible
+                self._end_rpc(trace, t0, route, ctx, "transport_death")
                 ticket._resolve(self._transport_error(
                     request, f"result fetch failed: HTTP {exc.code}"))
                 return
             except Exception as exc:
                 self._m_rpc.inc(route="result", outcome="error")
+                self._end_rpc(trace, t0, route, ctx, "transport_death")
                 ticket._resolve(self._transport_error(
                     request, f"result fetch failed: {exc!r}"))
                 return
             self._m_rpc.inc(route="result", outcome="ok")
+            self._end_rpc(trace, t0, route, ctx, "ok")
             ticket._resolve(response)
             return
         self._m_rpc.inc(route="result", outcome="poll_exhausted")
         self.cancel(remote_ticket)
+        self._end_rpc(trace, t0, route, ctx, "poll_exhausted")
         ticket._resolve(self._transport_error(
             request, f"poll budget {self.poll_budget_s}s exhausted "
                      f"after {misses} empty polls"))

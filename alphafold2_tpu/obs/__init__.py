@@ -28,5 +28,8 @@ from alphafold2_tpu.obs.registry import (DEFAULT_LATENCY_BUCKETS,  # noqa: F401
                                          Counter, Gauge, Histogram,
                                          MetricsRegistry, get_registry,
                                          set_registry)
+from alphafold2_tpu.obs.slo import (SLOClass, SLOEngine,  # noqa: F401
+                                    SLOPolicy)
 from alphafold2_tpu.obs.trace import (NULL_TRACE, NULL_TRACER,  # noqa: F401
-                                      MultiTrace, Trace, Tracer)
+                                      MultiTrace, Trace, TraceContext,
+                                      Tracer)

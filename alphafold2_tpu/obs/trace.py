@@ -27,6 +27,24 @@ Design constraints, in priority order:
 - completed traces are emitted as one JSONL record each (`"schema": 1`,
   spans with offsets relative to trace start) and the K slowest are
   kept in a ring the scheduler exposes via `serve_stats()["traces"]`.
+
+Cross-process propagation (ISSUE 15): a trace CROSSES the RPC seam.
+`Trace.wire_context()` mints a `TraceContext` — trace id + a fresh
+parent span id + this process's origin replica — that travels as HTTP
+headers (`fleet.rpc.HttpTransport`, the peer cache client); the
+receiving process continues the SAME trace via
+`Tracer.start_trace(request_id, context=ctx)`, so a forwarded fold's
+two halves share one trace id and the child record names the exact
+sender span (`parent_span_id`) it hangs under. Child segments are
+anchored to the parent's rpc span by the aggregator
+(`tools/obs_fleet.py`) — NEVER by comparing wall clocks across hosts:
+each record's offsets stay relative to its own monotonic start, and
+monotonic clocks don't compare across processes. `Tracer(origin=...)`
+makes trace ids globally unique (origin + a per-boot nonce ride the
+id) so two replicas' local counters can never collide in a merged
+file; origin-less tracers keep the compact single-process ids. No
+context goes on the wire unless tracing is on (`NULL_TRACE.
+wire_context()` is None).
 """
 
 from __future__ import annotations
@@ -37,12 +55,52 @@ import json
 import os
 import threading
 import time
+import uuid
+from dataclasses import dataclass
 from typing import IO, List, Optional
 
 # the one schema tag every observability record carries (obs/export.py)
 from alphafold2_tpu.obs.export import SCHEMA_VERSION
 
 _trace_counter = itertools.count()
+
+# wire header names the trace context travels under (HttpTransport
+# submit/submit_raw, PeerCacheClient fetches)
+_HDR_TRACE_ID = "X-Trace-Id"
+_HDR_PARENT_SPAN = "X-Parent-Span"
+_HDR_ORIGIN = "X-Trace-Origin"
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """The wire form of one cross-process trace hop: enough for the
+    receiver to continue the SAME trace (trace_id), to name the exact
+    sender span its segment hangs under (parent_span_id — the rpc or
+    peer-fetch span the sender records with a matching `span_id`
+    attr), and to attribute the hop (origin — the sender's replica
+    id). Header-encoded; absent headers decode to None, so a
+    pre-ISSUE-15 peer (or a tracing-off sender) costs nothing."""
+
+    trace_id: str
+    parent_span_id: str
+    origin: str = ""
+
+    def to_headers(self) -> dict:
+        h = {_HDR_TRACE_ID: self.trace_id,
+             _HDR_PARENT_SPAN: self.parent_span_id}
+        if self.origin:
+            h[_HDR_ORIGIN] = self.origin
+        return h
+
+    @classmethod
+    def from_headers(cls, headers) -> Optional["TraceContext"]:
+        trace_id = headers.get(_HDR_TRACE_ID)
+        if not trace_id:
+            return None
+        return cls(trace_id=str(trace_id),
+                   parent_span_id=str(
+                       headers.get(_HDR_PARENT_SPAN) or ""),
+                   origin=str(headers.get(_HDR_ORIGIN) or ""))
 
 
 class _NullContext:
@@ -66,6 +124,9 @@ class _NullTrace:
     __slots__ = ()
     enabled = False
     trace_id = ""
+
+    def wire_context(self):
+        return None         # tracing off: nothing goes on the wire
 
     def begin(self, name):
         pass
@@ -118,14 +179,30 @@ class Trace:
     """One request's span tree. Thread-safe; finish() is idempotent."""
 
     __slots__ = ("trace_id", "request_id", "leader_trace_id", "status",
-                 "source", "error", "_tracer", "_lock", "_t0", "_t0_unix",
-                 "_end", "_spans", "_events", "_open", "_finished")
+                 "source", "error", "parent_span_id", "parent_origin",
+                 "_span_seq", "_hop_nonce", "_tracer", "_lock", "_t0",
+                 "_t0_unix", "_end", "_spans", "_events", "_open",
+                 "_finished")
 
     enabled = True
 
     def __init__(self, tracer: "Tracer", request_id: str):
-        self.trace_id = f"t{next(_trace_counter)}"
+        # origin-tagged tracers (one per fleet replica) mint GLOBALLY
+        # unique ids — origin + a per-boot nonce ride the id, so two
+        # replicas' (or a restarted replica's) local counters can
+        # never collide in a merged fleet trace file. Origin-less
+        # tracers keep the compact pre-fleet single-process ids.
+        n = next(_trace_counter)
+        origin = getattr(tracer, "origin", "")
+        self.trace_id = (f"t{n}" if not origin
+                         else f"t{n}.{origin}.{tracer._nonce}")
         self.request_id = request_id
+        # set when this trace CONTINUES a remote hop (started with a
+        # TraceContext): the sender's span this record hangs under
+        self.parent_span_id: Optional[str] = None
+        self.parent_origin: str = ""
+        self._span_seq = itertools.count()
+        self._hop_nonce: Optional[str] = None   # minted on first hop
         self.leader_trace_id: Optional[str] = None
         self.status: Optional[str] = None
         self.source = "fold"
@@ -204,6 +281,26 @@ class Trace:
         with self._lock:
             self.leader_trace_id = leader_trace_id
 
+    def wire_context(self) -> Optional[TraceContext]:
+        """Mint the context for ONE outbound hop: this trace's id plus
+        a fresh span id the sender tags its rpc/peer-fetch span with
+        (`span_id` attr), so the receiver's continued record can name
+        exactly which sender span it hangs under. One context per hop
+        — two forwards from one trace get two parent span ids. The
+        per-Trace-OBJECT nonce keeps ids unique when one replica
+        continues the SAME trace twice (a failover retry looping back
+        after a restart): each continuation is a fresh Trace whose
+        counter restarts at 0, and two hops both named (origin, "s0")
+        would stitch ambiguously in the fleet aggregator."""
+        with self._lock:
+            if self._finished:
+                return None
+            if self._hop_nonce is None:
+                self._hop_nonce = uuid.uuid4().hex[:4]
+            sid = f"s{next(self._span_seq)}.{self._hop_nonce}"
+        return TraceContext(trace_id=self.trace_id, parent_span_id=sid,
+                            origin=getattr(self._tracer, "origin", ""))
+
     # -- terminal --------------------------------------------------------
 
     @property
@@ -242,6 +339,16 @@ class Trace:
             "spans": list(self._spans),
             "events": list(self._events),
         }
+        origin = getattr(self._tracer, "origin", "")
+        if origin:
+            record["origin"] = origin
+        if self.parent_span_id:
+            # the per-replica hop edge: which sender span (and whose)
+            # this record's segments continue — the fleet aggregator
+            # anchors child offsets at that span, never at wall clocks
+            record["parent_span_id"] = self.parent_span_id
+            if self.parent_origin:
+                record["parent_origin"] = self.parent_origin
         if self.leader_trace_id is not None:
             record["leader_trace_id"] = self.leader_trace_id
         if self.error:
@@ -301,8 +408,9 @@ class _MultiSpanContext:
 class _NullTracer:
     __slots__ = ()
     enabled = False
+    origin = ""
 
-    def start_trace(self, request_id):
+    def start_trace(self, request_id, context=None):
         return NULL_TRACE
 
     def slowest(self):
@@ -325,11 +433,23 @@ class Tracer:
         None disables the file sink (the ring still works).
     slow_k: how many slowest completed traces to retain for
         `serve_stats()["traces"]` / `slowest()`.
+    origin: this process's replica id for fleet-wide stitching
+        (ISSUE 15). When set, trace ids become globally unique
+        (origin + a per-boot nonce ride the id), every emitted record
+        carries an `origin` field, and outbound wire contexts name
+        this replica as the hop's sender. "" (the default) is the
+        pre-fleet single-process behavior, byte-for-byte.
     """
 
     enabled = True
 
-    def __init__(self, jsonl_path: Optional[str] = None, slow_k: int = 16):
+    def __init__(self, jsonl_path: Optional[str] = None, slow_k: int = 16,
+                 origin: str = ""):
+        self.origin = str(origin)
+        # per-boot nonce: a RESTARTED replica reuses its origin id but
+        # must never reuse the dead boot's trace ids (its counter
+        # restarts at 0)
+        self._nonce = uuid.uuid4().hex[:6]
         self._lock = threading.Lock()
         self._fh: Optional[IO] = None
         if jsonl_path:
@@ -341,8 +461,20 @@ class Tracer:
         self._slow: list = []           # min-heap of (duration, seq, record)
         self.completed = 0
 
-    def start_trace(self, request_id: str) -> Trace:
-        return Trace(self, request_id)
+    def start_trace(self, request_id: str,
+                    context: Optional[TraceContext] = None) -> Trace:
+        """Start a trace; with `context` (a remote hop's wire headers,
+        decoded by the receiving server) the new trace CONTINUES the
+        sender's — same trace id, and the emitted record names the
+        sender span it hangs under (`parent_span_id`/`parent_origin`)
+        so the fleet aggregator can stitch the two halves into one
+        waterfall."""
+        t = Trace(self, request_id)
+        if context is not None:
+            t.trace_id = context.trace_id
+            t.parent_span_id = context.parent_span_id or None
+            t.parent_origin = context.origin
+        return t
 
     def _on_finish(self, record: dict):
         # serialize OUTSIDE the lock: finish() runs on the serving

@@ -279,16 +279,19 @@ class FeaturePool:
 
     # -- submission ------------------------------------------------------
 
-    def submit_raw(self, raw: RawFoldRequest, scheduler) -> FoldTicket:
+    def submit_raw(self, raw: RawFoldRequest, scheduler,
+                   trace=None) -> FoldTicket:
         """Accept one raw job; returns the caller's FoldTicket NOW (the
         same ticket type Scheduler.submit returns — result(), progress
         callbacks, and done callbacks all behave identically). The
         pipeline behind it: feature cache -> in-flight coalesce ->
         worker featurize -> scheduler.submit, with the request trace
         carrying a `featurize` span for the first two stages' miss
-        path."""
+        path. `trace`: an already-started obs.Trace to continue (a
+        remote hop's continued trace, ISSUE 15); None mints one."""
         ticket = FoldTicket(raw.request_id)
-        trace = scheduler.tracer.start_trace(raw.request_id)
+        if trace is None:
+            trace = scheduler.tracer.start_trace(raw.request_id)
         t0 = time.monotonic()
         with self._lock:
             self.submissions += 1
@@ -629,11 +632,19 @@ class PipelineScheduler:
 
     # -- passthrough surface ---------------------------------------------
 
-    def submit(self, request: FoldRequest) -> FoldTicket:
-        return self.scheduler.submit(request)
+    @property
+    def tracer(self):
+        """The scheduler's tracer — a FrontDoorServer fronting this
+        object continues inbound trace contexts through it (ISSUE 15),
+        for tokenized submits exactly like raw ones."""
+        return self.scheduler.tracer
 
-    def submit_raw(self, raw: RawFoldRequest) -> FoldTicket:
-        return self.feature_pool.submit_raw(raw, self.scheduler)
+    def submit(self, request: FoldRequest, trace=None) -> FoldTicket:
+        return self.scheduler.submit(request, trace=trace)
+
+    def submit_raw(self, raw: RawFoldRequest, trace=None) -> FoldTicket:
+        return self.feature_pool.submit_raw(raw, self.scheduler,
+                                            trace=trace)
 
     def warmup(self, *args, **kwargs) -> int:
         return self.scheduler.warmup(*args, **kwargs)

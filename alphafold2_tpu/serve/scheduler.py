@@ -337,6 +337,14 @@ class Scheduler:
         kernel choice is an ExecKey element, so a policy flip can
         never serve a stale executable, and warmup() pre-compiles each
         bucket's chosen kernel.
+    slo: optional obs.slo.SLOEngine (OFF when None — the default,
+        which keeps serve_stats() keys and the registry metric-name
+        set byte-identical). Declarative per-QoS-class objectives
+        (latency percentile targets per bucket, availability over
+        terminal statuses) computed as windowed error budgets + burn
+        rates from the registry's own histograms/counters;
+        serve_stats()["slo"] carries the report and slo_* gauges ride
+        every /metrics scrape (ISSUE 15).
     """
 
     def __init__(self, executor: FoldExecutor, buckets: BucketPolicy,
@@ -353,8 +361,17 @@ class Scheduler:
                  mesh_policy: Optional[MeshPolicy] = None,
                  recycle_policy: Optional[RecyclePolicy] = None,
                  feature_pool=None,
-                 kernel_policy=None):
+                 kernel_policy=None,
+                 slo=None):
         self.executor = executor
+        # optional obs.slo.SLOEngine (OFF when None — the default,
+        # which keeps serve_stats() and the registry's metric-name set
+        # byte-identical): declarative per-QoS-class latency/
+        # availability objectives computed over the registry's own
+        # histograms/counters, reported as serve_stats()["slo"] and
+        # exported as slo_* gauges — the signal surface the future
+        # autoscaler (and /metrics scrapes) consume (ISSUE 15)
+        self.slo = slo
         # two-stage pipeline front (serve.features.FeaturePool — OFF
         # when None, the default, which keeps submit_raw featurizing
         # inline and serve_stats() byte-for-byte today's)
@@ -967,7 +984,7 @@ class Scheduler:
         self.metrics.record_enqueued(depth)
         return entry.ticket
 
-    def submit_raw(self, raw) -> FoldTicket:
+    def submit_raw(self, raw, trace=None) -> FoldTicket:
         """Accept one RAW job (serve.features.RawFoldRequest: an AA
         string or untokenized array plus raw MSA). With a
         `feature_pool` attached, featurization runs off the hot path on
@@ -978,15 +995,19 @@ class Scheduler:
         goes through the ordinary submit() — exactly what callers
         hand-rolled before this method existed, so the off switch is
         byte-for-byte today's behavior. Returns the same FoldTicket
-        either way."""
+        either way.
+
+        trace: an already-started obs.Trace to continue (the front
+        door passes a remote hop's continued trace, ISSUE 15); None —
+        the default — mints one exactly as before."""
         from alphafold2_tpu.serve.features import featurize_raw
         if self.feature_pool is not None:
-            return self.feature_pool.submit_raw(raw, self)
+            return self.feature_pool.submit_raw(raw, self, trace=trace)
         feats = featurize_raw(raw)
         return self.submit(FoldRequest(
             seq=feats.seq, msa=feats.msa, request_id=raw.request_id,
             priority=raw.priority, deadline_s=raw.deadline_s,
-            forwarded=raw.forwarded))
+            forwarded=raw.forwarded), trace=trace)
 
     # -- cache / coalescing ----------------------------------------------
 
@@ -1560,6 +1581,13 @@ class Scheduler:
                                    folds=folds)
         if self.feature_pool is not None:
             stats["featurize"] = self.feature_pool.snapshot()
+        if self.slo is not None:
+            # report() also refreshes the slo_* gauges, so a stats
+            # poll and a Prometheus scrape read the same window
+            try:
+                stats["slo"] = self.slo.report()
+            except Exception as exc:      # obs must never fail stats
+                stats["slo"] = {"error": repr(exc)}
         with self._cond:
             stats["running"] = self._running
             stats["draining"] = self._draining

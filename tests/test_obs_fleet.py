@@ -1,0 +1,798 @@
+"""Fleet-wide observability tests (ISSUE 15): cross-process trace
+propagation (TraceContext on the wire, origin-tagged tracers, continued
+traces) across all four hop types over real HTTP — forward, raw
+feature-key forward, peer-cache fetch, transport-death failover — plus
+the SLO engine unit suite (budget math, burn-rate windows, class
+mapping), the `/metrics` exposition endpoints, the STAGE_ORDER drift
+tripwire, and the tools/obs_fleet.py stitch checker.
+
+The HTTP tier is stub-executor + localhost servers (the
+test_frontdoor.py convention) — no model, no processes; serve_smoke.sh
+phase 14 runs the full 3-process version of the same story.
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from alphafold2_tpu import fleet
+from alphafold2_tpu.cache import FoldCache, fold_key
+from alphafold2_tpu.cache.keys import feature_key
+from alphafold2_tpu.fleet.frontdoor import FrontDoorServer
+from alphafold2_tpu.fleet.peer import PeerCacheClient, PeerCacheServer
+from alphafold2_tpu.fleet.rpc import HttpTransport
+from alphafold2_tpu.obs.registry import MetricsRegistry
+from alphafold2_tpu.obs.slo import (SLOClass, SLOEngine, SLOPolicy,
+                                    burn_rate, evaluate_class,
+                                    quantize_target)
+from alphafold2_tpu.obs.trace import NULL_TRACE, TraceContext, Tracer
+from alphafold2_tpu.obs.export import prometheus_text
+from alphafold2_tpu.serve import (BucketPolicy, FeaturePool, FoldRequest,
+                                  RawFoldRequest, Scheduler,
+                                  SchedulerConfig)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MSA_DEPTH = 3
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+obs_report = _load_tool("obs_report")
+obs_fleet = _load_tool("obs_fleet")
+
+
+class _OkExecutor:
+    """Deterministic stub; optional gate Event blocks every run until
+    set (the mid-fold owner-death window)."""
+
+    def __init__(self, gate=None):
+        self.gate = gate
+        self.calls = 0
+
+    def run(self, batch, num_recycles, trace=None):
+        if self.gate is not None:
+            self.gate.wait(timeout=30)
+        self.calls += 1
+        b, n = batch["seq"].shape
+        if trace is not None:
+            # the real FoldExecutor records the fold span; the stub
+            # must too or check_traces' accelerator rule fires
+            with trace.span("fold"):
+                time.sleep(0.001)
+
+        class R:
+            coords = np.zeros((b, n, 3), np.float32)
+            confidence = np.full((b, n), 0.5, np.float32)
+
+        return R()
+
+    def stats(self):
+        return {"calls": self.calls}
+
+
+def _request(seed=0, n=12, **kwargs):
+    rng = np.random.default_rng(seed)
+    return FoldRequest(
+        seq=rng.integers(0, 20, size=n).astype(np.int32),
+        msa=rng.integers(0, 20, size=(MSA_DEPTH, n)).astype(np.int32),
+        **kwargs)
+
+
+def _scheduler(tracer, executor=None, **kwargs):
+    return Scheduler(
+        executor or _OkExecutor(), BucketPolicy((16,)),
+        SchedulerConfig(max_batch_size=2, max_wait_ms=10.0, poll_ms=2.0,
+                        msa_depth=MSA_DEPTH),
+        model_tag="v1", registry=MetricsRegistry(), tracer=tracer,
+        **kwargs)
+
+
+# -- TraceContext wire format --------------------------------------------
+
+
+@pytest.mark.quick
+class TestTraceContext:
+    def test_header_roundtrip(self):
+        ctx = TraceContext("t1.r0.abc", "s3", origin="r0")
+        back = TraceContext.from_headers(ctx.to_headers())
+        assert back == ctx
+
+    def test_originless_context_omits_origin_header(self):
+        ctx = TraceContext("t1", "s0")
+        h = ctx.to_headers()
+        assert "X-Trace-Origin" not in h
+        assert TraceContext.from_headers(h) == ctx
+
+    def test_absent_headers_decode_none(self):
+        assert TraceContext.from_headers({}) is None
+        assert TraceContext.from_headers({"X-Other": "1"}) is None
+
+    def test_null_trace_has_no_wire_context(self):
+        assert NULL_TRACE.wire_context() is None
+
+
+class TestTracerOrigin:
+    def test_origin_makes_ids_unique_across_boots(self):
+        a = Tracer(origin="r0")
+        b = Tracer(origin="r0")   # same replica id, new boot
+        ta, tb = a.start_trace("x"), b.start_trace("x")
+        assert ta.trace_id != tb.trace_id
+        assert "r0" in ta.trace_id
+
+    def test_originless_tracer_keeps_compact_ids(self):
+        t = Tracer().start_trace("x")
+        assert t.trace_id.startswith("t") and "." not in t.trace_id
+
+    def test_record_carries_origin_and_parent_fields(self, tmp_path):
+        sender = Tracer(origin="r0")
+        receiver = Tracer(origin="r1")
+        t0 = sender.start_trace("req")
+        ctx = t0.wire_context()
+        assert ctx.trace_id == t0.trace_id and ctx.origin == "r0"
+        t1 = receiver.start_trace("req", context=ctx)
+        assert t1.trace_id == t0.trace_id
+        t1.finish("ok")
+        rec = receiver.slowest()[0]
+        assert rec["origin"] == "r1"
+        assert rec["parent_span_id"] == ctx.parent_span_id
+        assert rec["parent_origin"] == "r0"
+        t0.finish("ok")
+        rec0 = sender.slowest()[0]
+        assert rec0["origin"] == "r0"
+        assert "parent_span_id" not in rec0
+
+    def test_wire_context_mints_fresh_span_ids(self):
+        t = Tracer(origin="r0").start_trace("x")
+        a, b = t.wire_context(), t.wire_context()
+        assert a.parent_span_id != b.parent_span_id
+        t.finish("ok")
+        assert t.wire_context() is None
+
+
+# -- SLO policy / engine -------------------------------------------------
+
+
+@pytest.mark.quick
+class TestSLOPolicy:
+    def test_parse_buckets_and_all(self):
+        pol = SLOPolicy.parse("32=400,all=2000", window_s=60)
+        assert pol.window_s == 60
+        by_name = {c.name: c for c in pol.classes}
+        assert by_name["bucket32"].buckets == (32,)
+        assert by_name["bucket32"].target_s == pytest.approx(0.4)
+        assert by_name["all"].buckets == ()
+        assert by_name["all"].covers(32) and by_name["all"].covers(64)
+        assert not by_name["bucket32"].covers(64)
+
+    def test_parse_auto_target(self):
+        pol = SLOPolicy.parse("32=auto")
+        assert pol.classes[0].target_s is None
+        # availability objective still stands — the engine accepts it
+        SLOEngine(pol, registry=MetricsRegistry())
+
+    @pytest.mark.parametrize("bad", ["32", "foo=100", "32=slow",
+                                     "", ","])
+    def test_bad_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            SLOPolicy.parse(bad)
+
+    def test_class_validation(self):
+        with pytest.raises(ValueError):
+            SLOClass(name="", target_s=1.0)
+        with pytest.raises(ValueError):
+            SLOClass(name="x", target_s=-1.0)
+        with pytest.raises(ValueError):
+            SLOClass(name="x", target_s=1.0, percentile=0.0)
+        with pytest.raises(ValueError):
+            SLOPolicy(classes=[SLOClass("a", 1.0), SLOClass("a", 2.0)])
+
+    def test_quantize_target_picks_nearest_edge(self):
+        edges = (0.128, 0.256, 0.512, 1.024)
+        assert quantize_target(0.5, edges) == 0.512
+        assert quantize_target(0.3, edges) == 0.256
+
+    def test_burn_rate_math(self):
+        assert burn_rate(0.0, 0.01) == 0.0
+        assert burn_rate(0.01, 0.01) == pytest.approx(1.0)
+        assert burn_rate(0.05, 0.01) == pytest.approx(5.0)
+        assert burn_rate(0.5, 0.0) >= 1e9   # zero-allowance objective
+
+
+class TestSLOEngine:
+    def _rig(self, spec="32=500", window_s=10.0):
+        reg = MetricsRegistry()
+        hist = reg.histogram("serve_request_latency_seconds", "",
+                             ("bucket_len",))
+        out = reg.counter("serve_requests_total", "", ("outcome",))
+        clock = [0.0]
+        engine = SLOEngine(SLOPolicy.parse(spec, window_s=window_s),
+                           registry=reg, clock=lambda: clock[0])
+        return reg, hist, out, clock, engine
+
+    def test_budget_math_exact_burn(self):
+        reg, hist, out, clock, engine = self._rig()
+        for _ in range(99):
+            hist.observe(0.01, bucket_len=32)
+        hist.observe(10.0, bucket_len=32)     # 1/100 over target
+        out.inc(100, outcome="served")
+        clock[0] = 1.0
+        rep = engine.report()
+        lat = rep["classes"]["bucket32"]["latency"]
+        assert rep["classes"]["bucket32"]["requests"] == 100
+        assert lat["attainment"] == pytest.approx(0.99)
+        assert lat["burn_rate"] == pytest.approx(1.0)
+        assert lat["budget_remaining"] == pytest.approx(0.0)
+        assert lat["met"]   # p99 at exactly 99% within target
+
+    def test_burn_rate_window_rolls_off(self):
+        reg, hist, out, clock, engine = self._rig(window_s=10.0)
+        hist.observe(10.0, bucket_len=32)      # every request slow
+        out.inc(1, outcome="served")
+        clock[0] = 1.0
+        rep = engine.report()
+        assert rep["classes"]["bucket32"]["latency"]["burn_rate"] > 1.0
+        # 20s later with no new traffic the bad window has rolled off
+        clock[0] = 20.0
+        engine.report()
+        clock[0] = 21.0
+        rep2 = engine.report()
+        assert rep2["classes"]["bucket32"]["requests"] == 0
+        assert rep2["classes"]["bucket32"]["latency"]["burn_rate"] == 0.0
+
+    def test_class_bucket_mapping(self):
+        reg, hist, out, clock, engine = self._rig(
+            spec="32=500,all=500")
+        # bucket 64 traffic is slow; bucket 32 traffic is fast
+        for _ in range(10):
+            hist.observe(0.01, bucket_len=32)
+            hist.observe(10.0, bucket_len=64)
+        clock[0] = 1.0
+        rep = engine.report()
+        b32 = rep["classes"]["bucket32"]["latency"]
+        allc = rep["classes"]["all"]["latency"]
+        assert b32["attainment"] == pytest.approx(1.0)
+        assert allc["attainment"] == pytest.approx(0.5)
+
+    def test_availability_counts_bad_statuses(self):
+        reg, hist, out, clock, engine = self._rig()
+        out.inc(98, outcome="served")
+        out.inc(1, outcome="error")
+        out.inc(1, outcome="shed")   # not in DEFAULT_BAD_STATUSES
+        clock[0] = 1.0
+        rep = engine.report()
+        avail = rep["classes"]["bucket32"]["availability"]
+        assert avail["bad"] == 1
+        assert avail["observed"] == pytest.approx(0.99)
+        assert avail["burn_rate"] == pytest.approx(1.0)
+
+    def test_gauges_land_in_exposition(self):
+        reg, hist, out, clock, engine = self._rig()
+        hist.observe(0.01, bucket_len=32)
+        out.inc(1, outcome="served")
+        clock[0] = 1.0
+        engine.report()
+        text = prometheus_text(reg)
+        for name in ("slo_latency_attainment", "slo_latency_burn_rate",
+                     "slo_error_budget_remaining", "slo_availability"):
+            assert f'{name}{{objective="bucket32"}}' in text
+        assert obs_report.check_prometheus_text(text) == []
+
+    def test_availability_only_class(self):
+        reg = MetricsRegistry()
+        out = reg.counter("serve_requests_total", "", ("outcome",))
+        engine = SLOEngine(
+            SLOPolicy(classes=[SLOClass("av", target_s=None,
+                                        availability=0.9)],
+                      window_s=10.0),
+            registry=reg, clock=lambda: 1.0)
+        out.inc(1, outcome="error")
+        rep = engine.report(now=2.0)
+        assert "latency" not in rep["classes"]["av"]
+        assert not rep["classes"]["av"]["availability"]["met"]
+
+
+class TestSchedulerSLO:
+    def test_serve_stats_slo_block(self):
+        reg = MetricsRegistry()
+        from alphafold2_tpu.serve.metrics import ServeMetrics
+        engine = SLOEngine(SLOPolicy.parse("16=60000", window_s=60),
+                           registry=reg)
+        sched = Scheduler(
+            _OkExecutor(), BucketPolicy((16,)),
+            SchedulerConfig(max_batch_size=2, max_wait_ms=5.0,
+                            poll_ms=2.0, msa_depth=MSA_DEPTH),
+            metrics=ServeMetrics(registry=reg), registry=reg,
+            slo=engine)
+        with sched:
+            assert sched.submit(_request()).result(timeout=30).ok
+        stats = sched.serve_stats()
+        cls = stats["slo"]["classes"]["bucket16"]
+        assert cls["requests"] >= 1
+        assert cls["latency"]["met"] and cls["ok"]
+
+    def test_off_by_default_no_slo_keys_or_metrics(self):
+        reg = MetricsRegistry()
+        from alphafold2_tpu.serve.metrics import ServeMetrics
+        sched = Scheduler(
+            _OkExecutor(), BucketPolicy((16,)),
+            SchedulerConfig(max_batch_size=2, max_wait_ms=5.0,
+                            poll_ms=2.0, msa_depth=MSA_DEPTH),
+            metrics=ServeMetrics(registry=reg), registry=reg)
+        with sched:
+            assert sched.submit(_request()).result(timeout=30).ok
+        stats = sched.serve_stats()
+        assert "slo" not in stats
+        assert not [m.name for m in reg.metrics()
+                    if m.name.startswith("slo_")]
+
+
+# -- /metrics endpoints --------------------------------------------------
+
+
+class TestMetricsEndpoints:
+    def test_frontdoor_metrics_parses(self):
+        import urllib.request
+
+        reg = MetricsRegistry()
+        reg.counter("demo_total", "demo").inc(3)
+        sched = _scheduler(Tracer())
+        server = FrontDoorServer(sched, replica_id="r0", metrics=reg)
+        hook_calls = []
+        server.metrics_hook = lambda: hook_calls.append(1)
+        with sched, server:
+            with urllib.request.urlopen(server.url + "/metrics",
+                                        timeout=5) as resp:
+                assert resp.status == 200
+                assert "text/plain" in resp.headers["Content-Type"]
+                text = resp.read().decode("utf-8")
+        assert "demo_total 3" in text
+        assert "fleet_rpc_served_total" in text
+        assert hook_calls == [1]
+        assert obs_report.check_prometheus_text(text) == []
+
+    def test_peer_server_metrics_parses(self):
+        import urllib.request
+
+        reg = MetricsRegistry()
+        reg.counter("demo_total", "demo").inc(1)
+        cache = FoldCache(registry=MetricsRegistry())
+        partition = threading.Event()
+        server = PeerCacheServer(cache, replica_id="r1", metrics=reg,
+                                 partition=partition)
+        with server:
+            host, port = server.address
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics", timeout=5) as resp:
+                assert resp.status == 200
+                text = resp.read().decode("utf-8")
+            # the scrape survives an induced partition (control plane,
+            # same rule as the front door): the chaos window is when
+            # the numbers matter
+            partition.set()
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics", timeout=5) as resp:
+                assert resp.status == 200
+        assert "fleet_peer_served_total" in text
+        assert obs_report.check_prometheus_text(text) == []
+
+    def test_pipeline_scheduler_passes_trace_through(self):
+        from alphafold2_tpu.serve import PipelineScheduler
+
+        pool = FeaturePool(workers=1, registry=MetricsRegistry())
+        tracer = Tracer(origin="r0")
+        sched = _scheduler(tracer, feature_pool=pool)
+        pipe = PipelineScheduler(sched, pool)
+        assert pipe.tracer is tracer
+        with pipe:
+            ctx = Tracer(origin="driver").start_trace(
+                "x").wire_context()
+            trace = pipe.tracer.start_trace("x", context=ctx)
+            resp = pipe.submit(_request(), trace=trace).result(
+                timeout=30)
+        assert resp.ok
+        rec = tracer.slowest()[0]
+        assert rec["trace_id"] == ctx.trace_id
+        assert rec["parent_span_id"] == ctx.parent_span_id
+
+
+# -- STAGE_ORDER drift tripwire ------------------------------------------
+
+
+@pytest.mark.quick
+class TestStageOrderTripwire:
+    def _rec(self, span_name):
+        return {"schema": 1, "trace_id": "t0", "request_id": "r",
+                "status": "ok", "source": "cache", "duration_s": 1.0,
+                "spans": [{"name": span_name, "start_s": 0.0,
+                           "dur_s": 0.5}], "events": []}
+
+    def test_unknown_span_name_is_flagged(self):
+        problems = obs_report.check_stage_order(
+            [self._rec("totally_new_stage")])
+        assert len(problems) == 1
+        assert "totally_new_stage" in problems[0]
+        assert "STAGE_ORDER" in problems[0]
+
+    def test_known_names_pass(self):
+        recs = [self._rec(name) for name in obs_report.STAGE_ORDER]
+        assert obs_report.check_stage_order(recs) == []
+
+    def test_peer_serve_is_canonical(self):
+        assert "peer_serve" in obs_report.STAGE_ORDER
+
+
+# -- obs_fleet stitch checker (synthetic records) ------------------------
+
+
+def _parent_rec(outcome="ok", span_id="s0", auto_closed=False,
+                origin="r0"):
+    attrs = {"peer": "http://x", "route": "submit", "outcome": outcome,
+             "span_id": span_id}
+    if auto_closed:
+        attrs = {"auto_closed": True}
+    return {"schema": 1, "trace_id": "T1", "request_id": "req",
+            "status": "ok", "source": "forwarded", "origin": origin,
+            "duration_s": 1.0, "start_unix_s": 1.0,
+            "spans": [{"name": "rpc", "start_s": 0.1, "dur_s": 0.8,
+                       "attrs": attrs}],
+            "events": []}
+
+
+def _child_rec(parent="s0", origin="r1"):
+    return {"schema": 1, "trace_id": "T1", "request_id": "req",
+            "status": "ok", "source": "fold", "origin": origin,
+            "duration_s": 0.5, "start_unix_s": 1.2,
+            "parent_span_id": parent, "parent_origin": "r0",
+            "spans": [{"name": "fold", "start_s": 0.0, "dur_s": 0.4}],
+            "events": []}
+
+
+class TestObsFleetChecker:
+    def test_complete_stitch_is_clean(self):
+        st = obs_fleet.stitch([_parent_rec(), _child_rec()])
+        assert obs_fleet.check_stitches(st) == []
+        stitched = [s for s in st.values() if s.hops > 1]
+        assert len(stitched) == 1
+        assert stitched[0].origins == ["r0", "r1"]
+
+    def test_broken_stitch_flagged(self):
+        st = obs_fleet.stitch([_parent_rec()])   # armed hop, no child
+        problems = obs_fleet.check_stitches(st)
+        assert len(problems) == 1 and "BROKEN STITCH" in problems[0]
+
+    def test_transport_death_hop_requires_no_child(self):
+        st = obs_fleet.stitch([_parent_rec(outcome="transport_death")])
+        assert obs_fleet.check_stitches(st) == []
+
+    def test_auto_closed_rpc_span_flagged(self):
+        st = obs_fleet.stitch([_parent_rec(auto_closed=True)])
+        problems = obs_fleet.check_stitches(st)
+        assert len(problems) == 1 and "left open" in problems[0]
+
+    def test_unanchored_child_warns_but_does_not_fail(self):
+        # a kill -9 tears exactly this way: the dead sender's record
+        # never flushed but the owner's continued record did — the
+        # chaos the fleet survives must not fail its own tripwire
+        st = obs_fleet.stitch([_child_rec(parent="s99")])
+        assert obs_fleet.check_stitches(st) == []
+        warnings = obs_fleet.unanchored_warnings(st)
+        assert len(warnings) == 1 and "torn" in warnings[0]
+        assert obs_fleet.summarize(st, [_child_rec(parent="s99")])[
+            "unanchored_records"] == 1
+
+    def test_span_ids_disambiguate_by_origin(self):
+        # a 3-hop chain where BOTH hops mint "s0": each process's
+        # continued trace has its own span-id sequence, so the child
+        # must attach via (parent_origin, span_id), never span_id
+        # alone
+        driver = _parent_rec(span_id="s0", origin="driver")
+        mid = _child_rec(parent="s0", origin="r0")
+        mid["parent_origin"] = "driver"
+        mid["source"] = "forwarded"
+        mid["spans"].append(
+            {"name": "rpc", "start_s": 0.05, "dur_s": 0.3,
+             "attrs": {"peer": "http://r1", "route": "submit",
+                       "outcome": "ok", "span_id": "s0"}})
+        leaf = _child_rec(parent="s0", origin="r1")
+        leaf["parent_origin"] = "r0"
+        st = obs_fleet.stitch([driver, mid, leaf])
+        assert obs_fleet.check_stitches(st) == []
+        tr = list(st.values())[0]
+        assert tr.children_of[("driver", "s0")] == [mid]
+        assert tr.children_of[("r0", "s0")] == [leaf]
+        text = "\n".join(obs_fleet.render_stitched(tr))
+        # the leaf renders exactly once, nested under r0
+        assert text.count("[r1]") == 1
+
+    def test_wrong_origin_parent_is_a_broken_stitch(self):
+        parent = _parent_rec(span_id="s0", origin="r0")
+        child = _child_rec(parent="s0", origin="r1")
+        child["parent_origin"] = "r9"    # continues SOMEONE ELSE's s0
+        st = obs_fleet.stitch([parent, child])
+        problems = obs_fleet.check_stitches(st)
+        # r0's armed hop has no child (hard failure); the stray child
+        # itself is only an unanchored warning
+        assert len(problems) == 1 and "BROKEN STITCH" in problems[0]
+        assert len(obs_fleet.unanchored_warnings(st)) == 1
+
+    def test_merge_dedupes_identical_records(self, tmp_path):
+        path = tmp_path / "a.jsonl"
+        rec = _parent_rec()
+        path.write_text(json.dumps(rec) + "\n")
+        records, problems = obs_fleet.load_all_traces(
+            [str(path), str(path)])
+        assert len(records) == 1 and problems == []
+
+    def test_render_stitched_anchors_child_at_parent_span(self):
+        st = obs_fleet.stitch([_parent_rec(), _child_rec()])
+        stitched = [s for s in st.values() if s.hops > 1][0]
+        text = "\n".join(obs_fleet.render_stitched(stitched))
+        assert "[r0]" in text and "[r1]" in text
+        # child fold span renders at rpc start (0.1) + own offset (0.0)
+        assert "0.1000s +0.4000s  fold" in text
+
+    def test_prometheus_parse_and_slo_table(self):
+        reg = MetricsRegistry()
+        reg.gauge("slo_latency_burn_rate", "", ("objective",)).set(
+            2.5, objective="bucket32")
+        text = prometheus_text(reg)
+        parsed = obs_fleet.parse_prometheus(text)
+        assert parsed["slo_latency_burn_rate"][0] == (
+            {"objective": "bucket32"}, 2.5)
+        table = obs_fleet.slo_gauge_table({"r0.prom": text})
+        assert table["bucket32"]["r0.prom"]["latency_burn_rate"] == 2.5
+
+
+# -- the four hop types over real HTTP -----------------------------------
+
+
+class _Rig:
+    """Two replicas: r1 behind a FrontDoorServer (+ optional peer
+    cache server), r0 routing to it via HttpTransport — each with an
+    origin-tagged tracer writing JSONL into tmp_path."""
+
+    def __init__(self, tmp_path, executor1=None, r0_kwargs=None,
+                 transport_kwargs=None):
+        self.tmp = str(tmp_path)
+        self.tracer0 = Tracer(
+            jsonl_path=os.path.join(self.tmp, "r0.jsonl"), origin="r0")
+        self.tracer1 = Tracer(
+            jsonl_path=os.path.join(self.tmp, "r1.jsonl"), origin="r1")
+        self.s1 = _scheduler(self.tracer1, executor=executor1)
+        self.fd1 = FrontDoorServer(self.s1, replica_id="r1",
+                                   metrics=MetricsRegistry())
+        self.s1.start()
+        self.fd1.start()
+        self.registry = fleet.ReplicaRegistry(
+            model_tag="v1", registry=MetricsRegistry())
+        self.registry.register("r0")
+        self.transport = HttpTransport(self.fd1.url,
+                                       metrics=MetricsRegistry(),
+                                       **(transport_kwargs or {}))
+        self.registry.register("r1", transport=self.transport)
+        self.router = fleet.ConsistentHashRouter(
+            self.registry, "r0", metrics=MetricsRegistry())
+        self.cache0 = FoldCache(registry=MetricsRegistry())
+        self.s0 = _scheduler(self.tracer0, router=self.router,
+                             cache=self.cache0, **(r0_kwargs or {}))
+        self.s0.start()
+
+    def owned_by_r1(self):
+        for s in range(300):
+            req = _request(seed=s)
+            key = fold_key(req.seq, req.msa, msa_depth=MSA_DEPTH,
+                           num_recycles=self.s0.config.num_recycles,
+                           model_tag="v1")
+            if self.router.owner_for(key) == "r1":
+                return req
+        raise AssertionError("no key owned by r1")
+
+    def close(self):
+        for closer in (self.s0.stop, self.s1.stop, self.fd1.stop,
+                       self.tracer0.close, self.tracer1.close):
+            try:
+                closer()
+            except Exception:
+                pass
+
+    def merged(self):
+        records, problems = obs_fleet.load_all_traces(
+            [os.path.join(self.tmp, "r0.jsonl"),
+             os.path.join(self.tmp, "r1.jsonl")])
+        assert problems == []
+        return records
+
+
+def _assert_one_stitched(records, hops=2):
+    stitched = obs_fleet.stitch(records)
+    assert obs_fleet.check_stitches(stitched) == []
+    assert obs_report.check_traces(records) == []
+    assert obs_report.check_stage_order(records) == []
+    multi = [st for st in stitched.values() if st.hops > 1]
+    assert len(multi) == 1
+    assert multi[0].hops == hops
+    return multi[0]
+
+
+class TestHttpStitching:
+    def test_forward_hop_stitches(self, tmp_path):
+        rig = _Rig(tmp_path)
+        try:
+            req = rig.owned_by_r1()
+            resp = rig.s0.submit(req).result(timeout=30)
+            assert resp.ok and resp.source == "forwarded"
+        finally:
+            rig.close()
+        st = _assert_one_stitched(rig.merged())
+        assert st.origins == ["r0", "r1"]
+        root = st.roots[0]
+        assert root["origin"] == "r0"
+        rpc = [s for s in root["spans"] if s["name"] == "rpc"]
+        assert rpc and rpc[0]["attrs"]["outcome"] == "ok"
+        child = st.children_of[("r0", rpc[0]["attrs"]["span_id"])][0]
+        assert child["origin"] == "r1"
+        assert any(s["name"] == "fold" for s in child["spans"])
+
+    def test_forward_raw_hop_stitches(self, tmp_path):
+        pool = FeaturePool(workers=1, registry=MetricsRegistry())
+        rig = _Rig(tmp_path, r0_kwargs={"feature_pool": pool})
+        try:
+            raw = None
+            for s in range(300):
+                rng = np.random.default_rng(s)
+                cand = RawFoldRequest(
+                    seq=rng.integers(0, 20, size=12).astype(np.int32),
+                    msa=rng.integers(0, 20,
+                                     size=(MSA_DEPTH, 12)).astype(
+                                         np.int32))
+                key = feature_key(cand.seq, cand.msa,
+                                  config_digest=pool.config_digest)
+                if rig.router.owner_for(key) == "r1":
+                    raw = cand
+                    break
+            assert raw is not None
+            resp = rig.s0.submit_raw(raw).result(timeout=30)
+            assert resp.ok and resp.source == "forwarded"
+            pool.stop()
+        finally:
+            rig.close()
+        st = _assert_one_stitched(rig.merged())
+        root = st.roots[0]
+        rpc = [s for s in root["spans"] if s["name"] == "rpc"]
+        assert rpc and rpc[0]["attrs"]["route"] == "submit_raw"
+        child = st.children_of[("r0", rpc[0]["attrs"]["span_id"])][0]
+        assert child["origin"] == "r1"
+
+    def test_peer_fetch_hop_stitches(self, tmp_path):
+        tracer0 = Tracer(jsonl_path=str(tmp_path / "r0.jsonl"),
+                         origin="r0")
+        tracer1 = Tracer(jsonl_path=str(tmp_path / "r1.jsonl"),
+                         origin="r1")
+        cache1 = FoldCache(registry=MetricsRegistry())
+        server = PeerCacheServer(cache1, replica_id="r1",
+                                 metrics=MetricsRegistry())
+        server.tracer = tracer1
+        server.start()
+        try:
+            registry = fleet.ReplicaRegistry(model_tag="v1",
+                                             registry=MetricsRegistry())
+            registry.register("r0")
+            registry.register("r1", peer_addr=server.address)
+            router = fleet.ConsistentHashRouter(
+                registry, "r0", metrics=MetricsRegistry())
+            client = PeerCacheClient(registry, "r0", router=router,
+                                     metrics=MetricsRegistry())
+            cache0 = FoldCache(registry=MetricsRegistry(), peer=client)
+            key = None
+            for s in range(300):
+                req = _request(seed=s)
+                cand = fold_key(req.seq, req.msa, msa_depth=MSA_DEPTH,
+                                num_recycles=0, model_tag="v1")
+                if router.owner_for(cand) == "r1":
+                    key = cand
+                    break
+            assert key is not None
+            cache1.put(key, np.zeros((12, 3), np.float32),
+                       np.full((12,), 0.5, np.float32))
+            trace = tracer0.start_trace("peer-req")
+            hit = cache0.get(key, trace=trace)
+            assert hit is not None
+            trace.finish("ok", source="cache")
+        finally:
+            server.stop()
+            tracer0.close()
+            tracer1.close()
+        records, problems = obs_fleet.load_all_traces(
+            [str(tmp_path / "r0.jsonl"), str(tmp_path / "r1.jsonl")])
+        assert problems == []
+        st = _assert_one_stitched(records)
+        child = [r for r in st.records if r.get("origin") == "r1"][0]
+        assert any(s["name"] == "peer_serve" for s in child["spans"])
+        root = st.roots[0]
+        ev = [e for e in root["events"] if e["name"] == "peer_fetch"][0]
+        assert ev["attrs"]["outcome"] == "hit"
+        assert child["parent_span_id"] == ev["attrs"]["span_id"]
+
+    def test_failover_resubmit_closes_rpc_span(self, tmp_path):
+        gate = threading.Event()
+        rig = _Rig(tmp_path, executor1=_OkExecutor(gate=gate),
+                   transport_kwargs={"poll_wait_s": 0.2,
+                                     "timeout_s": 1.0})
+        try:
+            req = rig.owned_by_r1()
+            ticket = rig.s0.submit(req)     # forwarded; r1 blocked
+            time.sleep(0.2)
+            rig.fd1.stop()                  # owner dies mid-fold
+            resp = ticket.result(timeout=30)
+            assert resp.ok and resp.source == "fold"   # failover fold
+            assert rig.s0.serve_stats()["failovers"] == 1
+            gate.set()                      # release r1's worker
+            time.sleep(0.2)
+        finally:
+            gate.set()
+            rig.close()
+        records = rig.merged()
+        root = [r for r in records if r.get("origin") == "r0"][0]
+        rpc = [s for s in root["spans"] if s["name"] == "rpc"]
+        assert rpc, "driver-side rpc span missing"
+        attrs = rpc[0]["attrs"]
+        assert attrs["outcome"] == "transport_death"
+        assert "auto_closed" not in attrs
+        # forward span explicitly closed too, then the local refold
+        names = [s["name"] for s in root["spans"]]
+        assert "forward" in names and "fold" in names
+        assert any(e["name"] == "failover_local"
+                   for e in root["events"])
+        # the stitch checker is green: a dead-owner hop promises no
+        # child, and nothing dangles open
+        assert obs_fleet.check_stitches(obs_fleet.stitch(records)) == []
+
+
+# -- driver-side SLO windows (loadtest helper) ---------------------------
+
+
+class TestDriverSloReport:
+    def test_kill_window_burns_after_calibration(self):
+        loadtest = _load_tool("serve_loadtest")
+        args = types.SimpleNamespace(slo="all=auto", slo_window_s=2.0)
+        samples = []
+        # healthy phase: 0-5s, fast
+        for i in range(50):
+            samples.append({"t": i * 0.1, "lat": 0.05, "bucket": 32,
+                            "ok": True})
+        # kill at t=5: affected requests pay the failover penalty
+        for i in range(10):
+            samples.append({"t": 5.2 + i * 0.2, "lat": 1.5,
+                            "bucket": 32, "ok": True})
+        rep = loadtest._driver_slo_report(args, samples,
+                                          {"kill": 5.0}, 5.0)
+        assert rep["samples"] == 60
+        assert rep["classes"]["all"]["target_s"] < 1.0
+        assert rep["kill_window_burn"] > 0
+        # the healthy windows never burned
+        pre_kill = [w for w in rep["windows"] if w["t1"] <= 5.0]
+        assert pre_kill
+        assert all(c["latency_burn"] == 0.0
+                   for w in pre_kill for c in w["classes"].values())
+
+    def test_flag_rot(self):
+        loadtest = _load_tool("serve_loadtest")
+        args = loadtest.parse_args(
+            ["--slo", "32=400,all=auto", "--slo-window-s", "3",
+             "--obs-fleet-out", "/tmp/x", "--procs", "3"])
+        assert args.slo == "32=400,all=auto"
+        assert args.slo_window_s == 3.0
+        assert args.obs_fleet_out == "/tmp/x"

@@ -14,11 +14,12 @@ timing exists for:
 `--check` turns the report into a tripwire (tools/serve_smoke.sh's
 observability phase): exit 1 when any record is missing its schema
 version, any trace is incomplete (no terminal status), any span is an
-orphan (negative timing or escaping its trace's window), or any
-accelerator-served request (`source == "fold"`, status ok) lacks a
-non-zero `fold` span. `--prom FILE` additionally validates that a
-Prometheus text exposition (obs.export.prometheus_text / loadtest
---prom-path) parses.
+orphan (negative timing or escaping its trace's window), any span name
+is absent from STAGE_ORDER (the drift tripwire — a new serving stage
+must be appended to the canonical order), or any accelerator-served
+request (`source == "fold"`, status ok) lacks a non-zero `fold` span.
+`--prom FILE` additionally validates that a Prometheus text exposition
+(obs.export.prometheus_text / loadtest --prom-path) parses.
 
   python tools/obs_report.py /tmp/serve_traces.jsonl
   python tools/obs_report.py /tmp/serve_traces.jsonl --top 10
@@ -69,14 +70,24 @@ from alphafold2_tpu.utils.profiling import percentile  # noqa: E402
 # after a transient mid-loop failure so survivors continue at their
 # checkpointed ages, tagged with the resume-point recycle and the
 # recycles lost) with ISSUE 14 — it sits between the watchdog window
-# it recovers from and writeback.
+# it recovers from and writeback;
+# peer_serve (the serving side of a peer-cache fetch: the owner's
+# continued trace record, stitched under the requester's peer_fetch
+# hop by tools/obs_fleet.py) with ISSUE 15 — the rpc span now also
+# covers the WHOLE forwarded exchange (submit POST through terminal
+# pickup) and carries outcome/span_id attrs the fleet stitcher reads.
 # --check's orphan-span rules apply to all of them unchanged, which is
 # how the chaos smokes prove recovery cost is fully accounted.
+#
+# This tuple is LOAD-BEARING: check_stage_order() below hard-fails
+# --check on any span name absent from it, so adding a span to the
+# serving stack without appending it here trips the very next smoke
+# phase instead of silently rendering at the bottom of the waterfall.
 STAGE_ORDER = ("featurize", "submit", "forward", "rpc", "queue",
                "parked", "retry", "drain", "batch_form", "shard",
                "compile", "fold", "recycle", "admit", "watchdog",
-               "resume", "writeback", "peer_fetch", "cache_lookup",
-               "write")
+               "resume", "writeback", "peer_fetch", "peer_serve",
+               "cache_lookup", "write")
 
 # span/trace boundary slack: start_s, dur_s, and duration_s are each
 # INDEPENDENTLY rounded to 1e-6 when emitted, so a span auto-closed at
@@ -140,6 +151,23 @@ def check_traces(records: List[dict]) -> List[str]:
                 problems.append(f"{where}: served from the accelerator "
                                 "but has no non-zero fold span")
     return problems
+
+
+def check_stage_order(records: List[dict]) -> List[str]:
+    """STAGE_ORDER drift tripwire (ISSUE 15): a span name present in
+    the traces but absent from the canonical order is a HARD failure
+    under --check. Every recent serving feature added a span and
+    hand-appended it to STAGE_ORDER; this makes forgetting impossible
+    — the new span's first smoke run fails here with the exact name to
+    append instead of silently sorting to the waterfall's tail."""
+    known = set(STAGE_ORDER)
+    unknown = sorted({str(span.get("name", "?"))
+                      for rec in records
+                      for span in rec.get("spans", ())} - known)
+    return [f"span name {name!r} is not in STAGE_ORDER — a new serving "
+            f"stage must be appended to tools/obs_report.py's "
+            f"canonical order (and documented there)"
+            for name in unknown]
 
 
 def stage_stats(records: List[dict]) -> dict:
@@ -343,6 +371,7 @@ def main(argv=None) -> int:
     if not records:
         problems.append(f"no trace records in {args.trace_jsonl}")
     problems += check_traces(records)
+    problems += check_stage_order(records)
     if args.prom:
         try:
             with open(args.prom) as fh:

@@ -279,6 +279,29 @@ def parse_args(argv=None):
     ap.add_argument("--prom-path", default="",
                     help="dump the process metrics registry as "
                          "Prometheus text exposition here on exit")
+    ap.add_argument("--slo", default="",
+                    help="SLO objectives (ISSUE 15), the "
+                         "obs.slo.SLOPolicy.parse spec: "
+                         "'CLASS=P99_MS,...' where CLASS is a bucket "
+                         "edge or 'all' and the value is the p99 "
+                         "latency target in ms (or 'auto' — "
+                         "driver-calibrated from the run's own "
+                         "pre-chaos latencies, --procs mode only). "
+                         "With --procs, each replica also runs an "
+                         "SLOEngine (serve_stats()['slo'] + slo_* "
+                         "gauges on GET /metrics) and the driver "
+                         "reports windowed burn rates, kill window "
+                         "included")
+    ap.add_argument("--slo-window-s", type=float, default=5.0,
+                    help="error-budget window for the SLO engine and "
+                         "the driver's burn-rate windows")
+    ap.add_argument("--obs-fleet-out", default="",
+                    help="directory to collect fleet observability "
+                         "artifacts into (--procs mode): one "
+                         "<rid>.prom scrape of each replica's "
+                         "GET /metrics plus the driver's windowed "
+                         "SLO series (slo_driver.json) — the input "
+                         "set tools/obs_fleet.py aggregates")
     ap.add_argument("--platform", default="cpu",
                     choices=("cpu", "ambient"))
     ap.add_argument("--smoke", action="store_true",
@@ -622,6 +645,15 @@ def _build_tiny_model(args, jax, jnp, policy):
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.slo and not args.procs:
+        # an objective that silently monitors nothing is the exact
+        # failure SLOPolicy.parse's fail-loudly contract exists to
+        # prevent — the driver-side SLO harness is --procs-only today
+        print("--slo requires --procs (the SLO harness drives the "
+              "multi-process fleet; in-process modes attach an "
+              "SLOEngine via serve.Scheduler(slo=) directly)",
+              file=sys.stderr)
+        return 2
     if args.cross_bucket or args.eager_form:
         args.continuous = True       # both ride the continuous batcher
     if args.continuous:
@@ -1712,6 +1744,87 @@ def _run_fleet(args) -> int:
     return 0
 
 
+def _driver_slo_report(args, samples, chaos_t, kill_t):
+    """Windowed SLO evaluation over the DRIVER's own observations
+    (--procs mode): per-request completion times + latencies sliced
+    into half-overlapping windows of --slo-window-s, each evaluated
+    with obs.slo's one budget-math implementation. `auto` latency
+    targets calibrate from the run's own healthy requests (completed
+    before the first chaos event): 1.25 x healthy p99 + 0.3 s — above
+    the healthy tail by construction, below the failover penalty the
+    driver's backoff guarantees — so the kill window burns budget
+    against the run's own baseline, not a machine-speed guess."""
+    import dataclasses as _dc
+
+    from alphafold2_tpu.obs.slo import SLOPolicy, evaluate_class
+    from alphafold2_tpu.utils.profiling import percentile as _pct
+
+    policy = SLOPolicy.parse(args.slo, window_s=args.slo_window_s)
+    first_chaos = min(chaos_t.values()) if chaos_t else None
+    healthy = [s for s in samples
+               if first_chaos is None or s["t"] < first_chaos]
+    healthy = healthy or samples
+    classes = []
+    for c in policy.classes:
+        if c.target_s is None:
+            lats = [s["lat"] for s in healthy
+                    if s["ok"] and c.covers(s["bucket"])]
+            lats = lats or [s["lat"] for s in healthy if s["ok"]] \
+                or [0.0]
+            c = _dc.replace(
+                c, target_s=max(0.25, 1.25 * _pct(lats, 99) + 0.3))
+        classes.append(c)
+    t_end = max((s["t"] for s in samples), default=0.0)
+    w = policy.window_s
+    hop = max(w / 2.0, 0.25)
+    windows = []
+    t0 = 0.0
+    while t0 <= t_end:
+        in_w = [s for s in samples if t0 <= s["t"] < t0 + w]
+        per_class = {}
+        for c in classes:
+            sel = [s for s in in_w if c.covers(s["bucket"])]
+            ok = [s for s in sel if s["ok"]]
+            good = sum(1 for s in ok if s["lat"] <= c.target_s)
+            bad = sum(1 for s in sel if not s["ok"])
+            res = evaluate_class(c, good, len(ok), bad, len(sel))
+            per_class[c.name] = {
+                "requests": len(sel),
+                "latency_burn": res["latency"]["burn_rate"],
+                "attainment": res["latency"]["attainment"],
+                "availability_burn":
+                    res.get("availability", {}).get("burn_rate", 0.0),
+            }
+        windows.append({"t0": round(t0, 3), "t1": round(t0 + w, 3),
+                        "classes": per_class})
+        t0 += hop
+
+    def _burn(win):
+        return max((c["latency_burn"] for c in win["classes"].values()),
+                   default=0.0)
+
+    max_burn = max((_burn(win) for win in windows), default=0.0)
+    kill_burn = None
+    if kill_t is not None:
+        kill_burn = max(
+            (_burn(win) for win in windows
+             if win["t1"] > kill_t and win["t0"] < kill_t + 15.0),
+            default=0.0)
+    return {
+        "spec": args.slo,
+        "window_s": w,
+        "classes": {c.name: {"target_s": round(c.target_s, 4),
+                             "percentile": c.percentile,
+                             "buckets": list(c.buckets)}
+                    for c in classes},
+        "samples": len(samples),
+        "windows": windows,
+        "max_burn_rate": max_burn,
+        "kill_t": None if kill_t is None else round(kill_t, 3),
+        "kill_window_burn": kill_burn,
+    }
+
+
 def _run_procs(args) -> int:
     """--procs N: drive a REAL multi-process fleet (fleet.procfleet)
     over HTTP with driver-side failover, inducing the --proc-* chaos
@@ -1756,7 +1869,8 @@ def _run_procs(args) -> int:
             continuous=args.continuous,
             cross_bucket=args.cross_bucket,
             cross_bucket_max_pad_frac=args.cross_bucket_max_pad_frac,
-            eager_form=args.eager_form)))
+            eager_form=args.eager_form)),
+        slo=args.slo, slo_window_s=args.slo_window_s)
     print(f"procfleet: starting {n} replica processes under {run_dir}",
           file=sys.stderr)
     try:
@@ -1791,11 +1905,31 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
             os.remove(driver_trace_path)
         except OSError:
             pass
+        # origin-tagged (ISSUE 15): the driver's records merge into
+        # the fleet set and its submits carry trace contexts the
+        # replicas' continued traces stitch under
         tracer = obs.Tracer(jsonl_path=driver_trace_path,
-                            slow_k=args.trace_slow_k)
+                            slow_k=args.trace_slow_k, origin="driver")
+    client_retry = None
+    if args.slo:
+        # a deliberately heavy failover backoff: a request that hits
+        # the killed replica pays >= backoff_base_s on top of its
+        # refold, putting it decisively past the auto-calibrated
+        # latency target — the kill window's burn rate is then a
+        # guaranteed signal, not a timing coin-flip
+        client_retry = serve.RetryPolicy(
+            max_attempts=4, backoff_base_s=0.75, backoff_max_s=1.5)
     client = FleetClient(
         [h.frontdoor_url for h in fleet.replicas],
+        retry=client_retry,
         result_timeout_s=180.0)
+    if args.buckets:
+        bucket_edges = tuple(int(x) for x in args.buckets.split(",")
+                             if x)
+    else:
+        bucket_edges = tuple(serve.BucketPolicy.powers_of_two(
+            min(lengths), max(max(lengths), min(lengths))).edges)
+    bucketer = serve.BucketPolicy(bucket_edges)
 
     pool = synthetic_requests(
         jax.random.PRNGKey(1), num=max(args.requests, 64),
@@ -1829,6 +1963,13 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
     burst_box = {"tickets": [], "transport": None}
     drain_rc = [None]
     rolled = {"tag": None}    # set once the fleet-wide rollout fired
+    # driver-side SLO evidence (ISSUE 15): per-request completion time
+    # (relative to serving start) + latency + native bucket + outcome,
+    # and when each chaos verb actually fired — the offline windowed
+    # burn-rate evaluation slices these
+    run_t0 = [0.0]
+    slo_samples = []
+    chaos_t = {}
 
     def _note(event, **kw):
         with events_lock:
@@ -1839,6 +1980,8 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
             if name in fired:
                 return
             fired.add(name)
+        chaos_t.setdefault(name,
+                           time.monotonic() - run_t0[0])
         fn(i)
 
     def _reannounce(index):
@@ -1921,10 +2064,31 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
                                     deadline_s=deadline_s)
             trace = (tracer.start_trace(req.request_id) if tracer
                      else NULL_TRACE)
+            t_submit = time.monotonic()
+            # an over-length request (no bucket admits it) still gets a
+            # sample — attributed to its raw length, which only the
+            # bucketless "all" class covers; bucket_for raising here
+            # would kill the submitter thread from inside the very
+            # except handler that records failures
+            try:
+                req_bucket = bucketer.bucket_for(req.length)
+            except ValueError:
+                req_bucket = req.length
+
+            def _sample(ok):
+                now = time.monotonic()
+                with lock:
+                    slo_samples.append(
+                        {"t": now - run_t0[0],
+                         "lat": now - t_submit,
+                         "bucket": req_bucket,
+                         "ok": ok})
+
             try:
                 resp = client.fold(req, hint=i % n, trace=trace)
             except Exception as exc:
                 trace.finish("error", error=repr(exc))
+                _sample(False)
                 with lock:
                     failures.append(repr(exc))
                 continue
@@ -1932,6 +2096,7 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
             # so obs_report's fold-span rule applies to replica traces
             trace.finish(resp.status, source="forwarded",
                          error=resp.error)
+            _sample(bool(resp.ok))
             with lock:
                 statuses[resp.status] = statuses.get(resp.status, 0) + 1
             if not resp.ok:
@@ -1945,6 +2110,7 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
                         f"n={req.length}")
 
     t0 = time.monotonic()
+    run_t0[0] = t0
     threads = [threading.Thread(target=run_submitter, daemon=True)
                for _ in range(max(args.concurrency, 1))]
     for t in threads:
@@ -2004,7 +2170,31 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
             "drains": snap.get("drains"),
             "errors": snap.get("errors"),
             "rollout": extra.get("rollout"),
+            # the replica-side SLO engine's view (ISSUE 15): which
+            # classes it reports and whether each met its objectives
+            "slo": (None if "slo" not in snap else {
+                name: cls.get("ok")
+                for name, cls in snap["slo"].get("classes",
+                                                 {}).items()}),
         }
+    # fleet observability artifacts (ISSUE 15): scrape each replica's
+    # GET /metrics (the slo_* gauges + every serve_*/fleet_* series)
+    # into --obs-fleet-out, the file set tools/obs_fleet.py aggregates
+    scraped_slo_gauges = 0
+    if args.obs_fleet_out:
+        from urllib import request as _urlrequest
+        os.makedirs(args.obs_fleet_out, exist_ok=True)
+        for h in fleet.replicas:
+            try:
+                with _urlrequest.urlopen(h.frontdoor_url + "/metrics",
+                                         timeout=5) as resp:
+                    text = resp.read().decode("utf-8")
+            except Exception:
+                continue
+            scraped_slo_gauges += text.count("\nslo_")
+            with open(os.path.join(args.obs_fleet_out,
+                                   f"{h.replica_id}.prom"), "w") as fh:
+                fh.write(text)
     fleet.stop()
 
     span_counts = {}
@@ -2024,6 +2214,15 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
     if args.prom_path:
         from alphafold2_tpu import obs as _obs
         _obs.write_prometheus(args.prom_path)
+
+    slo_report = None
+    if args.slo and slo_samples:
+        slo_report = _driver_slo_report(
+            args, slo_samples, chaos_t, chaos_t.get("kill"))
+        if args.obs_fleet_out:
+            with open(os.path.join(args.obs_fleet_out,
+                                   "slo_driver.json"), "w") as fh:
+                json.dump(slo_report, fh, indent=1)
 
     expected_tag = rolled_tag if bump_at else model_tag
     total = counter[0] + len(burst_box["tickets"])
@@ -2050,6 +2249,9 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
                         for k in ("rpc", "drain", "forward", "fold")
                         if k in span_counts},
         "trace_path": args.trace_path or None,
+        "slo": slo_report,
+        "slo_gauges_scraped": scraped_slo_gauges,
+        "obs_fleet_out": args.obs_fleet_out or None,
         "failures": failures[:8],
     }
     print(json.dumps(report))
@@ -2081,6 +2283,24 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
         problems.append("no rpc spans in the merged traces")
     if tracer is not None and drain_at and not span_counts.get("drain"):
         problems.append("drain ran but no drain spans in the traces")
+    if args.slo:
+        if slo_report is None:
+            problems.append("--slo set but no SLO samples recorded")
+        elif kill_at and "kill" in chaos_t:
+            if not slo_report.get("kill_window_burn"):
+                problems.append(
+                    f"kill fired at t={chaos_t['kill']:.1f}s but the "
+                    f"SLO burn rate stayed 0 in the killed window "
+                    f"(max overall {slo_report['max_burn_rate']})")
+        missing_slo = [rid for rid, per in per_replica.items()
+                       if per is not None and not per.get("slo")]
+        if missing_slo:
+            problems.append(
+                f"replicas reporting no serve_stats()['slo'] block: "
+                f"{missing_slo}")
+        if args.obs_fleet_out and scraped_slo_gauges == 0:
+            problems.append("no slo_* gauges in the scraped /metrics "
+                            "expositions")
     if problems:
         print("SMOKE FAIL (procs): " + "; ".join(problems),
               file=sys.stderr)

@@ -140,6 +140,24 @@
 #      obs_report --check is clean over the chaos traces with resume
 #      spans present in the waterfall. The step-loop-fault-domain
 #      tripwire.
+#  14. fleet-wide observability (ISSUE 15, --slo + --obs-fleet-out +
+#      tools/obs_fleet.py): a 3-process fleet with consistent-hash
+#      forwarding and one kill -9 + restart mid-run, with tracing ON
+#      everywhere (origin-tagged tracers, cross-process trace
+#      contexts) and SLO objectives declared on every replica AND the
+#      driver. FAILS unless every request still resolves ok (the
+#      phase-6 contract), the driver's windowed SLO report shows
+#      burn-rate > 0 in the killed window (the failover penalty
+#      exceeds the auto-calibrated latency target by construction)
+#      while replicas report serve_stats()["slo"] and their scraped
+#      GET /metrics expositions carry slo_* gauges, obs_fleet --check
+#      is green over the merged driver+replica traces + scrapes —
+#      0 broken stitches (every forwarded fold's segments share one
+#      trace id and hang under the sender's rpc span), every
+#      rpc/forward span explicitly closed with an outcome (a
+#      transport-death failover never leaves a dangling span) — and
+#      at least one multi-hop stitched trace exists. The
+#      fleet-observability tripwire.
 #   7. multi-chip mesh serving (--mesh-policy, serve.MeshPolicy) under
 #      XLA_FLAGS=--xla_force_host_platform_device_count=8: a mixed
 #      short+long workload where the long bucket is pinned to a 4-chip
@@ -172,7 +190,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 DURATION="${SMOKE_DURATION_S:-30}"
-PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8,9,10,11,12,13}"
+PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8,9,10,11,12,13,14}"
 
 phase_on() {
     case ",${PHASES}," in
@@ -963,5 +981,84 @@ print(f"STEPFAULT SMOKE OK: {hard['checkpoint_resumes']} checkpoint "
       f"{hard['row_poison_isolations']} row poison isolations / 0 "
       f"bisections vs baseline {base['resilience']['retries']} "
       f"requeue retries, {resume_spans} resume spans", file=sys.stderr)
+EOF
+fi
+
+# phase 14: fleet-wide observability (ISSUE 15) — 3 real replica
+# processes with forwarding, one kill -9 + restart mid-run, tracing on
+# everywhere (origin-tagged, cross-process contexts) and SLO
+# objectives on every replica + the driver. serve_loadtest --smoke
+# enforces in-process: all requests ok, burn-rate > 0 in the killed
+# window, serve_stats()["slo"] on every replica, slo_* gauges in the
+# scraped /metrics. obs_fleet --check then proves the stitching: every
+# forwarded fold is ONE trace spanning both replicas, every
+# rpc/forward span explicitly closed with an outcome.
+if phase_on 14; then
+rm -rf /tmp/serve_smoke_obsfleet /tmp/serve_smoke_obsfleet_out
+rm -f /tmp/serve_smoke_obsfleet_traces.jsonl
+
+timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/serve_loadtest.py \
+    --smoke \
+    --procs 3 \
+    --proc-run-dir /tmp/serve_smoke_obsfleet \
+    --proc-kill-at 0.35 \
+    --requests 48 \
+    --lengths 24,48 \
+    --buckets 32,64 \
+    --msa-depth 3 \
+    --max-batch 2 \
+    --concurrency 3 \
+    --deadline-s 120 \
+    --num-recycles 0 \
+    --slo 32=auto,all=auto \
+    --slo-window-s 3 \
+    --obs-fleet-out /tmp/serve_smoke_obsfleet_out \
+    --trace-path /tmp/serve_smoke_obsfleet_traces.jsonl \
+    --prom-path /tmp/serve_smoke_obsfleet.prom \
+    > /tmp/serve_smoke_obsfleet.json
+cat /tmp/serve_smoke_obsfleet.json
+
+# the merged driver+replica trace file + the per-replica /metrics
+# scrapes, through the fleet aggregator's tripwire
+timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/obs_fleet.py /tmp/serve_smoke_obsfleet_traces.jsonl \
+    --prom-dir /tmp/serve_smoke_obsfleet_out \
+    --check --json > /tmp/serve_smoke_obsfleet_fleet.json
+cat /tmp/serve_smoke_obsfleet_fleet.json
+
+env -u PYTHONPATH python - <<'EOF'
+import json, sys
+run = json.load(open("/tmp/serve_smoke_obsfleet.json"))
+agg = json.load(open("/tmp/serve_smoke_obsfleet_fleet.json"))
+problems = []
+slo = run.get("slo") or {}
+if not slo.get("kill_window_burn"):
+    problems.append(f"no SLO burn in the killed window "
+                    f"(report {slo.get('kill_window_burn')})")
+if run.get("slo_gauges_scraped", 0) <= 0:
+    problems.append("no slo_* gauges in the scraped /metrics")
+missing = [r for r, per in (run.get("per_replica") or {}).items()
+           if not (per or {}).get("slo")]
+if missing:
+    problems.append(f"replicas without serve_stats()['slo']: {missing}")
+if agg.get("stitched_traces", 0) <= 0:
+    problems.append("no multi-hop stitched traces in the fleet set")
+if agg.get("broken_stitches", 0):
+    problems.append(f"{agg['broken_stitches']} broken stitches")
+want_origins = {"driver", "r0", "r1", "r2"}
+if not want_origins <= set(agg.get("origins", [])):
+    problems.append(f"origins {agg.get('origins')} missing some of "
+                    f"{sorted(want_origins)}")
+if problems:
+    print("OBS-FLEET SMOKE FAIL: " + "; ".join(problems),
+          file=sys.stderr)
+    sys.exit(1)
+print(f"OBS-FLEET SMOKE OK: {agg['stitched_traces']} stitched traces "
+      f"(max {agg['max_hops']} hops) across {agg['origins']}, "
+      f"0 broken stitches, kill-window burn "
+      f"{slo['kill_window_burn']:.2f} (max {slo['max_burn_rate']:.2f}),"
+      f" {run['slo_gauges_scraped']} slo gauge lines scraped",
+      file=sys.stderr)
 EOF
 fi
