@@ -31,12 +31,20 @@ and one cached home. Pieces, each usable alone:
 - procfleet:    ProcFleet/FleetClient — N REAL replica processes with
                 crash/partition/drain chaos (serve_loadtest --procs,
                 serve_smoke.sh phase 6)
+- scaling:      ScalingPolicy + pure decision functions (decide_scale/
+                decide_feature_workers/drain_target) — the control
+                plane's brain, unit-testable without processes
+- controlplane: FleetController — the reconcile loop that scales,
+                rolls out, resizes, and warms the fleet from its own
+                /metrics + /admin/stats scrapes (serve_loadtest
+                --controller, serve_smoke.sh phase 15)
 
 Everything is OFF by default: a Scheduler without `router=` and a
 FoldCache without `peer=` behave exactly as before (README "Fleet
 serving" / "Deployment", MIGRATING "Fleet").
 """
 
+from alphafold2_tpu.fleet.controlplane import FleetController  # noqa: F401
 from alphafold2_tpu.fleet.frontdoor import FrontDoorServer  # noqa: F401
 from alphafold2_tpu.fleet.local import FleetReplica, InProcessFleet  # noqa: F401
 from alphafold2_tpu.fleet.object_store import (FilesystemObjectStore,  # noqa: F401
@@ -49,3 +57,8 @@ from alphafold2_tpu.fleet.router import (ConsistentHashRouter,  # noqa: F401
                                          RouteDecision)
 from alphafold2_tpu.fleet.rpc import (HttpTransport, LocalTransport,  # noqa: F401
                                       RPC_TRANSPORT_MARKER)
+from alphafold2_tpu.fleet.scaling import (HOLD, SCALE_DOWN,  # noqa: F401
+                                          SCALE_UP, ReplicaSignals,
+                                          ScalingDecision, ScalingPolicy,
+                                          decide_feature_workers,
+                                          decide_scale, drain_target)

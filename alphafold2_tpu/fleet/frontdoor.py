@@ -46,7 +46,22 @@ cache tier in `fleet/peer.py`). The protocol is deliberately tiny:
                                  control-plane like /admin (served
                                  through an induced partition)
     POST /admin/rollout          {"tag": t} -> bump RolloutState
-    GET  /admin/stats            serve_stats() as JSON
+    GET  /admin/stats            serve_stats() as JSON, plus an
+                                 "identity" block (replica_id /
+                                 model_tag / incarnation boot nonce)
+                                 mirrored by the /metrics
+                                 fleet_replica_identity series — a
+                                 controller cross-checks the two so a
+                                 restarted replica's stale scrape is
+                                 discarded, never acted on (ISSUE 16)
+    POST /admin/resize           {"workers": n} -> resize the
+                                 scheduler's FeaturePool in place
+                                 (400 when no pool is attached)
+    POST /admin/peers            {"op": register|unregister|up|down,
+                                 "peer": {...}} -> runtime membership
+                                 verb against this replica's registry
+                                 (epoch-bumped ring rebuild); 400
+                                 unless the owner wired `peer_admin`
     POST /admin/partition        {"duration_s": f} -> data-plane 503s
                                  for f seconds (chaos: an induced
                                  network partition as every caller
@@ -144,6 +159,12 @@ class FrontDoorServer:
         # scrape should see fresh (the SLO engine's slo_* set, which
         # otherwise only update when serve_stats() runs)
         self.metrics_hook = None
+        # optional callable(op, peer_dict) -> dict handling
+        # POST /admin/peers (ISSUE 16 runtime membership): the owning
+        # process registers/unregisters/marks peers in ITS registry so
+        # a control plane can rebuild data-plane rings at runtime;
+        # None = 400 (static-membership replicas take no peer verbs)
+        self.peer_admin = None
         reg = metrics or get_registry()
         # the registry GET /metrics exposes — the same one the rpc
         # counter below reports into (the process default unless the
@@ -158,6 +179,22 @@ class FrontDoorServer:
             "fleet_rpc_served_total",
             "front-door RPCs served by this process, by route/outcome",
             ("route", "outcome"))
+        # who-am-I series (ISSUE 16): every /metrics exposition carries
+        # exactly one fleet_replica_identity sample at value 1 whose
+        # labels name this replica, its CURRENT model tag, and this
+        # process incarnation (the boot nonce) — a control plane that
+        # polled a restarted replica can cross-check the scrape against
+        # /admin/stats's identity block and discard a stale one instead
+        # of acting on another incarnation's numbers. Superseded label
+        # sets (pre-rollout tags) are zeroed, not removed, so exactly
+        # one series is ever at 1.
+        self._m_identity = reg.gauge(
+            "fleet_replica_identity",
+            "1 for this process's current identity "
+            "(replica_id/model_tag/incarnation), 0 for superseded",
+            ("replica_id", "model_tag", "incarnation"))
+        self._identity_labels: Optional[dict] = None
+        self._refresh_identity()
         server = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -294,6 +331,29 @@ class FrontDoorServer:
         self._m_rpc.inc(route="healthz", outcome="ok")
         h._json(200, payload)
 
+    def identity(self) -> dict:
+        """This process's identity triple: who the scrape/stats came
+        from. `incarnation` is the boot nonce — two boots of the same
+        replica_id never share it, which is what lets a controller
+        reject a stale scrape from a pre-restart incarnation."""
+        return {"replica_id": self.replica_id,
+                "model_tag": self.rollout.tag if self.rollout else "",
+                "incarnation": self._boot_nonce}
+
+    def _refresh_identity(self):
+        """Keep exactly one fleet_replica_identity series at 1: the
+        current triple. A rollout changes the tag label — the old
+        series is zeroed (kept, so the flip is visible in a scrape)."""
+        labels = self.identity()
+        with self._lock:
+            prev = self._identity_labels
+            if prev == labels:
+                return
+            self._identity_labels = labels
+        if prev is not None:
+            self._m_identity.set(0, **prev)
+        self._m_identity.set(1, **labels)
+
     def _metrics(self, h):
         """Prometheus text exposition of this process's registry (the
         0.0.4 format obs.export.prometheus_text renders) — the registry
@@ -305,6 +365,7 @@ class FrontDoorServer:
                 self.metrics_hook()
             except Exception:
                 pass      # a broken refresher never breaks the scrape
+        self._refresh_identity()
         try:
             text = prometheus_text(self._registry)
         except Exception as exc:
@@ -522,11 +583,55 @@ class FrontDoorServer:
                 stats = self.scheduler.serve_stats()
                 if self.extra_stats is not None:
                     stats["extra"] = self.extra_stats()
+                # identity rides every stats reply (ISSUE 16): a
+                # controller cross-checks it against the /metrics
+                # fleet_replica_identity series so a restarted
+                # replica's stale scrape is discarded, never acted on
+                stats["identity"] = self.identity()
                 body = json.dumps(stats, default=float).encode("utf-8")
             except Exception as exc:
                 return h._json(500, {"error": repr(exc)})
             self._m_rpc.inc(route="admin_stats", outcome="ok")
             return h._reply(200, body)
+        if path == "/admin/resize" and method == "POST":
+            pool = getattr(self.scheduler, "feature_pool", None)
+            if pool is None or not hasattr(pool, "resize"):
+                self._m_rpc.inc(route="admin_resize", outcome="error")
+                return h._json(400, {"error": "no feature pool"})
+            try:
+                payload = json.loads(h._body().decode("utf-8"))
+                workers = int(payload["workers"])
+            except Exception as exc:
+                self._m_rpc.inc(route="admin_resize", outcome="error")
+                return h._json(400, {"error": f"bad payload: {exc!r}"})
+            try:
+                new = pool.resize(workers)
+            except (ValueError, RuntimeError) as exc:
+                self._m_rpc.inc(route="admin_resize", outcome="error")
+                return h._json(400, {"error": str(exc)})
+            self._m_rpc.inc(route="admin_resize", outcome="ok")
+            return h._json(200, {"replica": self.replica_id,
+                                 "workers": new})
+        if path == "/admin/peers" and method == "POST":
+            if self.peer_admin is None:
+                self._m_rpc.inc(route="admin_peers", outcome="error")
+                return h._json(400, {"error": "no peer admin"})
+            try:
+                payload = json.loads(h._body().decode("utf-8"))
+                op = str(payload["op"])
+                peer = dict(payload["peer"])
+                if op not in ("register", "unregister", "up", "down"):
+                    raise ValueError(f"unknown op {op!r}")
+            except Exception as exc:
+                self._m_rpc.inc(route="admin_peers", outcome="error")
+                return h._json(400, {"error": f"bad payload: {exc!r}"})
+            try:
+                out = self.peer_admin(op, peer)
+            except Exception as exc:
+                self._m_rpc.inc(route="admin_peers", outcome="error")
+                return h._json(500, {"error": repr(exc)})
+            self._m_rpc.inc(route="admin_peers", outcome="ok")
+            return h._json(200, dict(out or {}, op=op))
         if path == "/admin/partition" and method == "POST":
             try:
                 payload = json.loads(h._body().decode("utf-8") or "{}")

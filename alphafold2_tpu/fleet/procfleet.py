@@ -123,70 +123,113 @@ class ProcFleet:
                  recycle: Optional[dict] = None,
                  feature_pool: Optional[dict] = None,
                  slo: str = "",
-                 slo_window_s: float = 60.0):
+                 slo_window_s: float = 60.0,
+                 key_log: bool = False,
+                 controller: Optional[dict] = None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self.run_dir = os.path.abspath(run_dir)
         os.makedirs(self.run_dir, exist_ok=True)
         self.replicas: List[ReplicaHandle] = []
+        self.host = host
+        self._n_boot = n_replicas
+        # knobs every replica config (boot-time AND runtime-added)
+        # inherits — add_replica() writes configs from the same dict
+        self._knobs = dict(
+            model_tag=model_tag, buckets=list(buckets),
+            max_batch=int(max_batch), max_wait_ms=float(max_wait_ms),
+            num_recycles=int(num_recycles),
+            model=dict(model or {"dim": 32, "depth": 1,
+                                 "msa_depth": 3}),
+            mesh_policy=str(mesh_policy),
+            mesh_hbm_gb=float(mesh_hbm_gb),
+            recycle=(None if recycle is None else dict(recycle)),
+            feature_pool=(None if feature_pool is None
+                          else dict(feature_pool)),
+            slo=str(slo), slo_window_s=float(slo_window_s),
+            retry=bool(retry), key_log=bool(key_log))
+        # optional control plane (ISSUE 16, OFF when None — the
+        # default, byte-identical to a controller-less fleet): dict of
+        # fleet.ScalingPolicy knobs + FleetController kwargs; start()
+        # builds and runs the reconcile loop against THIS fleet's
+        # spawn/drain verbs, stop() stops it first
+        self.controller_cfg = (None if controller is None
+                               else dict(controller))
+        self.controller = None
         ports = [(_free_port(), _free_port()) for _ in range(n_replicas)]
         peer_rows = [{"replica_id": f"r{i}", "host": host,
                       "frontdoor_port": fd, "peer_port": pp}
                      for i, (fd, pp) in enumerate(ports)]
         for i, row in enumerate(peer_rows):
-            rdir = os.path.join(self.run_dir, row["replica_id"])
-            os.makedirs(rdir, exist_ok=True)
-            config = dict(
-                row,
-                model_tag=model_tag,
-                state_dir=os.path.join(rdir, "state"),
-                cache_dir=os.path.join(rdir, "cache"),
-                trace_path=os.path.join(rdir, "traces.jsonl"),
-                buckets=list(buckets),
-                max_batch=int(max_batch),
-                max_wait_ms=float(max_wait_ms),
-                num_recycles=int(num_recycles),
-                model=dict(model or {"dim": 32, "depth": 1,
-                                     "msa_depth": 3}),
-                # per-replica mesh serving (ISSUE 9 satellite closing
-                # the PR-7 ROADMAP item): the spec string rides the
-                # config and each replica PROCESS builds its own
-                # MeshPolicy over its own device pool at boot
-                # (serve.MeshPolicy.parse: "", "auto", or
-                # "BUCKET=CHIPS,..."; shapes wider than the pool clamp
-                # cleanly, so one fleet config serves 1-device CI and
-                # 8-chip hosts alike)
-                mesh_policy=str(mesh_policy),
-                mesh_hbm_gb=float(mesh_hbm_gb),
-                # each replica claims the i-th 1/N share of whatever
-                # device pool its PROCESS sees: co-hosted replicas must
-                # not double-book chips (separate hosts see disjoint
-                # pools anyway, so the share is the whole pool there)
-                mesh_device_share=[i, n_replicas],
-                # optional step-mode recycle scheduling knobs
-                # (serve.RecyclePolicy kwargs); None = opaque folds
-                recycle=(None if recycle is None else dict(recycle)),
-                # optional feature pipeline (ISSUE 10): e.g.
-                # {"workers": 2, "latency_ms": 0} builds a per-replica
-                # serve.FeaturePool + disk-tiered FeatureCache, so raw
-                # (JSON) front-door submissions featurize off the hot
-                # path; None = inline featurize (today's behavior)
-                feature_pool=(None if feature_pool is None
-                              else dict(feature_pool)),
-                # optional SLO objectives (ISSUE 15): the
-                # obs.slo.SLOPolicy.parse spec string; each replica
-                # builds its own engine over its own registry, so the
-                # slo_* gauges ride its GET /metrics scrape and
-                # serve_stats()["slo"] reports its window
-                slo=str(slo),
-                slo_window_s=float(slo_window_s),
-                retry=bool(retry),
-                peers=[p for p in peer_rows
-                       if p["replica_id"] != row["replica_id"]])
-            config_path = os.path.join(rdir, "config.json")
-            with open(config_path, "w") as fh:
-                json.dump(config, fh, indent=1)
-            self.replicas.append(ReplicaHandle(i, config, config_path))
+            self._add_handle(i, row, peer_rows, n_replicas)
+
+    def _add_handle(self, i: int, row: dict, all_rows: List[dict],
+                    n_total: int) -> "ReplicaHandle":
+        """Write replica i's config.json from `row` + the shared knobs
+        and append its handle. `all_rows` is the full membership the
+        config's static `peers` list is cut from; `n_total` sizes the
+        mesh device share."""
+        k = self._knobs
+        rdir = os.path.join(self.run_dir, row["replica_id"])
+        os.makedirs(rdir, exist_ok=True)
+        config = dict(
+            row,
+            model_tag=k["model_tag"],
+            state_dir=os.path.join(rdir, "state"),
+            cache_dir=os.path.join(rdir, "cache"),
+            trace_path=os.path.join(rdir, "traces.jsonl"),
+            buckets=list(k["buckets"]),
+            max_batch=k["max_batch"],
+            max_wait_ms=k["max_wait_ms"],
+            num_recycles=k["num_recycles"],
+            model=dict(k["model"]),
+            # per-replica mesh serving (ISSUE 9 satellite closing
+            # the PR-7 ROADMAP item): the spec string rides the
+            # config and each replica PROCESS builds its own
+            # MeshPolicy over its own device pool at boot
+            # (serve.MeshPolicy.parse: "", "auto", or
+            # "BUCKET=CHIPS,..."; shapes wider than the pool clamp
+            # cleanly, so one fleet config serves 1-device CI and
+            # 8-chip hosts alike)
+            mesh_policy=k["mesh_policy"],
+            mesh_hbm_gb=k["mesh_hbm_gb"],
+            # each replica claims the i-th 1/N share of whatever
+            # device pool its PROCESS sees: co-hosted replicas must
+            # not double-book chips (separate hosts see disjoint
+            # pools anyway, so the share is the whole pool there)
+            mesh_device_share=[i, n_total],
+            # optional step-mode recycle scheduling knobs
+            # (serve.RecyclePolicy kwargs); None = opaque folds
+            recycle=(None if k["recycle"] is None
+                     else dict(k["recycle"])),
+            # optional feature pipeline (ISSUE 10): e.g.
+            # {"workers": 2, "latency_ms": 0} builds a per-replica
+            # serve.FeaturePool + disk-tiered FeatureCache, so raw
+            # (JSON) front-door submissions featurize off the hot
+            # path; None = inline featurize (today's behavior)
+            feature_pool=(None if k["feature_pool"] is None
+                          else dict(k["feature_pool"])),
+            # optional SLO objectives (ISSUE 15): the
+            # obs.slo.SLOPolicy.parse spec string; each replica
+            # builds its own engine over its own registry, so the
+            # slo_* gauges ride its GET /metrics scrape and
+            # serve_stats()["slo"] reports its window
+            slo=k["slo"],
+            slo_window_s=k["slo_window_s"],
+            retry=k["retry"],
+            peers=[p for p in all_rows
+                   if p["replica_id"] != row["replica_id"]])
+        if k["key_log"]:
+            # served-key frequency telemetry (ISSUE 16): the profile
+            # the controller's telemetry-driven warming (and
+            # cache_warm --from-serve-log) reads
+            config["key_log_path"] = os.path.join(rdir, "keys.jsonl")
+        config_path = os.path.join(rdir, "config.json")
+        with open(config_path, "w") as fh:
+            json.dump(config, fh, indent=1)
+        handle = ReplicaHandle(i, config, config_path)
+        self.replicas.append(handle)
+        return handle
 
     # -- lifecycle -------------------------------------------------------
 
@@ -207,7 +250,40 @@ class ProcFleet:
         for i in range(len(self.replicas)):
             self.spawn(i)
         self.wait_ready(timeout_s=timeout_s)
+        if self.controller_cfg is not None and self.controller is None:
+            self.controller = self._build_controller().start()
         return self
+
+    def _build_controller(self):
+        """FleetController over THIS fleet's verbs: policy knobs are
+        split out of the config dict by ScalingPolicy's field names;
+        the rest pass through to the controller. min/max default to
+        [boot size, boot size + 2] so an unconfigured controller holds
+        the fleet it was given rather than shrinking it to 1."""
+        import dataclasses
+
+        from alphafold2_tpu.fleet.controlplane import FleetController
+        from alphafold2_tpu.fleet.scaling import ScalingPolicy
+        from alphafold2_tpu.obs.trace import Tracer
+
+        cfg = dict(self.controller_cfg or {})
+        policy_fields = {f.name for f in
+                         dataclasses.fields(ScalingPolicy)}
+        policy_kwargs = {key: cfg.pop(key) for key in list(cfg)
+                         if key in policy_fields}
+        policy_kwargs.setdefault("min_replicas", self._n_boot)
+        policy_kwargs.setdefault(
+            "max_replicas",
+            max(policy_kwargs["min_replicas"], self._n_boot + 2))
+        cfg.setdefault("decisions_path", os.path.join(
+            self.run_dir, "controller.decisions.jsonl"))
+        cfg.setdefault("tracer", Tracer(
+            jsonl_path=os.path.join(self.run_dir,
+                                    "controller-traces.jsonl"),
+            origin="controller"))
+        return FleetController(self,
+                               policy=ScalingPolicy(**policy_kwargs),
+                               **cfg)
 
     def wait_ready(self, indices: Optional[List[int]] = None,
                    timeout_s: float = 180.0):
@@ -233,7 +309,17 @@ class ProcFleet:
 
     def stop(self, timeout_s: float = 60.0):
         """SIGTERM every live replica (graceful drain) and reap;
-        escalate to SIGKILL past the timeout."""
+        escalate to SIGKILL past the timeout. The controller (if any)
+        stops FIRST — a reconcile racing the teardown would respawn
+        what this is tearing down."""
+        if self.controller is not None:
+            self.controller.stop()
+            tracer = self.controller.tracer
+            if tracer is not None:
+                try:
+                    tracer.close()
+                except Exception:
+                    pass
         for h in self.replicas:
             if h.alive():
                 h.proc.send_signal(signal.SIGTERM)
@@ -294,6 +380,71 @@ class ProcFleet:
             out[h.replica_id] = (None if resp is None
                                  else resp.get("epoch"))
         return out
+
+    # -- control-plane actuator surface (ISSUE 16) -----------------------
+
+    def add_replica(self) -> int:
+        """Provision a NEW replica slot at runtime (fresh id, ports,
+        state dirs; static `peers` = the whole current membership so
+        its boot registry sees everyone). Returns its index — spawn()
+        it to bring it up. Existing replicas learn about it through
+        the controller's /admin/peers fan-out, not their configs."""
+        i = len(self.replicas)
+        row = {"replica_id": f"r{i}", "host": self.host,
+               "frontdoor_port": _free_port(),
+               "peer_port": _free_port()}
+        all_rows = [{"replica_id": h.replica_id,
+                     "host": h.config["host"],
+                     "frontdoor_port": h.config["frontdoor_port"],
+                     "peer_port": h.config["peer_port"]}
+                    for h in self.replicas] + [row]
+        self._add_handle(i, row, all_rows, len(all_rows))
+        return i
+
+    def scale_up(self) -> Optional[str]:
+        """Controller verb: provision + spawn one replica; returns its
+        id immediately (readiness shows up on the endpoint watch when
+        the executor is warm — the controller never blocks on it)."""
+        i = self.add_replica()
+        self.spawn(i)
+        return self.replicas[i].replica_id
+
+    def scale_down(self, replica_id: str) -> bool:
+        """Controller verb: graceful drain (SIGTERM — the same drain
+        contract rolling restarts use) WITHOUT blocking; the exit is
+        reaped in the background. Never kills: drain-before-kill is
+        the policy, and the policy layer already refused sub-quorum
+        targets."""
+        for h in self.replicas:
+            if h.replica_id == replica_id and h.alive():
+                h.proc.send_signal(signal.SIGTERM)
+                threading.Thread(target=h.proc.wait,
+                                 name=f"reap-{replica_id}",
+                                 daemon=True).start()
+                return True
+        return False
+
+    def endpoints(self) -> Dict[str, str]:
+        """Live replicas' front-door base URLs — the controller's
+        endpoint-watch source. A dead process drops out here, which is
+        what unregisters it from the controller's membership."""
+        return {h.replica_id: h.frontdoor_url
+                for h in self.replicas if h.alive()}
+
+    def peer_rows(self) -> List[dict]:
+        """Full address rows for every provisioned replica — what the
+        controller fans out to /admin/peers on join."""
+        return [{"replica_id": h.replica_id,
+                 "host": h.config["host"],
+                 "frontdoor_port": h.config["frontdoor_port"],
+                 "peer_port": h.config["peer_port"]}
+                for h in self.replicas]
+
+    def key_log_paths(self) -> Dict[str, str]:
+        """Served-key frequency files (empty unless key_log=True)."""
+        return {h.replica_id: h.config["key_log_path"]
+                for h in self.replicas
+                if h.config.get("key_log_path")}
 
     # -- views -----------------------------------------------------------
 
@@ -373,6 +524,7 @@ class FleetClient:
 
         if not urls:
             raise ValueError("FleetClient needs at least one URL")
+        self._metrics = metrics
         self.transports = [HttpTransport(u, metrics=metrics)
                            for u in urls]
         self.retry = retry or RetryPolicy(
@@ -387,6 +539,22 @@ class FleetClient:
     def _count(self, field: str):
         with self._lock:
             setattr(self, field, getattr(self, field) + 1)
+
+    def set_urls(self, urls: List[str]):
+        """Grow the failover set at runtime (ISSUE 16: a controller-
+        scaled fleet should receive driver traffic on its NEW replicas
+        too). Add-only: a URL that died just keeps failing over — the
+        fold loop already routes around it — so removal would only
+        race in-flight seat arithmetic for no benefit."""
+        with self._lock:
+            known = {t.base_url for t in self.transports}
+            fresh = [u for u in urls
+                     if u.rstrip("/") not in known]
+        for u in fresh:
+            # append is atomic; fold()'s modulo seat math tolerates
+            # growth between attempts
+            self.transports.append(
+                HttpTransport(u, metrics=self._metrics))
 
     def fold(self, request, hint: int = 0, trace=NULL_TRACE):
         """Submit `request` and block for its terminal FoldResponse,
@@ -573,6 +741,13 @@ def replica_main(config: dict) -> int:
         slo_engine = obs.SLOEngine(obs.SLOPolicy.parse(
             config["slo"],
             window_s=float(config.get("slo_window_s", 60.0))))
+    # optional served-key frequency telemetry (ISSUE 16): ingress
+    # submits aggregate into a cache_warm-format profile the control
+    # plane's telemetry-driven warming tails
+    key_log = None
+    if config.get("key_log_path"):
+        from alphafold2_tpu.serve.metrics import KeyFrequencyLog
+        key_log = KeyFrequencyLog(config["key_log_path"])
     scheduler = serve.Scheduler(
         executor, policy,
         serve.SchedulerConfig(
@@ -584,9 +759,32 @@ def replica_main(config: dict) -> int:
         router=router, retry=retry,
         quarantine_path=os.path.join(state_dir, "quarantine.jsonl"),
         mesh_policy=mesh_policy, recycle_policy=recycle_policy,
-        feature_pool=feature_pool, slo=slo_engine)
-    rollout.subscribe(
-        lambda tag, epoch: setattr(scheduler, "model_tag", tag))
+        feature_pool=feature_pool, slo=slo_engine, key_log=key_log)
+    # a rollout re-tags the executor, which orphans every executable
+    # compiled under the previous tag (the ISSUE 7 staleness fix) —
+    # re-warm in the BACKGROUND so a rolled replica re-compiles its
+    # serving shapes eagerly instead of on the first unlucky request
+    # (the cost exists either way; paying it off the request path is
+    # what keeps a controller-driven rollout invisible to latency)
+    rewarm = threading.Event()
+
+    def _on_rollout(tag, epoch):
+        scheduler.model_tag = tag    # O(1) under the state lock
+        rewarm.set()
+
+    rollout.subscribe(_on_rollout)
+
+    def _rewarm_loop():
+        while True:
+            rewarm.wait()
+            rewarm.clear()
+            try:
+                scheduler.warmup()
+            except Exception:
+                pass             # cold-serve fallback: compile on use
+
+    threading.Thread(target=_rewarm_loop, daemon=True,
+                     name=f"{rid}-rewarm").start()
 
     partition = threading.Event()
     frontdoor = FrontDoorServer(scheduler, rollout=rollout,
@@ -603,6 +801,29 @@ def replica_main(config: dict) -> int:
                  "recoveries": client.recoveries},
         "frontdoor": frontdoor.snapshot(),
         "rollout": {"tag": rollout.tag, "epoch": rollout.epoch}}
+
+    # runtime membership verbs (ISSUE 16): the control plane's
+    # /admin/peers fan-out rebuilds THIS replica's ring at runtime —
+    # a mid-run join starts receiving forwards, a swept member stops
+    def _peer_admin(op: str, peer: dict) -> dict:
+        pid = str(peer["replica_id"])
+        if pid == rid:
+            return {"replicas": registry.member_ids()}  # not my own row
+        if op == "register":
+            registry.register(
+                pid,
+                peer_addr=(peer["host"], int(peer["peer_port"])),
+                transport=HttpTransport(
+                    f"http://{peer['host']}:{peer['frontdoor_port']}",
+                    rollout=rollout))
+        elif op == "unregister":
+            registry.unregister(pid)
+        elif op in ("up", "down"):
+            registry.mark(pid, op == "up")
+        return {"replicas": registry.member_ids(),
+                "epoch": registry.epoch}
+
+    frontdoor.peer_admin = _peer_admin
     # peer-cache fetches served here emit continued trace records
     # under the requester's peer_fetch hop (ISSUE 15)
     peer_server.tracer = tracer

@@ -21,6 +21,15 @@ last heartbeat is fresh. Mark changes bump the membership epoch;
 heartbeat staleness does not (routers skip unhealthy members at lookup
 time, so the ring itself need not rebuild).
 
+`sweep()` (ISSUE 16) turns staleness into a real down-mark: a member
+whose heartbeat aged past `heartbeat_timeout_s` is auto-marked down
+with the membership epoch bumped, so rings REBUILD around it instead
+of merely skipping it at lookup time — a wedged-but-listening replica
+(process alive, event loop stuck) stops owning keys entirely. Auto-
+downed members are distinct from administratively downed ones: a fresh
+`heartbeat()` revives an auto-downed member, but never one an operator
+`mark()`-ed down.
+
 Everything is process-local state: in a real deployment this registry
 is fed by whatever control plane owns membership (k8s endpoints, a
 gossip layer); the serving stack only ever reads it through this
@@ -161,6 +170,9 @@ class ReplicaInfo:
     transport: Optional[Any] = None
     marked_up: bool = True
     last_heartbeat_s: float = field(default=0.0)
+    # True when the down-mark came from a heartbeat-TTL sweep rather
+    # than an operator: only these members are revivable by heartbeat.
+    auto_down: bool = field(default=False)
 
 
 class ReplicaRegistry:
@@ -190,6 +202,12 @@ class ReplicaRegistry:
             "fleet_replicas_healthy", "replicas currently routable")
         self._m_members = reg.gauge(
             "fleet_replicas_registered", "replicas in the registry")
+        # minted only when the TTL feature is armed: a default registry
+        # keeps the PR-15 metric-name set byte-identical
+        self._m_auto_downs = (reg.counter(
+            "fleet_auto_downs_total",
+            "members auto-marked down by heartbeat-TTL sweep")
+            if heartbeat_timeout_s is not None else None)
 
     # -- membership ------------------------------------------------------
 
@@ -228,6 +246,11 @@ class ReplicaRegistry:
                 self.epoch += 1
         self._report_gauges()
 
+    def unregister(self, replica_id: str):
+        """Remove a member entirely (endpoint gone, not just unhealthy);
+        bumps the membership epoch so rings rebuild without it."""
+        self.deregister(replica_id)
+
     def get(self, replica_id: str) -> Optional[ReplicaInfo]:
         with self._lock:
             return self._members.get(replica_id)
@@ -245,25 +268,67 @@ class ReplicaRegistry:
 
     def heartbeat(self, replica_id: str):
         """Freshness ping; does NOT bump the epoch (routers check
-        staleness at lookup time, the ring does not change)."""
+        staleness at lookup time, the ring does not change) — UNLESS it
+        revives a sweep-auto-downed member, which is a membership change
+        rings must see. An administrative down-mark is never revived."""
+        revived = False
         with self._lock:
             info = self._members.get(replica_id)
             if info is not None:
                 info.last_heartbeat_s = self._clock()
+                if info.auto_down and not info.marked_up:
+                    info.marked_up = True
+                    info.auto_down = False
+                    self.epoch += 1
+                    revived = True
+        if revived:
+            self._report_gauges()
 
     def mark(self, replica_id: str, up: bool):
-        """Administrative health mark; epoch bumps only on a change."""
+        """Administrative health mark; epoch bumps only on a change.
+        An explicit mark always clears `auto_down` — the operator's
+        word overrides (and un-arms) the TTL sweep's."""
         changed = False
         with self._lock:
             info = self._members.get(replica_id)
-            if info is not None and info.marked_up != up:
-                info.marked_up = up
-                if up:
-                    info.last_heartbeat_s = self._clock()
-                self.epoch += 1
-                changed = True
+            if info is not None:
+                if info.auto_down:
+                    info.auto_down = False
+                if info.marked_up != up:
+                    info.marked_up = up
+                    if up:
+                        info.last_heartbeat_s = self._clock()
+                    self.epoch += 1
+                    changed = True
         if changed:
             self._report_gauges()
+
+    def sweep(self) -> List[str]:
+        """Auto-down every marked-up member whose heartbeat aged past
+        `heartbeat_timeout_s` (no-op when the TTL is unset). Unlike the
+        passive lookup-time staleness check, this BUMPS the membership
+        epoch so consistent-hash rings rebuild without the wedged
+        member — it stops owning keys instead of merely failing them.
+        Returns the ids downed this sweep."""
+        if self.heartbeat_timeout_s is None:
+            return []
+        downed: List[str] = []
+        with self._lock:
+            now = self._clock()
+            for rid, info in self._members.items():
+                if (info.marked_up and
+                        now - info.last_heartbeat_s
+                        > self.heartbeat_timeout_s):
+                    info.marked_up = False
+                    info.auto_down = True
+                    downed.append(rid)
+            if downed:
+                self.epoch += 1
+        if downed:
+            if self._m_auto_downs is not None:
+                self._m_auto_downs.inc(len(downed))
+            self._report_gauges()
+        return sorted(downed)
 
     def is_healthy(self, replica_id: str) -> bool:
         with self._lock:
@@ -298,7 +363,11 @@ class ReplicaRegistry:
                       "forwardable": (info.transport is not None
                                       or info.submit is not None),
                       "transport": (None if info.transport is None
-                                    else type(info.transport).__name__)}
+                                    else type(info.transport).__name__),
+                      # only under an armed TTL: a default registry's
+                      # snapshot stays byte-identical to PR 15
+                      **({"auto_down": info.auto_down}
+                         if self.heartbeat_timeout_s is not None else {})}
                 for rid, info in sorted(self._members.items())}
             return {"epoch": self.epoch,
                     "model_tag": tag,

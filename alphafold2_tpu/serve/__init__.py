@@ -13,6 +13,8 @@ The serving stack, bottom-up:
 - scheduler: Scheduler — dynamic batching, deadlines, backpressure,
              optional result cache + in-flight coalescing
 - metrics:   ServeMetrics — counters, padding waste, latency tails, JSONL
+             KeyFrequencyLog — served-key frequencies in the
+             cache_warm profile format (`Scheduler(key_log=)`)
              (all mirrored into the process-wide obs.MetricsRegistry;
              pass `Scheduler(..., tracer=obs.Tracer(...))` for
              request-scoped traces — README "Observability")
@@ -91,7 +93,8 @@ from alphafold2_tpu.serve.meshpolicy import (AdmissionDecision,  # noqa: F401
                                              DeviceSliceAllocator,
                                              FoldMemoryModel, MeshPolicy,
                                              SliceLease)
-from alphafold2_tpu.serve.metrics import ServeMetrics  # noqa: F401
+from alphafold2_tpu.serve.metrics import (KeyFrequencyLog,  # noqa: F401
+                                          ServeMetrics)
 from alphafold2_tpu.serve.recycle import RecyclePolicy  # noqa: F401
 from alphafold2_tpu.serve.request import (FoldProgress, FoldRequest,  # noqa: F401
                                           FoldResponse, FoldTicket)

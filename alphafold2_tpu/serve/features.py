@@ -228,6 +228,8 @@ class FeaturePool:
         self._inflight: dict = {}
         self._depth = 0                # queued + running pool jobs
         self._stopped = False
+        self._retired_pools: list = []  # pre-resize executors, draining
+        self.resizes = 0               # in-place worker-count changes
         # lifetime counters (lock-guarded; snapshot reads are racy-ok)
         self.submissions = 0
         self.executions = 0            # featurize runs (dedup excluded)
@@ -269,7 +271,36 @@ class FeaturePool:
         feed their folds; nothing new is accepted)."""
         with self._lock:
             self._stopped = True
-        self._pool.shutdown(wait=True)
+            pools = [self._pool] + self._retired_pools
+            self._retired_pools = []
+        for pool in pools:
+            pool.shutdown(wait=True)
+
+    def resize(self, workers: int) -> int:
+        """Resize the worker pool IN PLACE (ISSUE 16 `/admin/resize`):
+        swap in a fresh executor at the new width and retire the old
+        one without waiting — its queued + running jobs drain on its
+        own threads, new submissions land on the new pool, and no job
+        is dropped or re-run. Callers racing the swap and losing
+        (submit on a just-shutdown pool) already fall back to inline
+        featurize in `_enqueue_local`. Returns the new width."""
+        workers = int(workers)
+        if workers < 1:
+            raise ValueError("FeaturePool needs at least 1 worker")
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("feature pool stopped")
+            if workers == self.workers:
+                return self.workers
+            old = self._pool
+            self._pool = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="featurize")
+            # keep a handle so stop() still waits for the stragglers
+            self._retired_pools.append(old)
+            self.workers = workers
+            self.resizes += 1
+        old.shutdown(wait=False)     # drains queued jobs, blocks nothing
+        return workers
 
     def __enter__(self) -> "FeaturePool":
         return self
@@ -581,6 +612,10 @@ class FeaturePool:
                    "shed": self.shed,
                    "forwarded": self.forwarded,
                    "latency_s_injected": self.latency_s}
+        if self.resizes:
+            # only after a resize: an untouched pool's snapshot stays
+            # byte-identical to PR 15 (controller-off stats pin)
+            out["resizes"] = self.resizes
         out["featurize_p50_s"] = self._latency.percentile(50)
         out["featurize_p99_s"] = self._latency.percentile(99)
         if self.cache is not None:

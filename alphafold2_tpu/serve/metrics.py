@@ -325,3 +325,91 @@ class ServeMetrics:
     def close(self):
         if self._logger is not None:
             self._logger.close()
+
+
+class KeyFrequencyLog:
+    """Served-traffic key frequencies as a cache_warm profile (ISSUE 16).
+
+    Every ingress submit (forwarded hops excluded — each user request
+    counts once, at the replica that received it) is aggregated by its
+    (seq, msa) content digest and periodically flushed as JSONL in
+    EXACTLY the profile format `tools/cache_warm.py` reads:
+
+        {"seq": [tokens...], "count": n}
+        {"seq": [tokens...], "msa": [[tokens...]], "count": n}
+
+    so telemetry-driven warming is the same code path as offline
+    warming — the controller (or `cache_warm --from-serve-log`) tails
+    these files and folds the head into the ring owners' caches.
+    Flushes are atomic full rewrites (tmp + os.replace): a reader never
+    sees a torn file, and counts are cumulative per unique key, not
+    append-per-request — the file stays O(unique keys).
+
+    Off by default everywhere: nothing constructs one unless asked
+    (`Scheduler(key_log=)`, ProcFleet `key_log=True`), so the no-log
+    serving path is byte-identical.
+    """
+
+    def __init__(self, path: str, flush_every: int = 16):
+        self.path = path
+        self.flush_every = max(1, int(flush_every))
+        self.observed = 0
+        self._lock = threading.Lock()
+        self._entries: Dict[str, dict] = {}   # digest -> profile record
+
+    def observe(self, seq, msa=None):
+        import hashlib
+
+        import numpy as np
+
+        try:
+            seq_arr = np.asarray(seq)
+            h = hashlib.blake2b(digest_size=16)
+            h.update(seq_arr.astype(np.int64, copy=False).tobytes())
+            msa_arr = None
+            if msa is not None:
+                msa_arr = np.asarray(msa)
+                h.update(b"|msa|")
+                h.update(msa_arr.astype(np.int64, copy=False).tobytes())
+            digest = h.hexdigest()
+        except Exception:
+            return             # unkeyable traffic is never worth a crash
+        with self._lock:
+            ent = self._entries.get(digest)
+            if ent is None:
+                rec = {"seq": seq_arr.tolist(), "count": 1}
+                if msa_arr is not None:
+                    rec["msa"] = msa_arr.tolist()
+                self._entries[digest] = rec
+            else:
+                ent["count"] += 1
+            self.observed += 1
+            due = self.observed % self.flush_every == 0
+        if due:
+            self.flush()
+
+    def flush(self):
+        """Atomic full rewrite, hottest keys first."""
+        import json
+        import os
+
+        with self._lock:
+            records = sorted(self._entries.values(),
+                             key=lambda r: -r["count"])
+            records = [dict(r) for r in records]
+        try:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                for rec in records:
+                    fh.write(json.dumps(rec) + "\n")
+            os.replace(tmp, self.path)
+        except OSError:
+            pass               # telemetry is best-effort, serving wins
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"path": self.path,
+                    "observed": self.observed,
+                    "unique": len(self._entries)}

@@ -362,8 +362,14 @@ class Scheduler:
                  recycle_policy: Optional[RecyclePolicy] = None,
                  feature_pool=None,
                  kernel_policy=None,
-                 slo=None):
+                 slo=None,
+                 key_log=None):
         self.executor = executor
+        # optional serve.metrics.KeyFrequencyLog (OFF when None — the
+        # default, byte-identical): ingress submits (forwarded hops
+        # excluded) are aggregated into a cache_warm-format profile so
+        # the control plane can warm from SERVED traffic (ISSUE 16)
+        self.key_log = key_log
         # optional obs.slo.SLOEngine (OFF when None — the default,
         # which keeps serve_stats() and the registry's metric-name set
         # byte-identical): declarative per-QoS-class latency/
@@ -673,6 +679,8 @@ class Scheduler:
         if self._worker is not None:
             self._worker.join()
             self._worker = None
+        if self.key_log is not None:
+            self.key_log.flush()   # profile durable across restarts
         if self._mesh_pool is not None:
             # the worker already waited out in-flight mesh executions,
             # so this is a fast thread teardown; start() re-creates it
@@ -884,6 +892,10 @@ class Scheduler:
             entry.trace.finish("rejected", error="draining")
             raise DrainingError(
                 "Scheduler draining: not admitting new requests")
+        # key-frequency telemetry at INGRESS only: a forwarded hop is
+        # the same user request already counted where it arrived
+        if self.key_log is not None and not request.forwarded:
+            self.key_log.observe(request.seq, request.msa)
         # HBM admission guard: a fold whose analytic footprint exceeds
         # even the largest configured mesh slice would die in an XLA
         # OOM mid-batch, taking its whole cohort with it — reject it at
@@ -1581,6 +1593,8 @@ class Scheduler:
                                    folds=folds)
         if self.feature_pool is not None:
             stats["featurize"] = self.feature_pool.snapshot()
+        if self.key_log is not None:
+            stats["key_log"] = self.key_log.snapshot()
         if self.slo is not None:
             # report() also refreshes the slo_* gauges, so a stats
             # poll and a Prometheus scrape read the same window

@@ -18,6 +18,18 @@ Frequency file: JSONL, one record per unique sequence —
 loadtest's traffic model) to F and exits — the self-contained demo /
 test path.
 
+`--from-serve-log DIR` (ISSUE 16 satellite) derives the profile from
+SERVED traffic instead of an offline file: it walks DIR for the
+`keys.jsonl` key-frequency records the serving scheduler writes when
+armed with `Scheduler(key_log=...)` / `ProcFleet(key_log=True)`,
+merges them across replicas (summing counts by content digest), and
+warms the head of what the fleet actually folded. The report then
+carries BOTH ratios: `predicted_hit_ratio` (frequency mass of the
+warmed head — what the warm buys if tomorrow looks like the log) and
+`realized_hit_ratio` (the mass that was ALREADY resident when probed
+— what previous warming/serving had realized); the delta is this
+run's purchase.
+
 `--fleet ID=DIR,...` warms FLEET-SCOPE (ISSUE 10 satellite): every key
 routes through the serving fleet's own `ConsistentHashRouter` and is
 folded into its OWNER replica's cache dir, so each warm entry lands
@@ -52,6 +64,13 @@ def parse_args(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--freq", default="",
                     help="sequence-frequency JSONL (seq + count per line)")
+    ap.add_argument("--from-serve-log", default="",
+                    help="derive the profile from served traffic: walk "
+                         "this directory for the scheduler's keys.jsonl "
+                         "key-frequency records (ProcFleet run_dir "
+                         "layout), merge counts across replicas by "
+                         "content digest, and warm that head. "
+                         "Alternative to --freq.")
     ap.add_argument("--emit-synthetic", default="",
                     help="write a synthetic Zipf profile here and exit")
     ap.add_argument("--num", type=int, default=32,
@@ -149,6 +168,34 @@ def load_profile(path: str):
     return entries
 
 
+def load_serve_log_profile(log_dir: str):
+    """Profile entries from the fleet's own key-frequency telemetry.
+
+    Walks `log_dir` for `keys.jsonl` files (one per replica in the
+    ProcFleet run_dir layout), merges records across replicas by
+    content digest via the controller's merge, and returns
+    ([(count, seq, msa)], n_files) hottest-first.
+    """
+    import numpy as np
+
+    from alphafold2_tpu.fleet.controlplane import merge_key_profiles
+
+    paths = []
+    for root, _, files in os.walk(log_dir):
+        paths.extend(os.path.join(root, f) for f in files
+                     if f == "keys.jsonl" or f.endswith(".keys.jsonl"))
+    merged = merge_key_profiles(sorted(paths))
+    entries = []
+    for rec in merged:
+        seq = np.asarray(rec["seq"], np.int32)
+        msa = rec.get("msa")
+        msa = None if msa is None else np.asarray(msa, np.int32)
+        if seq.ndim != 1 or rec["count"] < 1:
+            continue
+        entries.append((int(rec["count"]), seq, msa))
+    return entries, len(paths)
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     import __graft_entry__
@@ -156,9 +203,9 @@ def main(argv=None) -> int:
         __graft_entry__.force_cpu_fallback()
     if args.emit_synthetic:
         return emit_synthetic(args)
-    if not args.freq:
-        print("cache_warm: need --freq or --emit-synthetic",
-              file=sys.stderr)
+    if not args.freq and not args.from_serve_log:
+        print("cache_warm: need --freq, --from-serve-log, or "
+              "--emit-synthetic", file=sys.stderr)
         return 2
 
     import jax
@@ -167,10 +214,20 @@ def main(argv=None) -> int:
     from alphafold2_tpu import Alphafold2, predict
     from alphafold2_tpu.cache import FoldCache
 
-    entries = load_profile(args.freq)
-    if not entries:
-        print(f"cache_warm: empty profile {args.freq}", file=sys.stderr)
-        return 2
+    serve_log_files = 0
+    if args.from_serve_log:
+        entries, serve_log_files = load_serve_log_profile(
+            args.from_serve_log)
+        if not entries:
+            print(f"cache_warm: no keys.jsonl records under "
+                  f"{args.from_serve_log}", file=sys.stderr)
+            return 2
+    else:
+        entries = load_profile(args.freq)
+        if not entries:
+            print(f"cache_warm: empty profile {args.freq}",
+                  file=sys.stderr)
+            return 2
     entries.sort(key=lambda e: -e[0])
     total_freq = sum(c for c, _, _ in entries)
 
@@ -221,7 +278,7 @@ def main(argv=None) -> int:
         return sum(c.bytes_resident for c in caches.values())
 
     t0 = time.monotonic()
-    warmed, warmed_freq, skipped = 0, 0, 0
+    warmed, warmed_freq, skipped, skipped_freq = 0, 0, 0, 0
     per_replica = {rid: 0 for rid in caches}
     head = entries[:args.top] if args.top > 0 else entries
     for rank, (count, seq, msa) in enumerate(head):
@@ -246,6 +303,7 @@ def main(argv=None) -> int:
             num_recycles=args.num_recycles, **kwargs)
         if target.stats.hits > hits_before:
             skipped += 1               # already warm: fold was elided
+            skipped_freq += count
         else:
             warmed += 1
         warmed_freq += count
@@ -261,7 +319,10 @@ def main(argv=None) -> int:
                 for f in files if f.endswith(".npz"))
     report = {
         "metric": "cache_warm",
-        "profile": args.freq,
+        "profile": args.freq or args.from_serve_log,
+        "profile_source": ("serve_log" if args.from_serve_log
+                           else "freq_file"),
+        "serve_log_files": serve_log_files,
         "unique_in_profile": len(entries),
         "warmed": warmed,
         "skipped_already_cached": skipped,
@@ -276,6 +337,11 @@ def main(argv=None) -> int:
         # this warm predicts for traffic matching the profile
         "predicted_hit_ratio": round(
             warmed_freq / total_freq if total_freq else 0.0, 4),
+        # mass that was ALREADY resident when probed — the hit ratio
+        # previous warming/serving had realized; predicted - realized
+        # is what this run bought
+        "realized_hit_ratio": round(
+            skipped_freq / total_freq if total_freq else 0.0, 4),
         "warm_wall_s": round(elapsed, 3),
     }
     print(json.dumps(report))

@@ -22,19 +22,32 @@ the fleet-level questions:
   replica's Prometheus exposition (`GET /metrics` scrape files), plus
   fleet-merged per-bucket latency histograms (the fixed exponential
   buckets merge bucket-for-bucket across processes);
+- the CONTROLLER section (ISSUE 16): `*decisions.jsonl` records from
+  `FleetController` render as why-the-fleet-scaled — every non-hold
+  action with its recorded reason, membership churn (joined / left /
+  TTL-swept), rollout convergence verdicts, stale scrapes refused,
+  warm submissions;
 - `--check`: exit 1 on a BROKEN STITCH — a hop that armed stitching
   (an rpc span carrying a `span_id` that completed `outcome="ok"`, or
   a peer_fetch hit) with no child record continuing that span — on a
   failover span left open (an `rpc`/`forward` span auto-closed at
   finish instead of explicitly ended with an outcome: the ISSUE-15
-  orphan bug), and on every per-replica violation obs_report --check
-  would flag (schema, orphan spans, STAGE_ORDER drift, prom parse).
+  orphan bug), on an IDENTITY violation (an exposition whose
+  `fleet_replica_identity` doesn't pin exactly one live
+  (replica_id, model_tag, incarnation) series at 1, or one replica_id
+  scraped under two different incarnations — the stale-scrape hazard
+  a controller must never act on), and on every per-replica violation
+  obs_report --check would flag (schema, orphan spans, STAGE_ORDER
+  drift, prom parse).
 
 Inputs are files or directories: directories are scanned recursively
 for `*.jsonl` trace files and `*.prom` exposition files — point it at
 a `ProcFleet` run dir (each replica's `<rid>/traces.jsonl`) and the
 `--obs-fleet-out` scrape dir, or pass one pre-merged trace file.
-`--scrape URL,...` additionally pulls live `<url>/metrics` endpoints.
+`keys.jsonl` (scheduler key-frequency telemetry) and `*decisions.jsonl`
+(controller decisions) are routed to their own parsers, never the
+trace parser. `--scrape URL,...` additionally pulls live
+`<url>/metrics` endpoints.
 
   python tools/obs_fleet.py /tmp/procfleet_run --check
   python tools/obs_fleet.py merged.jsonl --prom-dir scrapes/ --top 5
@@ -84,23 +97,38 @@ _STITCH_EVENT_OUTCOMES = {"peer_fetch": ("hit",)}
 # -- input gathering -----------------------------------------------------
 
 
-def gather_paths(paths: List[str]) -> Tuple[List[str], List[str]]:
-    """(trace_jsonl_files, prom_files) from a mix of files and dirs."""
-    traces, proms = [], []
+def _classify_jsonl(name: str) -> str:
+    """Not every fleet JSONL is a trace file: the controller's decision
+    log (`*decisions.jsonl`) and the scheduler's key-frequency records
+    (`keys.jsonl`) live in the same run dir and would otherwise be fed
+    to the trace parser as schema violations."""
+    if name == "keys.jsonl" or name.endswith(".keys.jsonl"):
+        return "keys"
+    if name.endswith("decisions.jsonl"):
+        return "decisions"
+    return "trace"
+
+
+def gather_paths(paths: List[str]
+                 ) -> Tuple[List[str], List[str], List[str], List[str]]:
+    """(trace_jsonl_files, prom_files, decision_files, key_files) from
+    a mix of files and dirs."""
+    traces, proms, decisions, keys = [], [], [], []
+    by_kind = {"trace": traces, "decisions": decisions, "keys": keys}
     for p in paths:
         if os.path.isdir(p):
             for root, _dirs, files in os.walk(p):
                 for f in sorted(files):
                     full = os.path.join(root, f)
                     if f.endswith(".jsonl"):
-                        traces.append(full)
+                        by_kind[_classify_jsonl(f)].append(full)
                     elif f.endswith(".prom"):
                         proms.append(full)
         elif p.endswith(".prom"):
             proms.append(p)
         else:
-            traces.append(p)
-    return traces, proms
+            by_kind[_classify_jsonl(os.path.basename(p))].append(p)
+    return traces, proms, decisions, keys
 
 
 def load_all_traces(files: List[str]) -> Tuple[List[dict], List[str]]:
@@ -212,6 +240,118 @@ def merged_latency_histogram(prom_by_source: Dict[str, str]) -> dict:
                                      {"count": 0, "buckets": {}})
             slot["count"] += value
     return merged
+
+
+# -- controller decisions ------------------------------------------------
+
+
+def load_decisions(files: List[str]) -> Tuple[List[dict], List[str]]:
+    """Controller decision JSONL records (controlplane.FleetController
+    `_log` output), merged in file order; torn lines are problems."""
+    records, problems = [], []
+    for path in files:
+        try:
+            with open(path) as fh:
+                for lineno, line in enumerate(fh, 1):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        problems.append(
+                            f"{path}:{lineno}: torn decision record")
+                        continue
+                    if not isinstance(rec, dict) or "event" not in rec:
+                        problems.append(
+                            f"{path}:{lineno}: decision record without "
+                            f"an event field")
+                        continue
+                    records.append(rec)
+        except OSError as exc:
+            problems.append(f"{path}: unreadable ({exc})")
+    return records, problems
+
+
+def controller_summary(decisions: List[dict]) -> dict:
+    """Why the fleet scaled, from the decision log: reconcile count,
+    every non-hold action with its recorded reason, membership churn,
+    rollout verdicts, warming volume."""
+    recs = [d for d in decisions if d.get("event") == "reconcile"]
+    actions = []
+    for d in recs:
+        for act in d.get("actions", ()):
+            actions.append({"reconcile": d.get("reconcile"),
+                            "verb": act.get("verb"),
+                            "replica": act.get("replica"),
+                            "error": act.get("error"),
+                            "reason": (d.get("decision") or {}
+                                       ).get("reason", "")})
+    replicas_over_time = [
+        {"reconcile": d.get("reconcile"),
+         "healthy": d.get("healthy"),
+         "endpoints": len(d.get("endpoints", ()))}
+        for d in recs]
+    return {
+        "reconciles": len(recs),
+        "errors": sum(1 for d in decisions
+                      if d.get("event") == "reconcile_error"),
+        "actions": actions,
+        "joined": sorted({r for d in recs for r in d.get("joined", ())}),
+        "left": sorted({r for d in recs for r in d.get("left", ())}),
+        "swept": sorted({r for d in recs for r in d.get("swept", ())}),
+        "stale_scrapes": sum(int(d.get("stale_scrapes", 0))
+                             for d in recs),
+        "warm_submissions": sum(int(d.get("warm_submissions", 0))
+                                for d in recs),
+        "resizes": sum(len(d.get("resized", {})) for d in recs),
+        "rollouts": [{"tag": d.get("tag"),
+                      "converged": d.get("converged"),
+                      "stragglers": d.get("stragglers")}
+                     for d in decisions if d.get("event") == "rollout"],
+        "replicas_over_time": replicas_over_time,
+    }
+
+
+# -- identity consistency ------------------------------------------------
+
+
+def check_identity(prom_by_source: Dict[str, str]) -> List[str]:
+    """The stale-scrape tripwire (ISSUE 16 satellite): each exposition
+    that exports `fleet_replica_identity` must pin EXACTLY ONE
+    (replica_id, model_tag, incarnation) series at value 1 — that's the
+    contract controlplane.parse_identity relies on to refuse acting on
+    a mismatched scrape. Across the merged set, one replica_id showing
+    two different incarnations means the input mixes scrapes of two
+    lives of the same replica — a controller fed this set could act on
+    the dead incarnation's numbers."""
+    problems = []
+    active: Dict[str, Dict[str, str]] = {}   # replica_id -> {inc: src}
+    for source, text in sorted(prom_by_source.items()):
+        samples = parse_prometheus(text).get("fleet_replica_identity")
+        if samples is None:
+            continue           # pre-fleet exposition: nothing to pin
+        ones = [labels for labels, value in samples if value == 1.0]
+        if len(ones) != 1:
+            problems.append(
+                f"{source}: fleet_replica_identity has {len(ones)} "
+                f"series at value 1 (want exactly 1) — the scrape "
+                f"does not name a single live incarnation")
+            continue
+        labels = ones[0]
+        rid = labels.get("replica_id", "?")
+        inc = labels.get("incarnation", "?")
+        prev = active.setdefault(rid, {})
+        if inc not in prev and prev:
+            others = ", ".join(
+                f"{i} ({src})" for i, src in sorted(prev.items()))
+            problems.append(
+                f"{source}: replica_id {rid!r} incarnation {inc!r} "
+                f"conflicts with {others} — the input mixes scrapes "
+                f"from different lives of the same replica (stale "
+                f"scrape hazard)")
+        prev.setdefault(inc, os.path.basename(str(source)))
+    return problems
 
 
 # -- stitching -----------------------------------------------------------
@@ -476,13 +616,16 @@ def main(argv=None) -> int:
                          "report")
     args = ap.parse_args(argv)
 
-    trace_files, prom_files = gather_paths(args.paths)
+    trace_files, prom_files, decision_files, _keys = gather_paths(
+        args.paths)
     if args.prom_dir:
-        _t, extra = gather_paths([args.prom_dir])
+        _t, extra, _d, _k = gather_paths([args.prom_dir])
         prom_files += extra
     records, problems = load_all_traces(trace_files)
     if not records:
         problems.append(f"no trace records under {args.paths}")
+    decisions, decision_problems = load_decisions(decision_files)
+    problems += decision_problems
 
     prom_by_source: Dict[str, str] = {}
     for path in prom_files:
@@ -504,6 +647,7 @@ def main(argv=None) -> int:
     for source, text in prom_by_source.items():
         problems += [f"{source}: {p}"
                      for p in obs_report.check_prometheus_text(text)]
+    problems += check_identity(prom_by_source)
 
     stitched = stitch(records)
     stitch_problems = check_stitches(stitched)
@@ -517,12 +661,16 @@ def main(argv=None) -> int:
     slowest = sorted((st for st in stitched.values() if st.hops > 1),
                      key=lambda st: -st.duration_s)[:args.top]
 
+    ctrl = controller_summary(decisions) if decisions else None
+
     if args.json:
         out = dict(summary)
         out["latency_by_origin"] = latency
         out["slo"] = slo_table
         out["merged_latency_buckets"] = merged_hist
         out["broken_stitches"] = len(stitch_problems)
+        if ctrl is not None:
+            out["controller"] = ctrl
         out["warnings"] = warnings[:20]
         out["problems"] = problems[:20]
         print(json.dumps(out))
@@ -550,6 +698,23 @@ def main(argv=None) -> int:
             for bucket_len, slot in sorted(merged_hist.items()):
                 print(f"  bucket {bucket_len}: "
                       f"{int(slot['count'])} served")
+        if ctrl is not None:
+            print(f"\n-- controller: {ctrl['reconciles']} reconciles, "
+                  f"{len(ctrl['actions'])} actions, "
+                  f"{ctrl['stale_scrapes']} stale scrapes refused, "
+                  f"{ctrl['warm_submissions']} warm submissions, "
+                  f"{ctrl['resizes']} pool resizes --")
+            for act in ctrl["actions"][:20]:
+                what = act["replica"] or act["error"] or "?"
+                print(f"  reconcile {act['reconcile']}: "
+                      f"{act['verb']} {what}  ({act['reason']})")
+            for ro in ctrl["rollouts"]:
+                print(f"  rollout tag={ro['tag']} "
+                      f"converged={ro['converged']} "
+                      f"stragglers={ro['stragglers']}")
+            if ctrl["joined"] or ctrl["left"] or ctrl["swept"]:
+                print(f"  membership: joined={ctrl['joined']} "
+                      f"left={ctrl['left']} swept={ctrl['swept']}")
         print(f"\n-- top {args.top} slowest stitched traces --")
         if not slowest:
             print("(no multi-hop traces)")

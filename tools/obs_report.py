@@ -83,11 +83,11 @@ from alphafold2_tpu.utils.profiling import percentile  # noqa: E402
 # --check on any span name absent from it, so adding a span to the
 # serving stack without appending it here trips the very next smoke
 # phase instead of silently rendering at the bottom of the waterfall.
-STAGE_ORDER = ("featurize", "submit", "forward", "rpc", "queue",
-               "parked", "retry", "drain", "batch_form", "shard",
-               "compile", "fold", "recycle", "admit", "watchdog",
-               "resume", "writeback", "peer_fetch", "peer_serve",
-               "cache_lookup", "write")
+STAGE_ORDER = ("reconcile", "featurize", "submit", "forward", "rpc",
+               "queue", "parked", "retry", "drain", "batch_form",
+               "shard", "compile", "fold", "recycle", "admit",
+               "watchdog", "resume", "writeback", "peer_fetch",
+               "peer_serve", "cache_lookup", "write")
 
 # span/trace boundary slack: start_s, dur_s, and duration_s are each
 # INDEPENDENTLY rounded to 1e-6 when emitted, so a span auto-closed at

@@ -131,6 +131,27 @@ def parse_args(argv=None):
     ap.add_argument("--proc-drain-at", type=float, default=0.0,
                     help="rolling drain-restart (SIGTERM -> exit 0 -> "
                          "respawn) one replica after this fraction")
+    ap.add_argument("--controller", action="store_true",
+                    help="CONTROL PLANE (ISSUE 16, --procs only): arm "
+                         "FleetController on the ProcFleet — the "
+                         "reconcile loop owns membership, autoscaling, "
+                         "rollout convergence, pool resizing, and "
+                         "warming; the driver fires NO operator verbs "
+                         "(a killed replica is NOT restarted by the "
+                         "driver — the controller restores quorum)")
+    ap.add_argument("--scale-min", type=int, default=0,
+                    help="controller ScalingPolicy.min_replicas "
+                         "(0 = the --procs boot count)")
+    ap.add_argument("--scale-max", type=int, default=0,
+                    help="controller ScalingPolicy.max_replicas "
+                         "(0 = boot count + 2)")
+    ap.add_argument("--traffic-wave", default="",
+                    help="'F0:F1:MULT' — while the request counter is "
+                         "inside [F0, F1) of the budget, run MULT x "
+                         "--concurrency EXTRA submitter threads (their "
+                         "requests are on top of the budget): the "
+                         "traffic spike the controller must absorb by "
+                         "scaling up")
     ap.add_argument("--fleet", default="auto",
                     choices=("auto", "on", "off"),
                     help="wire replicas into one fleet (consistent-hash "
@@ -652,6 +673,11 @@ def main(argv=None) -> int:
         print("--slo requires --procs (the SLO harness drives the "
               "multi-process fleet; in-process modes attach an "
               "SLOEngine via serve.Scheduler(slo=) directly)",
+              file=sys.stderr)
+        return 2
+    if args.controller and not args.procs:
+        print("--controller requires --procs (the control plane "
+              "actuates ProcFleet's spawn/SIGTERM verbs)",
               file=sys.stderr)
         return 2
     if args.cross_bucket or args.eager_form:
@@ -1744,7 +1770,8 @@ def _run_fleet(args) -> int:
     return 0
 
 
-def _driver_slo_report(args, samples, chaos_t, kill_t):
+def _driver_slo_report(args, samples, chaos_t, kill_t,
+                       recovery_from=None):
     """Windowed SLO evaluation over the DRIVER's own observations
     (--procs mode): per-request completion times + latencies sliced
     into half-overlapping windows of --slo-window-s, each evaluated
@@ -1810,6 +1837,31 @@ def _driver_slo_report(args, samples, chaos_t, kill_t):
             (_burn(win) for win in windows
              if win["t1"] > kill_t and win["t0"] < kill_t + 15.0),
             default=0.0)
+    # the post-convergence recovery probe (controller mode), evaluated
+    # as ONE window per class: traffic served by the healed fleet
+    recovery = None
+    if recovery_from is not None:
+        rs = [s for s in samples if s["t"] >= recovery_from]
+        per_class = {}
+        for c in classes:
+            sel = [s for s in rs if c.covers(s["bucket"])]
+            ok = [s for s in sel if s["ok"]]
+            good = sum(1 for s in ok if s["lat"] <= c.target_s)
+            bad = sum(1 for s in sel if not s["ok"])
+            res = evaluate_class(c, good, len(ok), bad, len(sel))
+            per_class[c.name] = {
+                "requests": len(sel),
+                "latency_burn": res["latency"]["burn_rate"],
+                "attainment": res["latency"]["attainment"],
+            }
+        recovery = {
+            "from_t": round(recovery_from, 3),
+            "samples": len(rs),
+            "burn": max((v["latency_burn"]
+                         for v in per_class.values()), default=0.0),
+            "classes": per_class,
+            "latencies_s": [round(s["lat"], 3) for s in rs],
+        }
     return {
         "spec": args.slo,
         "window_s": w,
@@ -1822,6 +1874,7 @@ def _driver_slo_report(args, samples, chaos_t, kill_t):
         "max_burn_rate": max_burn,
         "kill_t": None if kill_t is None else round(kill_t, 3),
         "kill_window_burn": kill_burn,
+        "recovery": recovery,
     }
 
 
@@ -1870,8 +1923,16 @@ def _run_procs(args) -> int:
             cross_bucket=args.cross_bucket,
             cross_bucket_max_pad_frac=args.cross_bucket_max_pad_frac,
             eager_form=args.eager_form)),
-        slo=args.slo, slo_window_s=args.slo_window_s)
-    print(f"procfleet: starting {n} replica processes under {run_dir}",
+        slo=args.slo, slo_window_s=args.slo_window_s,
+        key_log=bool(args.controller),
+        controller=(None if not args.controller else dict(
+            {"min_replicas": args.scale_min} if args.scale_min else {},
+            **({"max_replicas": args.scale_max}
+               if args.scale_max else {}),
+            interval_s=0.5, heartbeat_timeout_s=4.0,
+            cooldown_s=6.0, warm=True)))
+    print(f"procfleet: starting {n} replica processes under {run_dir}"
+          + (" + controller" if args.controller else ""),
           file=sys.stderr)
     try:
         return _drive_procs(args, fleet, run_dir, model_tag,
@@ -1894,6 +1955,19 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
     n = args.procs
     lengths = tuple(int(x) for x in args.lengths.split(",") if x)
     deadline_s = args.deadline_s or None
+    controller_on = bool(args.controller)
+    wave = None
+    if args.traffic_wave:
+        try:
+            f0, f1, mult = args.traffic_wave.split(":")
+            wave = (float(f0), float(f1), int(mult))
+            if not (0.0 <= wave[0] < wave[1] <= 1.0) or wave[2] < 1:
+                raise ValueError(args.traffic_wave)
+        except ValueError:
+            print(f"serve_loadtest: bad --traffic-wave "
+                  f"{args.traffic_wave!r} (want F0:F1:MULT, "
+                  f"0 <= F0 < F1 <= 1, MULT >= 1)", file=sys.stderr)
+            return 2
     fleet.start()
 
     tracer = None
@@ -2000,6 +2074,11 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
         _note("kill", at_request=i, replica=kill_victim)
         rc = fleet.kill(kill_victim)
         _note("killed", rc=rc)
+        if controller_on:
+            # NO operator restart: the controller's reconcile loop
+            # must notice the missing endpoint and restore quorum by
+            # spawning a replacement — that's the thing under test
+            return
 
         def _restart():
             fleet.restart(kill_victim)
@@ -2042,23 +2121,7 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
         _note("drain_restarted", replica=drain_victim,
               healthz=fleet.healthz(drain_victim))
 
-    def run_submitter():
-        while True:
-            with lock:
-                i = counter[0]
-                if i >= budget:
-                    return
-                counter[0] = i + 1
-            if kill_at and i == kill_at:
-                _fire("kill", i, _do_kill)
-            if part_at and i == part_at:
-                _fire("partition", i, _do_partition)
-            if bump_at and i == bump_at:
-                rolled["tag"] = rolled_tag
-                _note("rollout", at_request=i,
-                      epochs=fleet.rollout(rolled_tag))
-            if drain_at and i == drain_at:
-                _fire("drain", i, _do_drain)
+    def _submit_one(i, via=None):
             proto = pool[schedule[i % len(schedule)]]
             req = serve.FoldRequest(seq=proto.seq, msa=proto.msa,
                                     deadline_s=deadline_s)
@@ -2085,13 +2148,14 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
                          "ok": ok})
 
             try:
-                resp = client.fold(req, hint=i % n, trace=trace)
+                resp = (via or client).fold(req, hint=i % n,
+                                            trace=trace)
             except Exception as exc:
                 trace.finish("error", error=repr(exc))
                 _sample(False)
                 with lock:
                     failures.append(repr(exc))
-                continue
+                return
             # the driver never folds: its traces are forwarded-sourced
             # so obs_report's fold-span rule applies to replica traces
             trace.finish(resp.status, source="forwarded",
@@ -2109,10 +2173,87 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
                         f"bad coords {resp.coords.shape} for "
                         f"n={req.length}")
 
+    def run_submitter():
+        while True:
+            with lock:
+                i = counter[0]
+                if i >= budget:
+                    return
+                counter[0] = i + 1
+            if kill_at and i == kill_at:
+                _fire("kill", i, _do_kill)
+            if part_at and i == part_at:
+                _fire("partition", i, _do_partition)
+            if bump_at and i == bump_at:
+                rolled["tag"] = rolled_tag
+                if controller_on:
+                    # ONE verb, controller-owned: fan-out with retry/
+                    # backoff + convergence check; stragglers and late
+                    # joiners are re-rolled by every later reconcile
+                    _note("rollout", at_request=i,
+                          report=fleet.controller.rollout(rolled_tag))
+                else:
+                    _note("rollout", at_request=i,
+                          epochs=fleet.rollout(rolled_tag))
+            if drain_at and i == drain_at:
+                _fire("drain", i, _do_drain)
+            _submit_one(i)
+
+    # --traffic-wave F0:F1:MULT: while the shared counter sits inside
+    # [F0, F1) of the budget, MULT x concurrency EXTRA threads submit
+    # on top of it — a spike the controller must absorb by scaling up
+    wave_counter = [0]
+
+    def run_wave_submitter():
+        lo = int(wave[0] * budget)
+        hi = int(wave[1] * budget)
+        while True:
+            with lock:
+                i = counter[0]
+            if i >= budget or i >= hi:
+                return
+            if i < lo:
+                time.sleep(0.02)
+                continue
+            with lock:
+                wave_counter[0] += 1
+                j = wave_counter[0]
+            _submit_one(budget + j)
+
     t0 = time.monotonic()
     run_t0[0] = t0
+
+    # with the controller on, a daemon watches the fleet's endpoint
+    # set: the driver's client learns controller-spawned replicas (so
+    # traffic actually reaches them) and the report gets a
+    # replicas-over-time series
+    replica_samples = []
+    mon_stop = threading.Event()
+
+    def _monitor():
+        while not mon_stop.is_set():
+            try:
+                eps = fleet.endpoints()
+                client.set_urls(list(eps.values()))
+                with events_lock:
+                    replica_samples.append(
+                        {"t": round(time.monotonic() - run_t0[0], 2),
+                         "replicas": len(eps)})
+            except Exception:
+                pass
+            mon_stop.wait(0.5)
+
+    monitor_thread = None
+    if controller_on:
+        monitor_thread = threading.Thread(target=_monitor, daemon=True)
+        monitor_thread.start()
+
     threads = [threading.Thread(target=run_submitter, daemon=True)
                for _ in range(max(args.concurrency, 1))]
+    if wave:
+        threads += [threading.Thread(target=run_wave_submitter,
+                                     daemon=True)
+                    for _ in range(max(args.concurrency, 1) * wave[2])]
     for t in threads:
         t.start()
     for t in threads:
@@ -2149,14 +2290,89 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
     for t in restart_threads:
         t.join(timeout=240)
 
-    # fleet-wide truth BEFORE teardown: per-replica stats + health
+    # with the controller on, the driver fired no recovery verbs —
+    # give the reconcile loop a bounded window to finish restoring
+    # quorum and converging the rollout before the truth snapshot
+    converged = {"replicas": not controller_on,
+                 "tag": not (controller_on and rolled["tag"])}
+    if controller_on:
+        target_min = args.scale_min or n
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            live_hz = {idx: hz for idx, hz in
+                       ((idx, fleet.healthz(idx))
+                        for idx in range(len(fleet.replicas)))
+                       if hz and hz.get("running")}
+            converged["replicas"] = len(live_hz) >= target_min
+            if rolled["tag"]:
+                live_tags = {(hz.get("model_tag") or hz.get("tag"))
+                             for hz in live_hz.values()}
+                converged["tag"] = live_tags == {rolled_tag}
+            if all(converged.values()):
+                break
+            time.sleep(0.5)
+        _note("converged", **converged)
+    # post-convergence recovery probe: the driver fired no recovery
+    # verbs, so the claim worth gating on is that the HEALED fleet —
+    # restored quorum, rolled replicas — serves within SLO. A
+    # replacement replica's boot can outlast the serving window on a
+    # slow machine, so the main run's tail windows can't show this;
+    # probe traffic after convergence can. Probes go through a FRESH
+    # client built from CURRENT membership (the long-lived client's
+    # failover set is add-only, so it still sprays the kill victim's
+    # dead seat and pays the deliberately heavy backoff — a penalty
+    # the healed fleet doesn't deserve) at the main run's concurrency
+    # (so batches form at the warmed shapes), after one unmeasured
+    # shakeout round that flushes any one-off cold compiles on the
+    # replacement. Reported as slo["recovery"].
+    recovery_from = None
+    probe_count = [0]
+    if controller_on and all(converged.values()) and args.slo:
+        probe_client = FleetClient(
+            list(fleet.endpoints().values()),
+            retry=client_retry, result_timeout_s=180.0)
+        conc = max(args.concurrency, 1)
+
+        def _run_probes(lo, hi):
+            ths = [threading.Thread(
+                target=lambda off=k: [_submit_one(i, via=probe_client)
+                                      for i in range(lo + off, hi,
+                                                     conc)],
+                daemon=True) for k in range(conc)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            probe_count[0] += hi - lo
+
+        shake_n = 2 * conc
+        probe_n = max(12, 4 * conc)
+        # sequential shakeout: single submits form batch-of-1, the
+        # one serving shape warmup doesn't pre-compile — flush that
+        # cold path per bucket before measuring
+        for i in range(budget, budget + shake_n):
+            _submit_one(i, via=probe_client)
+        probe_count[0] += shake_n
+        recovery_from = time.monotonic() - run_t0[0]
+        _run_probes(budget + shake_n, budget + shake_n + probe_n)
+        _note("recovery_probe", probes=probe_n, shakeout=shake_n,
+              from_t=round(recovery_from, 3))
+    mon_stop.set()
+    if monitor_thread is not None:
+        monitor_thread.join(timeout=10)
+
+    # fleet-wide truth BEFORE teardown: per-replica stats + health.
+    # Controller mode: a dead handle is an EXPECTED shape (the kill
+    # victim stays dead; its replacement is a new handle) — only live
+    # replicas owe a tag
     per_replica, stale_tag_hits, replica_failovers = {}, 0, 0
     tags = {}
     for i, h in enumerate(fleet.replicas):
         snap = fleet.stats(i)
         hz = fleet.healthz(i)
-        tags[h.replica_id] = (hz or {}).get("model_tag") or \
-            (hz or {}).get("tag")
+        if not controller_on or (hz and hz.get("running")):
+            tags[h.replica_id] = (hz or {}).get("model_tag") or \
+                (hz or {}).get("tag")
         if snap is None:
             per_replica[h.replica_id] = None
             continue
@@ -2218,14 +2434,19 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
     slo_report = None
     if args.slo and slo_samples:
         slo_report = _driver_slo_report(
-            args, slo_samples, chaos_t, chaos_t.get("kill"))
+            args, slo_samples, chaos_t, chaos_t.get("kill"),
+            recovery_from=recovery_from)
         if args.obs_fleet_out:
             with open(os.path.join(args.obs_fleet_out,
                                    "slo_driver.json"), "w") as fh:
                 json.dump(slo_report, fh, indent=1)
 
     expected_tag = rolled_tag if bump_at else model_tag
-    total = counter[0] + len(burst_box["tickets"])
+    total = counter[0] + len(burst_box["tickets"]) + wave_counter[0] \
+        + probe_count[0]
+    ctrl_snap = (fleet.controller.snapshot()
+                 if controller_on and fleet.controller is not None
+                 else None)
     report = {
         "metric": "serve_loadtest_procs",
         "platform": args.platform,
@@ -2252,6 +2473,13 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
         "slo": slo_report,
         "slo_gauges_scraped": scraped_slo_gauges,
         "obs_fleet_out": args.obs_fleet_out or None,
+        "controller": (None if ctrl_snap is None else dict(
+            ctrl_snap,
+            converged=converged,
+            replicas_over_time=replica_samples[-240:])),
+        "wave": (None if not wave else {
+            "window": [wave[0], wave[1]], "mult": wave[2],
+            "extra_requests": wave_counter[0]}),
         "failures": failures[:8],
     }
     print(json.dumps(report))
@@ -2279,6 +2507,22 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
         problems.append(f"replicas on the wrong tag after "
                         f"rollout/restart: {bad_tags} "
                         f"(expected {expected_tag!r})")
+    if controller_on:
+        if not converged["replicas"]:
+            problems.append(
+                f"controller never restored quorum "
+                f"(live < {args.scale_min or n} after the grace "
+                f"window, zero operator verbs fired)")
+        if rolled["tag"] and not converged["tag"]:
+            problems.append(
+                "controller never converged the rollout on the live "
+                "replicas")
+        if kill_at and ctrl_snap is not None \
+                and ctrl_snap.get("scale_ups", 0) < 1:
+            problems.append(
+                "kill fired but the controller recorded no scale_up "
+                "action (quorum restore should have spawned a "
+                "replacement)")
     if tracer is not None and not span_counts.get("rpc"):
         problems.append("no rpc spans in the merged traces")
     if tracer is not None and drain_at and not span_counts.get("drain"):

@@ -158,6 +158,22 @@
 #      transport-death failover never leaves a dangling span) — and
 #      at least one multi-hop stitched trace exists. The
 #      fleet-observability tripwire.
+#  15. control-plane actuation (ISSUE 16, --controller): a 3-process
+#      fleet run by its OWN FleetController — the driver fires ZERO
+#      operator recovery verbs. Chaos: a traffic wave (2x extra
+#      submitters over the middle of the run), one kill -9 (NOT
+#      restarted by the driver — the controller must notice the
+#      missing endpoint and spawn a replacement to restore quorum),
+#      and a mid-run rollout issued through the controller's one
+#      retry/backoff/convergence verb. FAILS unless every request
+#      resolves ok with 0 lost, quorum and the rolled tag converge on
+#      the live replicas, the controller recorded >= 1 scale_up, a
+#      post-convergence recovery probe through the HEALED fleet
+#      (replacement included) attains its SLO targets, obs_fleet --check
+#      is green over traces + scrapes + the controller's decision log
+#      (including the replica-identity pins), and cache_warm
+#      --from-serve-log can rebuild a warm profile from the run's own
+#      keys.jsonl telemetry. The fleet-runs-itself tripwire.
 #   7. multi-chip mesh serving (--mesh-policy, serve.MeshPolicy) under
 #      XLA_FLAGS=--xla_force_host_platform_device_count=8: a mixed
 #      short+long workload where the long bucket is pinned to a 4-chip
@@ -190,7 +206,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 DURATION="${SMOKE_DURATION_S:-30}"
-PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8,9,10,11,12,13,14}"
+PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8,9,10,11,12,13,14,15}"
 
 phase_on() {
     case ",${PHASES}," in
@@ -1059,6 +1075,136 @@ print(f"OBS-FLEET SMOKE OK: {agg['stitched_traces']} stitched traces "
       f"0 broken stitches, kill-window burn "
       f"{slo['kill_window_burn']:.2f} (max {slo['max_burn_rate']:.2f}),"
       f" {run['slo_gauges_scraped']} slo gauge lines scraped",
+      file=sys.stderr)
+EOF
+fi
+
+# phase 15: control-plane actuation (ISSUE 16) — the fleet runs
+# itself. 3 replica processes + FleetController; the driver submits
+# traffic and chaos (wave + kill -9 + rollout) but fires NO recovery
+# verbs: the controller restores quorum after the kill, converges the
+# rollout on stragglers/late joiners, resizes pools, and warms from
+# the fleet's own key telemetry. obs_fleet --check must be green over
+# traces + scrapes + controller decisions (identity pins included),
+# and cache_warm --from-serve-log must rebuild a profile from the
+# run's keys.jsonl.
+if phase_on 15; then
+rm -rf /tmp/serve_smoke_ctrl /tmp/serve_smoke_ctrl_out \
+       /tmp/serve_smoke_ctrl_warmcache
+rm -f /tmp/serve_smoke_ctrl_traces.jsonl
+
+timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/serve_loadtest.py \
+    --smoke \
+    --procs 3 \
+    --controller \
+    --scale-min 3 \
+    --scale-max 5 \
+    --traffic-wave 0.10:0.40:1 \
+    --proc-kill-at 0.35 \
+    --rollout-at 0.55 \
+    --requests 48 \
+    --lengths 24,48 \
+    --buckets 32,64 \
+    --msa-depth 3 \
+    --max-batch 2 \
+    --concurrency 3 \
+    --deadline-s 120 \
+    --num-recycles 0 \
+    --slo 32=auto,all=auto \
+    --slo-window-s 3 \
+    --obs-fleet-out /tmp/serve_smoke_ctrl_out \
+    --proc-run-dir /tmp/serve_smoke_ctrl \
+    --trace-path /tmp/serve_smoke_ctrl_traces.jsonl \
+    > /tmp/serve_smoke_ctrl.json
+cat /tmp/serve_smoke_ctrl.json
+
+# merged traces + run dir (controller traces, decision log, keys) +
+# scrapes through the fleet aggregator — identity pins included
+timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/obs_fleet.py /tmp/serve_smoke_ctrl_traces.jsonl \
+    /tmp/serve_smoke_ctrl \
+    --prom-dir /tmp/serve_smoke_ctrl_out \
+    --check --json > /tmp/serve_smoke_ctrl_fleet.json
+cat /tmp/serve_smoke_ctrl_fleet.json
+
+# the telemetry-driven warm: rebuild a profile from the run's own
+# keys.jsonl records and warm its head into a fresh cache dir
+timeout -k 10 300 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/cache_warm.py \
+    --from-serve-log /tmp/serve_smoke_ctrl \
+    --top 2 \
+    --cache-dir /tmp/serve_smoke_ctrl_warmcache \
+    --model-tag procfleet@v1+rolled \
+    --msa-depth 3 \
+    > /tmp/serve_smoke_ctrl_warm.json
+cat /tmp/serve_smoke_ctrl_warm.json
+
+env -u PYTHONPATH python - <<'EOF'
+import json, sys
+run = json.load(open("/tmp/serve_smoke_ctrl.json"))
+agg = json.load(open("/tmp/serve_smoke_ctrl_fleet.json"))
+warm = json.load(open("/tmp/serve_smoke_ctrl_warm.json"))
+problems = []
+ctrl = run.get("controller") or {}
+conv = ctrl.get("converged") or {}
+if not conv.get("replicas"):
+    problems.append("controller never restored quorum")
+if not conv.get("tag"):
+    problems.append("controller never converged the rollout")
+if ctrl.get("scale_ups", 0) < 1:
+    problems.append("no controller scale_up recorded after the kill")
+if run.get("lost", 0):
+    problems.append(f"{run['lost']} LOST requests")
+wave = run.get("wave") or {}
+if wave.get("extra_requests", 0) <= 0:
+    problems.append("traffic wave submitted no extra requests")
+slo = run.get("slo") or {}
+if not slo.get("kill_window_burn"):
+    problems.append("kill fired but no SLO burn in the killed window")
+# recovery is proven by traffic on the HEALED fleet, not by the main
+# run's tail (the replacement's boot can outlast the serving window
+# on a slow machine): the post-convergence probe must burn nothing
+rec = slo.get("recovery") or {}
+if not rec.get("samples"):
+    problems.append("no post-convergence recovery probe samples")
+else:
+    # gate fleet-wide attainment at a bar the probe's sample size can
+    # support (>= 0.9 over ~12 probes tolerates one cold-path
+    # straggler; the per-bucket classes are reported, not gated)
+    att = ((rec.get("classes") or {}).get("all")
+           or {}).get("attainment", 0.0)
+    if att < 0.9:
+        problems.append(
+            f"healed fleet still degraded: recovery probe "
+            f"attainment {att:.2f} < 0.90 over {rec['samples']} "
+            f"probes (burn {rec.get('burn', 0):.2f}, "
+            f"latencies {rec.get('latencies_s')})")
+if agg.get("problems"):
+    problems.append(f"obs_fleet check problems: {agg['problems'][:3]}")
+actrl = agg.get("controller") or {}
+if actrl.get("reconciles", 0) <= 0:
+    problems.append("obs_fleet saw no controller reconcile decisions")
+if warm.get("profile_source") != "serve_log" or \
+        warm.get("unique_in_profile", 0) <= 0:
+    problems.append(f"cache_warm --from-serve-log found no key "
+                    f"telemetry ({warm.get('unique_in_profile')})")
+if warm.get("predicted_hit_ratio", 0.0) <= 0.0:
+    problems.append("warm predicted_hit_ratio is 0")
+if problems:
+    print("CONTROL-PLANE SMOKE FAIL: " + "; ".join(problems),
+          file=sys.stderr)
+    sys.exit(1)
+print(f"CONTROL-PLANE SMOKE OK: zero operator verbs — "
+      f"{ctrl.get('scale_ups')} scale-up(s), quorum + rollout "
+      f"converged, {wave.get('extra_requests')} wave requests "
+      f"absorbed, recovery probe attainment "
+      f"{((rec.get('classes') or {}).get('all') or {}).get('attainment', 0):.2f} "
+      f"over {rec.get('samples')} probes on the healed fleet, "
+      f"{actrl.get('reconciles')} reconciles logged, "
+      f"warm from telemetry predicted "
+      f"{warm.get('predicted_hit_ratio'):.2f} "
+      f"(realized {warm.get('realized_hit_ratio'):.2f})",
       file=sys.stderr)
 EOF
 fi
