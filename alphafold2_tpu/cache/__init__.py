@@ -13,6 +13,12 @@ pieces, each usable alone:
             LRU + TTL over an atomic-write disk tier with quarantine),
             parameterized on encode/decode; both stores below re-base
             on it (ISSUE 13)
+- checkpoints: CheckpointStore — durable per-row MID-LOOP carry
+            spills keyed by (fold_key, model_tag, age), rebased on
+            ByteStore's disk tier with optional object-store backend
+            and peer tiers, so an interrupted step-loop fold resumes
+            at its checkpointed age on any replica (ISSUE 18;
+            `serve.RetryPolicy(checkpoint_spill=...)`)
 - store:    FoldCache — ByteStore over encode_fold/decode_fold plus
             the fold-specific stats, gauges, and peer tier;
             corruption == miss
@@ -31,6 +37,11 @@ deduplication").
 """
 
 from alphafold2_tpu.cache.bytestore import ByteStore  # noqa: F401
+from alphafold2_tpu.cache.checkpoints import (CheckpointStore,  # noqa: F401
+                                              RowCheckpoint,
+                                              checkpoint_group,
+                                              decode_checkpoint,
+                                              encode_checkpoint)
 from alphafold2_tpu.cache.coalesce import InflightRegistry  # noqa: F401
 from alphafold2_tpu.cache.features import (FeatureCache,  # noqa: F401
                                            FeaturizedInput,

@@ -222,6 +222,67 @@ class ByteStore:
             except OSError:
                 pass
 
+    # -- disk iteration ---------------------------------------------------
+
+    def keys(self, prefix: str = ""):
+        """Keys present in the DISK tier (sorted), optionally filtered
+        by prefix. Point lookups were the only read path until the
+        checkpoint store needed boot-time discovery (ISSUE 18): a
+        restarted replica has to enumerate what survived it, not ask
+        for keys it no longer remembers. Expired entries are swept
+        here, not just skipped — TTL enforced only on `get` left a
+        scan able to resurrect a stale key (the ISSUE-18 bugfix);
+        quarantined files never enumerate."""
+        if not self.disk_dir:
+            return []
+        now = self._clock()
+        out = []
+        # fan-out dirs are key[:2]; a prefix >= 2 chars pins the dir
+        subdirs = ([prefix[:2]] if len(prefix) >= 2
+                   else sorted(d for d in self._listdir(self.disk_dir)
+                               if len(d) == 2))
+        for sub in subdirs:
+            root = os.path.join(self.disk_dir, sub)
+            for name in sorted(self._listdir(root)):
+                if not name.endswith(".npz"):
+                    continue           # quarantined / tmp leftovers
+                key = name[:-len(".npz")]
+                if prefix and not key.startswith(prefix):
+                    continue
+                path = os.path.join(root, name)
+                if self.ttl_s is not None:
+                    try:
+                        if now >= os.path.getmtime(path) + self.ttl_s:
+                            self._on_event("expirations")
+                            try:
+                                os.remove(path)
+                            except OSError:
+                                pass
+                            continue
+                    except OSError:
+                        continue       # raced a concurrent sweep
+                out.append(key)
+        return out
+
+    def scan(self, prefix: str = "", trace=NULL_TRACE):
+        """Iterate (key, value) over the disk tier, optionally
+        prefix-filtered. Rides `keys()` so expired entries are swept,
+        and `disk_get` so corrupt entries quarantine to a miss instead
+        of raising into the caller's boot path."""
+        for key in self.keys(prefix):
+            hit = self.disk_get(key, trace)
+            if hit is None:
+                continue
+            value, _expires_at = hit
+            yield key, value
+
+    @staticmethod
+    def _listdir(path: str):
+        try:
+            return os.listdir(path)
+        except OSError:
+            return []
+
     # -- composed lookup -------------------------------------------------
 
     def lookup(self, key: str, trace=NULL_TRACE):

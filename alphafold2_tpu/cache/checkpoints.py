@@ -1,0 +1,575 @@
+"""Durable step-loop checkpoints: the migratable-fold store (ISSUE 18).
+
+PR 14's `_StepCheckpoint` lives in the serving process's host memory —
+it survives transient step failures and watchdog fires, but a kill -9
+mid-flagship-loop still refolds from recycle 0. This module makes the
+checkpoint a DURABLE, MIGRATABLE artifact: one npz payload per batch
+ROW (the fold is the unit of migration, not the batch it happened to
+share a device slice with), carrying exactly what a resuming replica
+needs to continue that fold mid-loop:
+
+- the row's slice of the step carry (`predict.snapshot_step_state`
+  leaves, sliced on the batch axis, each with a portable sharding SPEC
+  so a mesh-sharded carry re-places on restore);
+- the row's host inputs (unpadded seq + msa tokens — enough to verify
+  the resumed request is byte-identical work);
+- the recycle age the carry was captured at.
+
+`CheckpointStore` rebases on `cache/bytestore.py` (atomic disk writes,
+TTL, quarantine, and the new `keys()`/`scan()` iteration this store
+motivated) and is keyed by `(fold_key, model_tag, age)`:
+`checkpoint_key` digests fold_key + model_tag into a GROUP prefix and
+appends the age, so every age of one fold shares a prefix —
+`latest()` is a prefix scan, boot discovery (`survivors()`) is a full
+scan, and a rollout's tag bump makes old checkpoints unreachable by
+lookup and actively DISCARDED by scan (stale-tag resume is the one
+unforgivable failure mode: a new model must never continue an old
+model's carry). Older ages are pruned after each newer spill, so the
+disk holds one checkpoint per in-flight fold.
+
+Tiering mirrors the fold cache: local disk is authoritative; an
+optional `ObjectStoreBackend` mirror (one object per fold group, the
+shared-volume path) and an optional peer tier (duck-typed
+`fetch_checkpoint(group, tag) -> bytes | None`, served by
+`fleet.peer.PeerCacheServer`'s `kind=checkpoint` route) let a failover
+owner resume a dead replica's fold mid-loop — the fleet hand-off half
+of ISSUE 18. Every tier carries the same self-identifying bytes and
+validates with the same `decode_checkpoint`.
+
+The treedef is deliberately NOT on the wire: the resuming scheduler
+already initializes the row through the normal admission path (the
+row-masked init program), then overwrites the row's leaves with the
+decoded carry — leaf ORDER is deterministic for one model structure,
+and a leaf-count/shape mismatch is a validation failure (discard +
+refold-from-zero), never a guess.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from alphafold2_tpu.cache.bytestore import ByteStore
+from alphafold2_tpu.obs.registry import MetricsRegistry, get_registry
+from alphafold2_tpu.obs.trace import NULL_TRACE
+from alphafold2_tpu.utils.hashing import stable_digest
+
+# bump when the payload's fields or meaning change: old spills must
+# MISS (and be discarded), never resume into the wrong semantics
+CHECKPOINT_SCHEMA = "ckpt-v1"
+
+# JSON-able reference-leaf types the wire can carry; anything else
+# makes the row unspillable (counted, skipped — never a torn payload)
+_REF_TYPES = (bool, int, float, str, type(None))
+
+
+def checkpoint_group(fold_key: str, model_tag: str = "") -> str:
+    """Prefix shared by every age of one fold's checkpoints."""
+    return stable_digest(CHECKPOINT_SCHEMA, fold_key, model_tag)
+
+
+def checkpoint_key(fold_key: str, model_tag: str = "",
+                   age: int = 0) -> str:
+    """(fold_key, model_tag, age) -> store key. Zero-padded age keeps
+    lexicographic order == age order within a group's prefix scan."""
+    return f"{checkpoint_group(fold_key, model_tag)}-a{int(age):08d}"
+
+
+def key_age(key: str) -> int:
+    """Age component of a `checkpoint_key` (raises on malformed)."""
+    return int(key.rsplit("-a", 1)[1])
+
+
+# -- sharding specs --------------------------------------------------------
+
+
+def sharding_spec(sharding) -> Optional[dict]:
+    """Portable descriptor of a leaf's sharding — enough to re-place a
+    NamedSharding on a same-shaped mesh of the RESUMING process's
+    devices. Anything else (single-device, positional, None) restores
+    through default placement, exactly `restore_step_state`'s
+    fallback."""
+    if sharding is None:
+        return None
+    try:
+        mesh = getattr(sharding, "mesh", None)
+        spec = getattr(sharding, "spec", None)
+        if mesh is None or spec is None:
+            return None
+        axes, sizes = zip(*mesh.shape.items()) if mesh.shape else ((), ())
+        return {"kind": "named",
+                "axes": list(axes),
+                "sizes": [int(s) for s in sizes],
+                "spec": [list(p) if isinstance(p, (tuple, list))
+                         else p for p in tuple(spec)]}
+    except Exception:
+        return None
+
+
+def sharding_from_spec(desc: Optional[dict]):
+    """Rebuild a NamedSharding from a spec on THIS process's devices;
+    None when the spec is absent or the device count no longer fits
+    (default placement — the restore path's existing fallback)."""
+    if not desc or desc.get("kind") != "named":
+        return None
+    try:
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        sizes = [int(s) for s in desc["sizes"]]
+        need = int(np.prod(sizes)) if sizes else 1
+        devices = jax.devices()
+        if len(devices) < need:
+            return None
+        mesh = Mesh(np.asarray(devices[:need]).reshape(sizes),
+                    tuple(desc["axes"]))
+        parts = [tuple(p) if isinstance(p, list) else p
+                 for p in desc["spec"]]
+        return NamedSharding(mesh, PartitionSpec(*parts))
+    except Exception:
+        return None
+
+
+# -- the payload -----------------------------------------------------------
+
+
+@dataclass
+class RowCheckpoint:
+    """One fold's mid-loop state: everything a resuming replica needs
+    to continue THIS row at `age` recycles, detached from the batch it
+    was sharing. `leaves` holds the row's slice of the flattened step
+    carry in `snapshot_step_state` order — ("dev", (1, ...) np array,
+    sharding spec) or ("ref", json-able scalar, None)."""
+
+    fold_key: str
+    model_tag: str
+    age: int
+    seq: np.ndarray                       # (L,) int32, unpadded
+    msa: Optional[np.ndarray] = None      # (m, L) int32 or None
+    leaves: List[tuple] = field(default_factory=list)
+    created_s: float = 0.0
+
+    @property
+    def nbytes(self) -> int:
+        n = self.seq.nbytes + (0 if self.msa is None else self.msa.nbytes)
+        for kind, val, _spec in self.leaves:
+            if kind == "dev":
+                n += val.nbytes
+        return n
+
+    def state_entries(self) -> List[tuple]:
+        """`restore_step_state`-shaped entries (kind, value, sharding)
+        with each spec rebuilt into a live sharding (or None): the
+        resume path re-uploads THROUGH the recorded placement, PR 14's
+        restore contract."""
+        return [(kind, val, sharding_from_spec(spec) if kind == "dev"
+                 else None)
+                for kind, val, spec in self.leaves]
+
+    def restore_leaves(self) -> list:
+        """Decoded leaves re-placed on device via the PR 14 restore
+        path (`predict.restore_step_state` over a flat list treedef):
+        device leaves go back through their recorded sharding spec with
+        default-device fallback, references pass through."""
+        import jax
+
+        from alphafold2_tpu import predict
+        entries = self.state_entries()
+        treedef = jax.tree_util.tree_structure([0] * len(entries))
+        return list(predict.restore_step_state((treedef, entries)))
+
+
+def row_checkpoint(snapshot, row: int, *, fold_key: str,
+                   model_tag: str, age: int, seq: np.ndarray,
+                   msa: Optional[np.ndarray] = None,
+                   clock=time.time) -> RowCheckpoint:
+    """Slice row `row` out of a full-batch `snapshot_step_state`
+    result. Raises ValueError when the carry is not row-sliceable (a
+    dev leaf without a batch axis, or an opaque reference leaf the
+    wire cannot carry) — the caller counts and skips the spill, it
+    never writes a partial payload."""
+    _treedef, entries = snapshot
+    leaves: List[tuple] = []
+    for kind, val, sharding in entries:
+        if kind == "dev":
+            arr = np.asarray(val)
+            if arr.ndim < 1 or arr.shape[0] <= row:
+                raise ValueError(
+                    f"carry leaf shape {arr.shape} has no row {row}")
+            leaves.append(("dev", np.ascontiguousarray(arr[row:row + 1]),
+                           sharding_spec(sharding)))
+        else:
+            if not isinstance(val, _REF_TYPES):
+                raise ValueError(
+                    f"opaque reference leaf {type(val).__name__} is "
+                    f"not wire-able")
+            leaves.append(("ref", val, None))
+    return RowCheckpoint(
+        fold_key=fold_key, model_tag=model_tag, age=int(age),
+        seq=np.asarray(seq, np.int32),
+        msa=None if msa is None else np.asarray(msa, np.int32),
+        leaves=leaves, created_s=float(clock()))
+
+
+# -- wire format -----------------------------------------------------------
+
+
+def encode_checkpoint(key: str, ckpt: RowCheckpoint) -> bytes:
+    """Self-identifying npz bytes — the disk tier, the peer
+    `kind=checkpoint` route, and object-store mirrors all carry
+    exactly these; every tier validates with `decode_checkpoint`."""
+    meta = {"schema": CHECKPOINT_SCHEMA, "key": key,
+            "fold_key": ckpt.fold_key, "model_tag": ckpt.model_tag,
+            "age": int(ckpt.age), "created_s": float(ckpt.created_s),
+            "msa": ckpt.msa is not None,
+            "kinds": [kind for kind, _v, _s in ckpt.leaves],
+            "shardings": [spec for kind, _v, spec in ckpt.leaves],
+            "refs": {str(i): val
+                     for i, (kind, val, _s) in enumerate(ckpt.leaves)
+                     if kind == "ref"},
+            "dtypes": [str(np.asarray(v).dtype) if kind == "dev" else None
+                       for kind, v, _s in ckpt.leaves]}
+    arrays = {"meta": np.frombuffer(
+        json.dumps(meta).encode("utf-8"), np.uint8), "seq": ckpt.seq}
+    if ckpt.msa is not None:
+        arrays["msa"] = ckpt.msa
+    for i, (kind, val, _spec) in enumerate(ckpt.leaves):
+        if kind == "dev":
+            arrays[f"leaf_{i:05d}"] = val
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def decode_checkpoint(key: str, data: bytes) -> RowCheckpoint:
+    """Parse + validate `encode_checkpoint` bytes. Raises on anything
+    wrong (unreadable, schema drift, key mismatch, leaf bookkeeping
+    nonsense); callers translate into miss + quarantine. Model-tag
+    POLICY (discard vs serve) stays with `CheckpointStore` — the codec
+    only guarantees the payload says what it is."""
+    with np.load(io.BytesIO(data)) as z:
+        meta = json.loads(bytes(z["meta"]).decode("utf-8"))
+        if meta.get("schema") != CHECKPOINT_SCHEMA:
+            raise ValueError(f"checkpoint {key}: schema "
+                             f"{meta.get('schema')!r}")
+        if meta.get("key") != key:
+            raise ValueError(f"checkpoint {key}: embedded key mismatch")
+        kinds = list(meta["kinds"])
+        shardings = list(meta["shardings"])
+        refs = dict(meta.get("refs", {}))
+        dtypes = list(meta.get("dtypes") or [None] * len(kinds))
+        if len(shardings) != len(kinds):
+            raise ValueError(f"checkpoint {key}: leaf bookkeeping "
+                             f"mismatch")
+        leaves: List[tuple] = []
+        for i, kind in enumerate(kinds):
+            if kind == "dev":
+                arr = np.asarray(z[f"leaf_{i:05d}"])
+                if dtypes[i] and str(arr.dtype) != dtypes[i]:
+                    # npz round-trips extension dtypes (ml_dtypes
+                    # bfloat16) as opaque void bytes — re-view through
+                    # the recorded dtype string, byte-identical
+                    arr = arr.view(np.dtype(dtypes[i]))
+                if arr.ndim < 1 or arr.shape[0] != 1:
+                    raise ValueError(
+                        f"checkpoint {key}: leaf {i} is not one row")
+                leaves.append(("dev", arr, shardings[i]))
+            elif kind == "ref":
+                if str(i) not in refs:
+                    raise ValueError(
+                        f"checkpoint {key}: ref leaf {i} missing")
+                leaves.append(("ref", refs[str(i)], None))
+            else:
+                raise ValueError(
+                    f"checkpoint {key}: unknown leaf kind {kind!r}")
+        ckpt = RowCheckpoint(
+            fold_key=str(meta["fold_key"]),
+            model_tag=str(meta["model_tag"]),
+            age=int(meta["age"]),
+            seq=np.asarray(z["seq"], np.int32),
+            msa=(np.asarray(z["msa"], np.int32)
+                 if meta.get("msa") else None),
+            leaves=leaves, created_s=float(meta.get("created_s", 0.0)))
+    if ckpt.age < 0 or ckpt.seq.ndim != 1:
+        raise ValueError(f"checkpoint {key} fails validation")
+    return ckpt
+
+
+# -- the store -------------------------------------------------------------
+
+
+class CheckpointStats:
+    """Thread-safe outcome counters, mirrored into the registry as
+    `fold_checkpoint_events_total{event=...}` (minted only when a
+    store is constructed — a spill-off scheduler's metric-name set is
+    untouched)."""
+
+    FIELDS = ("spills", "spill_errors", "hits", "misses", "discards",
+              "stale_tag_discards", "expirations", "disk_errors",
+              "peer_hits", "backend_hits")
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self._lock = threading.Lock()
+        for f in self.FIELDS:
+            setattr(self, f, 0)
+        self._m_events = (registry or get_registry()).counter(
+            "fold_checkpoint_events_total",
+            "durable step-checkpoint store outcomes", ("event",))
+
+    def bump(self, field: str, n: int = 1):
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+        self._m_events.inc(n, event=field)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {f: getattr(self, f) for f in self.FIELDS}
+
+
+class CheckpointStore:
+    """Durable (fold_key, model_tag, age)-keyed row checkpoints over a
+    ByteStore disk tier, with optional object-store mirror and peer
+    fallback.
+
+    disk_dir: the spill directory (the `RetryPolicy(checkpoint_spill=)`
+        knob's value). Required — a memory-only durable store is a
+        contradiction.
+    model_tag: the serving model identity; `latest`/`survivors` DISCARD
+        any decoded payload whose tag differs (counted
+        `stale_tag_discards`) — a rolled-out model never continues an
+        old model's carry.
+    ttl_s: disk TTL; swept on scan as well as get (the ISSUE-18
+        ByteStore fix), so boot discovery never resurrects a fold
+        nobody has asked about for ttl_s.
+    backend: optional `fleet.object_store.ObjectStoreBackend` mirror —
+        one object per fold GROUP (latest age wins), so a shared
+        volume serves fail-over resume with zero peer servers.
+    peer: optional duck-typed `fetch_checkpoint(group, model_tag) ->
+        bytes | None` (fleet.peer.PeerCacheClient) consulted on local
+        + backend miss.
+    """
+
+    def __init__(self, disk_dir: str, *, model_tag: str = "",
+                 ttl_s: Optional[float] = None,
+                 backend=None, peer=None,
+                 registry: Optional[MetricsRegistry] = None,
+                 clock=time.time):
+        if not disk_dir:
+            raise ValueError("CheckpointStore needs a disk_dir")
+        self.model_tag = str(model_tag)
+        self.backend = backend
+        self.peer = peer
+        self.stats = CheckpointStats(registry)
+        self._clock = clock
+        self.store = ByteStore(
+            encode=encode_checkpoint, decode=decode_checkpoint,
+            max_bytes=0, max_entries=0,      # durable tier only
+            ttl_s=ttl_s, disk_dir=disk_dir, clock=clock,
+            on_event=self._on_store_event,
+            quarantine_event="checkpoint_quarantine")
+
+    def _on_store_event(self, fld: str, n: int = 1):
+        if fld in ("expirations", "disk_errors"):
+            self.stats.bump(fld, n)
+
+    # -- keys --------------------------------------------------------------
+
+    def group(self, fold_key: str) -> str:
+        return checkpoint_group(fold_key, self.model_tag)
+
+    # -- spill -------------------------------------------------------------
+
+    def put_row(self, ckpt: RowCheckpoint) -> Optional[str]:
+        """Spill one row checkpoint; prunes the group's older ages so
+        the tier holds exactly the latest. Returns the store key, or
+        None on failure (counted — a spill error must never fail the
+        step loop it rode along with)."""
+        try:
+            key = checkpoint_key(ckpt.fold_key, self.model_tag,
+                                 ckpt.age)
+            self.store.disk_put(key, ckpt)
+            prefix = self.group(ckpt.fold_key)
+            for old in self.store.keys(prefix):
+                if old != key:
+                    self._remove(old)
+            if self.backend is not None:
+                try:
+                    self.backend.put(prefix,
+                                     encode_checkpoint(key, ckpt))
+                except Exception:
+                    pass               # mirror is best-effort
+            self.stats.bump("spills")
+            return key
+        except Exception:
+            self.stats.bump("spill_errors")
+            return None
+
+    # -- resume lookups ----------------------------------------------------
+
+    def latest(self, fold_key: str,
+               trace=NULL_TRACE) -> Optional[RowCheckpoint]:
+        """Newest-age checkpoint for `fold_key` under THIS store's
+        model tag: local disk, then the object-store mirror, then the
+        peer tier. Stale-tag payloads (possible through mirror/peer
+        bytes, impossible through local keys) are discarded."""
+        prefix = self.group(fold_key)
+        keys = self.store.keys(prefix)
+        if keys:
+            key = max(keys, key=key_age)
+            hit = self.store.disk_get(key, trace)
+            if hit is not None:
+                ckpt, _expires = hit
+                if self._tag_ok(ckpt):
+                    self.stats.bump("hits")
+                    return ckpt
+                self._remove(key)
+        for source, fetch in (("backend", self._backend_fetch),
+                              ("peer", self._peer_fetch)):
+            ckpt = fetch(fold_key, prefix, trace)
+            if ckpt is not None:
+                self.stats.bump(f"{source}_hits")
+                self.stats.bump("hits")
+                # promote: a migrated fold's next spill/discard is local
+                self.put_row(ckpt)
+                return ckpt
+        self.stats.bump("misses")
+        return None
+
+    def _backend_fetch(self, fold_key: str, prefix: str,
+                       trace) -> Optional[RowCheckpoint]:
+        if self.backend is None:
+            return None
+        try:
+            data = self.backend.get(prefix)
+            if data is None:
+                return None
+            ckpt = decode_checkpoint(
+                checkpoint_key(fold_key, self.model_tag,
+                               _peek_age(data)), data)
+        except Exception:
+            # shared-store quarantine analogue: a corrupt object costs
+            # every replica a failed parse until someone deletes it
+            try:
+                self.backend.delete(prefix)
+            except Exception:
+                pass
+            self.stats.bump("disk_errors")
+            return None
+        if not self._tag_ok(ckpt) or ckpt.fold_key != fold_key:
+            try:
+                self.backend.delete(prefix)
+            except Exception:
+                pass
+            return None
+        trace.event("peer_fetch", peer="object_store", outcome="hit")
+        return ckpt
+
+    def _peer_fetch(self, fold_key: str, prefix: str,
+                    trace) -> Optional[RowCheckpoint]:
+        if self.peer is None:
+            return None
+        try:
+            data = self.peer.fetch_checkpoint(prefix, self.model_tag)
+            if data is None:
+                return None
+            ckpt = decode_checkpoint(
+                checkpoint_key(fold_key, self.model_tag,
+                               _peek_age(data)), data)
+        except Exception:
+            return None
+        if not self._tag_ok(ckpt) or ckpt.fold_key != fold_key:
+            return None
+        return ckpt
+
+    def latest_raw(self, group: str) -> Optional[bytes]:
+        """Raw wire bytes of a group's newest checkpoint — the peer
+        server's read path (`kind=checkpoint`), mirroring
+        `FoldCache.read_raw`: the serving side never decodes."""
+        keys = self.store.keys(group)
+        if not keys:
+            return None
+        try:
+            with open(self.store.path(max(keys, key=key_age)),
+                      "rb") as fh:
+                return fh.read()
+        except OSError:
+            return None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def discard(self, fold_key: str):
+        """Drop every age of one fold (resolved, cancelled, or
+        poisoned: the checkpoint must not outlive the work)."""
+        prefix = self.group(fold_key)
+        removed = 0
+        for key in self.store.keys(prefix):
+            removed += self._remove(key)
+        if self.backend is not None:
+            try:
+                self.backend.delete(prefix)
+            except Exception:
+                pass
+        if removed:
+            self.stats.bump("discards", removed)
+
+    def survivors(self, trace=NULL_TRACE
+                  ) -> Iterator[Tuple[str, RowCheckpoint]]:
+        """Boot-time discovery: every (store_key, checkpoint) the disk
+        tier holds under THIS model tag, newest age per group. Expired
+        entries are swept by the scan itself; decoded payloads whose
+        tag mismatches (an old tag's leftovers after a rollout) are
+        discarded + counted, never yielded — a restarted replica can
+        trust every survivor it sees."""
+        newest: dict = {}
+        for key in self.store.keys():
+            group = key.rsplit("-a", 1)[0]
+            prev = newest.get(group)
+            if prev is None or key_age(key) > key_age(prev):
+                newest[group] = key
+        for group in sorted(newest):
+            key = newest[group]
+            hit = self.store.disk_get(key, trace)
+            if hit is None:
+                continue
+            ckpt, _expires = hit
+            if not self._tag_ok(ckpt):
+                for stale in self.store.keys(group):
+                    self._remove(stale)
+                continue
+            yield key, ckpt
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _tag_ok(self, ckpt: RowCheckpoint) -> bool:
+        if ckpt.model_tag == self.model_tag:
+            return True
+        self.stats.bump("stale_tag_discards")
+        return False
+
+    def _remove(self, key: str) -> int:
+        import os
+        try:
+            os.remove(self.store.path(key))
+            return 1
+        except OSError:
+            return 0
+
+    def snapshot(self) -> dict:
+        return {"model_tag": self.model_tag,
+                "disk_dir": self.store.disk_dir,
+                "resident_keys": len(self.store.keys()),
+                "stats": self.stats.snapshot()}
+
+
+def _peek_age(data: bytes) -> int:
+    """Age embedded in wire bytes (needed to reconstruct the exact
+    store key a mirrored/peer payload was encoded under, so the codec's
+    embedded-key check still bites on those tiers)."""
+    with np.load(io.BytesIO(data)) as z:
+        return int(json.loads(bytes(z["meta"]).decode("utf-8"))["age"])

@@ -209,6 +209,13 @@ class FleetController:
         /healthz probe IS the heartbeat.
     decisions_path: structured JSONL, one record per reconcile (and
         one per rollout verb); obs_fleet renders it. None = no log.
+    decision_log_max_bytes / decision_log_max_age_s: decision-log
+        retention (ISSUE 18). Off by default (0 / None — unbounded,
+        the old behavior). When set, the JSONL rotates in place
+        keeping the newest records under the byte bound and dropping
+        records older than the age bound; the in-memory mirror trims
+        by the same age. `tools/obs_fleet.py --since` narrows reads
+        the same way.
     tracer: optional obs.Tracer — each cycle runs under a `reconcile`
         span so control-plane latency sits in the fleet waterfall.
     warm / warm_top_k / warm_min_count / warm_max_inflight: telemetry-
@@ -238,6 +245,8 @@ class FleetController:
                  rollout_attempts: int = 5,
                  rollout_backoff_s: float = 0.2,
                  boot_grace_s: float = 180.0,
+                 decision_log_max_bytes: int = 0,
+                 decision_log_max_age_s: Optional[float] = None,
                  clock=time.monotonic):
         self.fleet = fleet
         self.policy = policy or ScalingPolicy()
@@ -253,6 +262,17 @@ class FleetController:
         self.rollout_attempts = int(rollout_attempts)
         self.rollout_backoff_s = float(rollout_backoff_s)
         self.boot_grace_s = float(boot_grace_s)
+        # decision-log retention (ISSUE 18): a controller that runs
+        # for weeks appends one JSONL record per reconcile — unbounded
+        # by default (byte-identical to PR 16/17 behavior). When
+        # either bound is set, _log rotates the file in place (newest
+        # records kept under max_bytes/2 so rotation is amortized, and
+        # records older than max_age_s dropped) and trims the
+        # in-memory mirror by the same age cutoff.
+        self.decision_log_max_bytes = int(decision_log_max_bytes)
+        self.decision_log_max_age_s = (
+            None if decision_log_max_age_s is None
+            else float(decision_log_max_age_s))
         self._clock = clock
         reg = registry or get_registry()
         # the controller's OWN membership view — sweep() needs the TTL
@@ -754,6 +774,14 @@ class FleetController:
         record.setdefault("ts", time.time())
         with self._lock:
             self.decisions.append(record)
+            if self.decision_log_max_age_s is not None:
+                # trim the in-memory mirror by the same age contract
+                # as the file — snapshot() math stays over the
+                # retained window, not the process lifetime
+                cutoff = record["ts"] - self.decision_log_max_age_s
+                while self.decisions and \
+                        float(self.decisions[0].get("ts", 0)) < cutoff:
+                    self.decisions.pop(0)
         if not self.decisions_path:
             return
         try:
@@ -761,8 +789,60 @@ class FleetController:
             os.makedirs(d, exist_ok=True)
             with open(self.decisions_path, "a") as fh:
                 fh.write(json.dumps(record, default=str) + "\n")
+            self._maybe_rotate_log(float(record["ts"]))
         except OSError:
             pass               # the log must never break the loop
+
+    def _maybe_rotate_log(self, now_ts: float):
+        """Retention for the decision JSONL: when the file outgrows
+        `decision_log_max_bytes` (or, age-only configs, once per
+        max_age_s/4), rewrite it atomically keeping the NEWEST records
+        — age cutoff first, then newest-first bytes down to half the
+        byte bound so a rotation buys headroom instead of running
+        every append. Torn lines are dropped (the rewrite is also the
+        repair). OSError propagates to _log's swallow."""
+        max_b = self.decision_log_max_bytes
+        max_age = self.decision_log_max_age_s
+        if max_b <= 0 and max_age is None:
+            return
+        path = self.decisions_path
+        due = False
+        if max_b > 0 and os.path.getsize(path) > max_b:
+            due = True
+        if not due and max_age is not None:
+            last = getattr(self, "_last_age_rotate", 0.0)
+            if now_ts - last >= max_age / 4.0:
+                self._last_age_rotate = now_ts
+                due = True
+        if not due:
+            return
+        with open(path) as fh:
+            lines = fh.readlines()
+        kept = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if max_age is not None and \
+                    float(rec.get("ts", 0)) < now_ts - max_age:
+                continue
+            kept.append(line + "\n")
+        if max_b > 0:
+            budget, tail = max_b // 2, []
+            for line in reversed(kept):
+                budget -= len(line)
+                if budget < 0 and tail:
+                    break
+                tail.append(line)
+            kept = list(reversed(tail))
+        tmp = path + ".rotate"
+        with open(tmp, "w") as fh:
+            fh.writelines(kept)
+        os.replace(tmp, path)
 
     # -- views -------------------------------------------------------------
 

@@ -152,6 +152,15 @@ class InProcessFleet:
             rep_retry = (None if retry is None else
                          dataclasses.replace(retry,
                                              seed=retry.seed + i))
+            if rep_retry is not None and rep_retry.checkpoint_spill:
+                # per-replica spill namespace, same reasoning as the
+                # cache disk_dir split above: replicas are separate
+                # hosts in production, and cross-replica resume must
+                # go over the peer wire, not through a shared path
+                rep_retry = dataclasses.replace(
+                    rep_retry,
+                    checkpoint_spill=os.path.join(
+                        rep_retry.checkpoint_spill, rid))
             scheduler = Scheduler(
                 make_executor(), buckets, config,
                 metrics=(metrics_factory(i) if metrics_factory else None),
@@ -175,6 +184,13 @@ class InProcessFleet:
                 # unified health: the peer probe payload carries the
                 # same breaker/queue/drain truth the front door serves
                 peer_server.health_source = scheduler.health
+                # checkpoint artifact kind (ISSUE 18): spilled carries
+                # become peer-fetchable, and this replica's resume
+                # path can pull a dead peer's spill over the wire
+                peer_server.checkpoint_source = \
+                    scheduler.checkpoint_store
+                if scheduler.checkpoint_store is not None:
+                    scheduler.checkpoint_store.peer = cache.peer
                 # served fetches emit continued trace records under
                 # the requester's peer_fetch hop (ISSUE 15) — the
                 # in-process harness shares the one tracer, so the

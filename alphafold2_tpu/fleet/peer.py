@@ -103,6 +103,16 @@ class PeerCacheServer:
         # wire it to SLOEngine.report so a scrape of THIS port reads
         # gauges as fresh as the front-door port's
         self.metrics_hook = None
+        # checkpoint_source: optional duck-typed
+        # `latest_raw(group) -> bytes | None`
+        # (cache.checkpoints.CheckpointStore) behind the
+        # `kind=checkpoint` route — a failover peer fetches a dead
+        # replica's spilled mid-loop carry through the SAME wire the
+        # fold cache uses (ISSUE 18). Assignable after construction
+        # like health_source; None keeps the route a clean 404, so a
+        # spill-off replica answers checkpoint probes with a miss,
+        # never an error.
+        self.checkpoint_source = None
         reg = metrics or get_registry()
         self._registry = reg      # GET /metrics exposes this registry
         m_served = reg.counter(
@@ -209,8 +219,8 @@ class PeerCacheServer:
                         trace = server.tracer.start_trace(
                             f"peer:{key[:24]}", context=ctx)
                         trace.begin("peer_serve")
-                    tag = urlparse.parse_qs(parsed.query).get(
-                        "tag", [""])[0]
+                    qs = urlparse.parse_qs(parsed.query)
+                    tag = qs.get("tag", [""])[0]
                     if server.rollout is not None \
                             and tag != server.rollout.tag:
                         # cross-tag fetch: the requester and this
@@ -220,6 +230,26 @@ class PeerCacheServer:
                         self._finish(trace, "stale_tag", "rejected")
                         self._reply(409, b"model tag mismatch",
                                     "text/plain")
+                        return
+                    if qs.get("kind", [""])[0] == "checkpoint":
+                        # checkpoint artifact kind (ISSUE 18): <key>
+                        # is a checkpoint GROUP digest; serve this
+                        # replica's newest spilled carry for it. The
+                        # decoded payload re-proves the tag client-
+                        # side (decode_checkpoint), so the route
+                        # shares the fold path's 409 gate above and
+                        # needs no second check.
+                        src = server.checkpoint_source
+                        data = (None if src is None
+                                else src.latest_raw(key))
+                        if data is None:
+                            self._count("ckpt_miss")
+                            self._finish(trace, "ckpt_miss", "miss")
+                            self._reply(404, b"miss", "text/plain")
+                            return
+                        self._count("ckpt_hit")
+                        self._finish(trace, "ckpt_hit", "ok")
+                        self._reply(200, data)
                         return
                     data = server.cache.read_raw(key)
                     if data is None:
@@ -477,3 +507,66 @@ class PeerCacheClient:
         else:
             trace.event("peer_fetch", peer=owner, outcome=outcome)
         return value
+
+    # max healthy peers one checkpoint probe sweeps: the probe runs
+    # once per orphaned fold (boot/admission, not per request), so a
+    # small bound keeps failover cheap on wide fleets while still
+    # covering every peer of the 2-4 replica deployments the smoke
+    # harness runs
+    CKPT_PROBE_LIMIT = 4
+
+    def fetch_checkpoint(self, group: str,
+                         model_tag: str = "") -> Optional[bytes]:
+        """Checkpoint-tier fetch (ISSUE 18): ask live peers for the
+        newest spilled carry under `group` (a checkpoint GROUP digest,
+        cache.checkpoints.checkpoint_group). Unlike get(), there is no
+        owner to route to — the replica that spilled the checkpoint is
+        the one that just died, and the group digest has no ring
+        position — so this probes up to CKPT_PROBE_LIMIT healthy peers
+        (never itself) and returns the first hit's raw bytes for the
+        caller (CheckpointStore._peer_fetch) to validate with
+        decode_checkpoint. Every outcome lands in the same
+        fleet_peer_fetch_total{peer,outcome} counter as fold fetches
+        (ckpt_hit/ckpt_miss/ckpt_error) and transport failures feed
+        the same markdown machinery; never raises."""
+        tag = model_tag or (self.rollout.tag
+                            if self.rollout is not None else "")
+        probed = 0
+        for pid in self.registry.member_ids():
+            if probed >= self.CKPT_PROBE_LIMIT:
+                break
+            if pid == self.self_id or not self.registry.is_healthy(pid):
+                continue
+            info = self.registry.get(pid)
+            if info is None or info.peer_addr is None:
+                continue
+            probed += 1
+            host, port = info.peer_addr
+            url = (f"http://{host}:{port}/cache/"
+                   f"{urlparse.quote(group, safe='')}"
+                   f"?kind=checkpoint&tag={urlparse.quote(tag, safe='')}")
+            t0 = time.monotonic()
+            outcome, body = "ckpt_error", None
+            try:
+                if self.faults is not None:
+                    self.faults.on_peer_fetch(pid)
+                with urlrequest.urlopen(url,
+                                        timeout=self.timeout_s) as resp:
+                    body = resp.read()
+                outcome = "ckpt_hit"
+                self._note_transport_ok(pid)
+            except urlerror.HTTPError as exc:
+                # 404 = this peer never saw the fold; 409 = it runs a
+                # different tag — both are live-transport misses
+                outcome = ("ckpt_miss" if exc.code in (404, 409)
+                           else "ckpt_error")
+                self._note_transport_ok(pid)
+                if outcome == "ckpt_error":
+                    self._note_transport_failure(pid)
+            except Exception:
+                self._note_transport_failure(pid)
+            self._m_latency.observe(time.monotonic() - t0)
+            self._m_fetch.inc(peer=pid, outcome=outcome)
+            if body is not None:
+                return body
+        return None

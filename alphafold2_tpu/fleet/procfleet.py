@@ -125,7 +125,9 @@ class ProcFleet:
                  slo: str = "",
                  slo_window_s: float = 60.0,
                  key_log: bool = False,
-                 controller: Optional[dict] = None):
+                 controller: Optional[dict] = None,
+                 checkpoint_spill: bool = False,
+                 bulk: Optional[dict] = None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self.run_dir = os.path.abspath(run_dir)
@@ -147,7 +149,14 @@ class ProcFleet:
             feature_pool=(None if feature_pool is None
                           else dict(feature_pool)),
             slo=str(slo), slo_window_s=float(slo_window_s),
-            retry=bool(retry), key_log=bool(key_log))
+            retry=bool(retry), key_log=bool(key_log),
+            # durable mid-loop checkpoints (ISSUE 18): each replica
+            # spills step-loop carries under its state dir and serves
+            # them to failover peers over the checkpoint artifact kind
+            checkpoint_spill=bool(checkpoint_spill),
+            # bulk tier (ISSUE 18): serve.BulkPolicy kwargs; None =
+            # no BulkQueue, qos="bulk" submits fold as plain online
+            bulk=(None if bulk is None else dict(bulk)))
         # optional control plane (ISSUE 16, OFF when None — the
         # default, byte-identical to a controller-less fleet): dict of
         # fleet.ScalingPolicy knobs + FleetController kwargs; start()
@@ -217,6 +226,8 @@ class ProcFleet:
             slo=k["slo"],
             slo_window_s=k["slo_window_s"],
             retry=k["retry"],
+            checkpoint_spill=k.get("checkpoint_spill", False),
+            bulk=(None if k.get("bulk") is None else dict(k["bulk"])),
             peers=[p for p in all_rows
                    if p["replica_id"] != row["replica_id"]])
         if k["key_log"]:
@@ -691,8 +702,16 @@ def replica_main(config: dict) -> int:
     tracer = obs.Tracer(jsonl_path=config["trace_path"], origin=rid)
     retry = None
     if config.get("retry", True):
-        retry = serve.RetryPolicy(max_attempts=4, backoff_base_s=0.02,
-                                  backoff_max_s=0.5)
+        retry_kw = dict(max_attempts=4, backoff_base_s=0.02,
+                        backoff_max_s=0.5)
+        if config.get("checkpoint_spill"):
+            # durable spill rides the carry-checkpoint cadence under
+            # the replica's state dir: kill -9 loses the process, the
+            # restarted replica resumes survivors at their spilled age
+            retry_kw.update(
+                checkpoint_every=1,
+                checkpoint_spill=os.path.join(state_dir, "checkpoints"))
+        retry = serve.RetryPolicy(**retry_kw)
     # optional step-mode recycle scheduling from the fleet config:
     # the same RecyclePolicy knobs the loadtest's --recycle-sched sets
     recycle_cfg = config.get("recycle")
@@ -759,7 +778,15 @@ def replica_main(config: dict) -> int:
         router=router, retry=retry,
         quarantine_path=os.path.join(state_dir, "quarantine.jsonl"),
         mesh_policy=mesh_policy, recycle_policy=recycle_policy,
-        feature_pool=feature_pool, slo=slo_engine, key_log=key_log)
+        feature_pool=feature_pool, slo=slo_engine, key_log=key_log,
+        bulk=(None if not config.get("bulk")
+              else serve.BulkPolicy(**config["bulk"])))
+    # fleet tiers for the durable checkpoint store (ISSUE 18): this
+    # replica's spills become fetchable by failover peers
+    # (checkpoint_source below), and ITS resume path can pull a dead
+    # peer's spill through the same client that fetches fold results
+    if scheduler.checkpoint_store is not None:
+        scheduler.checkpoint_store.peer = client
     # a rollout re-tags the executor, which orphans every executable
     # compiled under the previous tag (the ISSUE 7 staleness fix) —
     # re-warm in the BACKGROUND so a rolled replica re-compiles its
@@ -796,6 +823,9 @@ def replica_main(config: dict) -> int:
                                   replica_id=rid,
                                   health_source=scheduler.health,
                                   partition=partition)
+    # checkpoint artifact kind (ISSUE 18): peers resuming this
+    # replica's orphaned folds fetch its spilled carries here
+    peer_server.checkpoint_source = scheduler.checkpoint_store
     frontdoor.extra_stats = lambda: {
         "peer": {"stale_tag_hits": client.stale_tag_hits,
                  "recoveries": client.recoveries},

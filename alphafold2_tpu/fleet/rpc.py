@@ -68,6 +68,9 @@ _HDR_BUCKET = "X-Bucket-Len"
 _HDR_ERROR = "X-Error"
 _HDR_RECYCLES = "X-Recycles"         # step-mode: iterations executed
 _HDR_RECYCLE = "X-Recycle"           # progressive result: its iteration
+_HDR_QOS = "X-Qos"                   # "bulk" marks the background tier
+#                                      (absent == "online", so the
+#                                      pre-ISSUE-18 wire is unchanged)
 
 
 # -- wire format ---------------------------------------------------------
@@ -92,6 +95,8 @@ def request_headers(request: FoldRequest, tag: str = "",
          "Content-Type": "application/octet-stream"}
     if request.deadline_s is not None:
         h[_HDR_DEADLINE] = repr(float(request.deadline_s))
+    if getattr(request, "qos", "online") != "online":
+        h[_HDR_QOS] = request.qos
     if tag:
         h[_HDR_TAG] = tag
     if context is not None:
@@ -118,11 +123,13 @@ def decode_request(body: bytes, headers) -> FoldRequest:
     rid = headers.get(_HDR_REQUEST_ID)
     if rid:
         kwargs["request_id"] = rid
+    # an unknown qos raises ValueError from FoldRequest itself -> 400
     return FoldRequest(
         seq=seq, msa=msa,
         priority=int(headers.get(_HDR_PRIORITY, "0") or 0),
         deadline_s=None if deadline is None else float(deadline),
         forwarded=headers.get(_HDR_FORWARDED, "0") == "1",
+        qos=headers.get(_HDR_QOS) or "online",
         **kwargs)
 
 

@@ -80,6 +80,7 @@ from alphafold2_tpu.cache import (FeatureCache, FoldCache,  # noqa: F401
 from alphafold2_tpu.obs import (MetricsRegistry, Tracer,  # noqa: F401
                                 get_registry, prometheus_text)
 from alphafold2_tpu.serve.bucketing import BucketPolicy, default_policy  # noqa: F401
+from alphafold2_tpu.serve.bulk import BulkPolicy, BulkQueue  # noqa: F401
 from alphafold2_tpu.serve.executor import FoldExecutor  # noqa: F401
 from alphafold2_tpu.serve.faults import FaultInjected, FaultPlan  # noqa: F401
 from alphafold2_tpu.serve.features import (FeaturePool,  # noqa: F401

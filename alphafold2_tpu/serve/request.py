@@ -38,6 +38,11 @@ class FoldRequest:
         (fleet.ConsistentHashRouter); the receiving scheduler serves it
         locally regardless of its own ring view, so divergent membership
         views can bounce a request once, never loop it.
+    qos: "online" (the default — every pre-bulk caller, byte-for-byte
+        the old behavior) or "bulk": lowest-QoS sweep work that rides
+        the scheduler's BulkQueue, admitted only by work-stealing and
+        throttled by online burn rate (ISSUE 18). Ignored by
+        schedulers constructed without a BulkPolicy.
     """
 
     seq: np.ndarray
@@ -46,8 +51,13 @@ class FoldRequest:
     priority: int = 0
     deadline_s: Optional[float] = None
     forwarded: bool = False
+    qos: str = "online"
 
     def __post_init__(self):
+        if self.qos not in ("online", "bulk"):
+            raise ValueError(
+                f"FoldRequest.qos must be 'online' or 'bulk', "
+                f"got {self.qos!r}")
         self.seq = np.asarray(self.seq, dtype=np.int32)
         if self.seq.ndim != 1:
             raise ValueError(

@@ -130,6 +130,23 @@ class RetryPolicy:
         checkpoint sits at the failure step. 0 (default) disables
         checkpointing: every failure path is byte-for-byte the PR-5
         requeue-from-zero behavior.
+    checkpoint_spill: durable checkpoint spill directory (ISSUE 18;
+        "" disables — the default). With a path set, every host-memory
+        carry checkpoint ALSO spills per-row npz payloads through
+        `cache.checkpoints.CheckpointStore` to the disk tier keyed by
+        (fold_key, model_tag, age) — written at the same
+        `checkpoint_every` cadence (which must therefore be >= 1),
+        pruned to the newest age, and discarded when the fold
+        resolves. A restarted replica (boot discovery), a re-submitted
+        duplicate (submit consult), or a failover peer (the
+        `kind=checkpoint` peer route) then RESUMES the fold at its
+        checkpointed age instead of refolding from recycle 0 —
+        resume-at-age is byte-equal to the uninterrupted loop, per
+        row, through PR 14's restore path. `Scheduler.drain()` spills
+        every in-flight loop's current carry before exiting, so drain
+        becomes checkpoint-and-hand-off (the preemptible/spot
+        contract). "" keeps scrubbed serve_stats() and the registry
+        metric-name set byte-identical to spill-off.
     row_isolation: per-row poison isolation in the step loop
         (ISSUE 14). A per-step non-finite scan retires ONLY the
         offending row the moment its output goes non-finite (strike
@@ -159,6 +176,7 @@ class RetryPolicy:
     breaker_threshold: int = 0
     breaker_cooldown_s: float = 5.0
     checkpoint_every: int = 0
+    checkpoint_spill: str = ""
     row_isolation: bool = False
     transient_types: Tuple[type, ...] = ()
     transient_markers: Tuple[str, ...] = (
@@ -181,6 +199,11 @@ class RetryPolicy:
             raise ValueError("watchdog_s must be > 0 (None disables)")
         if self.checkpoint_every < 0:
             raise ValueError("checkpoint_every must be >= 0 (0 disables)")
+        if self.checkpoint_spill and self.checkpoint_every < 1:
+            raise ValueError(
+                "checkpoint_spill rides the checkpoint cadence: set "
+                "checkpoint_every >= 1 (the spill directory alone "
+                "never checkpoints anything)")
         self._rng = random.Random(self.seed)
 
     def is_transient(self, exc: BaseException) -> bool:

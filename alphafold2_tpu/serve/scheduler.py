@@ -363,7 +363,8 @@ class Scheduler:
                  feature_pool=None,
                  kernel_policy=None,
                  slo=None,
-                 key_log=None):
+                 key_log=None,
+                 bulk=None):
         self.executor = executor
         # optional serve.metrics.KeyFrequencyLog (OFF when None — the
         # default, byte-identical): ingress submits (forwarded hops
@@ -462,6 +463,58 @@ class Scheduler:
                 "batch rows retired alone by per-row poison isolation "
                 "(non-finite scan or row-attributed deterministic "
                 "failure) while their batch mates kept folding")
+        # durable checkpoint spill (ISSUE 18): per-row mid-loop
+        # checkpoints outlive the process in a cache.checkpoints
+        # CheckpointStore so a restarted replica (or a failover peer
+        # reached through the store's backend/peer tiers) resumes
+        # survivors at their checkpointed ages instead of refolding.
+        # OFF unless RetryPolicy.checkpoint_spill names a directory —
+        # the store (and its counters) is never built otherwise,
+        # keeping scrubbed serve_stats() and the registry metric-name
+        # set byte-identical
+        self._ckpt_store = None
+        self._n_spill_resumes = 0
+        self._boot_survivors = 0
+        spill_dir = "" if retry is None else getattr(
+            retry, "checkpoint_spill", "")
+        if spill_dir:
+            from alphafold2_tpu.cache.checkpoints import CheckpointStore
+            self._ckpt_store = CheckpointStore(
+                spill_dir, model_tag=model_tag, registry=registry)
+            self._c_spill_resumes = reg.counter(
+                "serve_spill_resumes_total",
+                "fold rows resumed mid-loop from a durable spilled "
+                "checkpoint (local disk, object store, or peer)")
+            try:
+                self._boot_survivors = sum(
+                    1 for _ in self._ckpt_store.survivors())
+            except Exception:
+                self._boot_survivors = 0
+        # bulk tier (ISSUE 18): lowest-QoS sweep work admitted only by
+        # work-stealing through the continuous-admission front, gated
+        # by online burn rate. OFF when None — byte-identical stats
+        self.bulk = bulk
+        self._bulk_queue = None
+        self._n_bulk_admits = 0
+        self._n_bulk_yields = 0
+        self._n_bulk_rejected = 0
+        self._bulk_gated_flag = False
+        self._bulk_last_check = 0.0
+        if bulk is not None:
+            from alphafold2_tpu.serve.bulk import BulkQueue
+            self._bulk_queue = BulkQueue()
+            self._c_bulk_admits = reg.counter(
+                "serve_bulk_admits_total",
+                "bulk-QoS requests admitted into fold batches (stolen "
+                "freed rows or idle-founded batches)")
+            self._c_bulk_yields = reg.counter(
+                "serve_bulk_yields_total",
+                "in-flight bulk rows that checkpointed-and-yielded at "
+                "an admission gap because online burn crossed "
+                "BulkPolicy.max_burn")
+            self._g_bulk_gated = reg.gauge(
+                "serve_bulk_gated",
+                "1 while bulk admission is gated by online burn rate")
         # step-mode recycle scheduling (before the mesh block: the LRU
         # autosizing below must know whether each (bucket, slice) needs
         # one executable or the init+step pair)
@@ -649,6 +702,21 @@ class Scheduler:
         ex = getattr(self, "executor", None)
         if ex is not None and hasattr(ex, "model_tag"):
             ex.model_tag = tag
+        # re-tag the checkpoint spill store too: a rolled scheduler
+        # must never resume a carry computed under the previous
+        # weights' identity (the store discards stale-tag survivors)
+        cs = getattr(self, "_ckpt_store", None)
+        if cs is not None:
+            cs.model_tag = tag
+
+    @property
+    def checkpoint_store(self):
+        """The durable checkpoint spill store, or None when the
+        `RetryPolicy(checkpoint_spill=)` knob is off. Harnesses wire
+        its fleet tiers post-construction (`.peer`, `.backend`) and
+        hand it to `fleet.PeerCacheServer.checkpoint_source` so peers
+        can fetch this replica's spilled carries (ISSUE 18)."""
+        return self._ckpt_store
 
     # -- lifecycle -------------------------------------------------------
 
@@ -925,6 +993,22 @@ class Scheduler:
             self._raise_unless_running(entry)
             if self._fail_fast_quarantined(entry):
                 return entry.ticket
+        # bulk tier (ISSUE 18): lowest-QoS sweep work takes its own
+        # queue. A store hit still serves (campaign re-runs are
+        # idempotent), but bulk never coalesces or forwards — a bulk
+        # LEADER could park online duplicates behind work the burn
+        # gate may starve indefinitely, and a forwarded hop would
+        # spend an online transport slot on background work
+        if self.bulk is not None and \
+                getattr(request, "qos", "online") == "bulk":
+            self._raise_unless_running(entry)
+            if self._serve_bulk_from_cache(entry):
+                return entry.ticket
+            if self._breaker is not None \
+                    and not self._breaker.allow_submit():
+                self._degraded_shed(entry)
+                return entry.ticket
+            return self._submit_bulk(entry)
         if self.cache is not None or self.router is not None:
             self._raise_unless_running(entry)
             if self.cache is not None \
@@ -1234,6 +1318,216 @@ class Scheduler:
         # (no-op for non-leaders)
         self._settle_followers(entry, resp)
 
+    # -- bulk tier (ISSUE 18) --------------------------------------------
+
+    def _serve_bulk_from_cache(self, entry: _Entry) -> bool:
+        """Store-only lookup for a bulk submit (no coalescing — see
+        submit()); sets store_key either way so the eventual fold
+        writes back and the NEXT campaign run hits."""
+        if self.cache is None:
+            return False
+        key = self._entry_key(entry)
+        if key is None:
+            return False
+        try:
+            cached = self.cache.get(key, trace=entry.trace)
+        except Exception:
+            return False
+        if cached is None:
+            self.metrics.record_cache_miss()
+            return False
+        self.metrics.record_cache_hit()
+        entry.resolve(FoldResponse(
+            request_id=entry.request.request_id, status="ok",
+            coords=cached.coords.copy(),
+            confidence=cached.confidence.copy(),
+            bucket_len=entry.bucket_len,
+            latency_s=time.monotonic() - entry.enqueued_at,
+            source="cache"))
+        return True
+
+    def _submit_bulk(self, entry: _Entry) -> FoldTicket:
+        """Enqueue into the bulk queue — its own bound, kept OUT of
+        `_depth` so background backlog can never push the online queue
+        into its full policy."""
+        q = self._bulk_queue
+        if len(q) >= self.bulk.max_pending:
+            self._n_bulk_rejected += 1
+            self.metrics.record_rejected()
+            entry.trace.finish(
+                "rejected", error="bulk queue at limit")
+            raise QueueFullError(
+                f"bulk queue at limit {self.bulk.max_pending}")
+        entry.mark_enqueued()
+        entry.trace.end("submit")
+        entry.trace.begin("bulk")
+        with self._cond:
+            q.push(entry.bucket_len, entry)
+            self._cond.notify_all()
+        return entry.ticket
+
+    def _bulk_gated(self) -> bool:
+        """True while online burn rate exceeds BulkPolicy.max_burn —
+        the SLO engine's own report throttles the bulk tier. The
+        report is cached for check_interval_s (it walks registry
+        histograms); racy reads of the cached flag are fine. Without
+        an SLO engine there is no burn signal and bulk is never
+        gated."""
+        if self.bulk is None or self.slo is None:
+            return False
+        now = time.monotonic()
+        if now - self._bulk_last_check < self.bulk.check_interval_s:
+            return self._bulk_gated_flag
+        self._bulk_last_check = now
+        burn = 0.0
+        try:
+            report = self.slo.report()
+            for cls in report.get("classes", {}).values():
+                b = (cls.get("latency") or {}).get("burn_rate")
+                if b is not None:
+                    burn = max(burn, float(b))
+        except Exception:
+            burn = 0.0             # a broken report must not gate bulk
+        gated = burn > self.bulk.max_burn
+        if gated != self._bulk_gated_flag:
+            self._bulk_gated_flag = gated
+            self._g_bulk_gated.set(1 if gated else 0)
+        return gated
+
+    def _take_bulk_candidate(self, bucket_len: int,
+                             batch_msa_depth: int) -> Optional[_Entry]:
+        """Work-stealing admission: one bulk entry for a freed row of
+        `bucket_len`'s host batch — called only after every online
+        take (same-bucket and cross-bucket) came up empty, and only
+        while the burn gate is open. Expired deadlines shed here, at
+        take time (bulk entries never ride the online shed sweep);
+        an unpinned-msa_depth head deeper than the running batch's
+        compiled depth goes back to the head (same rule as online
+        admission — truncating it would serve different content)."""
+        q = self._bulk_queue
+        if q is None or not len(q) or self._bulk_gated():
+            return None
+        now = time.monotonic()
+        while True:
+            e = q.take(bucket_len)
+            if e is None:
+                return None
+            if e.deadline is not None and now >= e.deadline:
+                self._shed_bulk(e)
+                continue
+            if self.config.msa_depth is None \
+                    and e.request.msa is not None \
+                    and int(e.request.msa.shape[0]) > batch_msa_depth:
+                q.push_front(bucket_len, e)
+                return None
+            e.trace.end("bulk")
+            e.trace.event("bulk_stolen", bucket=bucket_len)
+            self._count_bulk_admits(1)
+            return e
+
+    def _form_bulk_batch(self, stopping: bool):
+        """Idle founding: bulk work founds a batch ONLY when no online
+        work is pending anywhere (the caller checked, under _cond) —
+        and even then not while the burn gate is closed, except during
+        a draining stop, where terminal resolution beats throttling."""
+        q = self._bulk_queue
+        if q is None or not len(q):
+            return None
+        if not stopping and self._bulk_gated():
+            return None
+        now = time.monotonic()
+        for bucket_len in q.buckets():
+            if self._allocator is not None and not self._allocator \
+                    .can_allocate(self.mesh_policy.shape_for(bucket_len)):
+                continue
+            take: List[_Entry] = []
+            while len(take) < self.config.max_batch_size:
+                e = q.take(bucket_len)
+                if e is None:
+                    break
+                if e.deadline is not None and now >= e.deadline:
+                    self._shed_bulk(e)
+                    continue
+                e.trace.end("bulk")
+                take.append(e)
+            if take:
+                self._count_bulk_admits(len(take))
+                return bucket_len, take
+        return None
+
+    def _count_bulk_admits(self, n: int):
+        self._n_bulk_admits += n
+        self._c_bulk_admits.inc(n)
+
+    def _shed_bulk(self, e: _Entry):
+        self.metrics.record_shed()
+        e.trace.event("deadline_shed")
+        self._resolve_entry(e, FoldResponse(
+            request_id=e.request.request_id, status="shed",
+            bucket_len=e.bucket_len,
+            latency_s=time.monotonic() - e.enqueued_at,
+            error="deadline expired while queued (bulk)"))
+
+    def _yield_bulk_rows(self, state, active, rows, ages,
+                         all_members) -> int:
+        """Checkpoint-and-yield (ISSUE 18): under online burn, spill
+        every bulk row's carry to the durable store and requeue its
+        entry as resumable — the freed rows go to online admission at
+        this very gap. Requires the spill store: without one a yield
+        would refold from zero, so bulk rows run to completion
+        instead. Returns the number of rows freed."""
+        store = self._ckpt_store
+        if store is None or self._bulk_queue is None:
+            return 0
+        idx = [i for i, e in enumerate(active)
+               if getattr(e.request, "qos", "online") == "bulk"]
+        if not idx:
+            return 0
+        from alphafold2_tpu.cache.checkpoints import row_checkpoint
+        from alphafold2_tpu.predict import snapshot_step_state
+        try:
+            snap = snapshot_step_state(state)
+        except Exception:
+            return 0
+        yielded = []
+        for i in idx:
+            e = active[i]
+            key = self._entry_key(e)
+            if key is None:
+                continue
+            try:
+                ck = row_checkpoint(
+                    snap, rows[i], fold_key=key,
+                    model_tag=self.model_tag, age=ages[i],
+                    seq=e.request.seq, msa=e.request.msa)
+            except ValueError:
+                continue       # unspillable carry: the row keeps folding
+            if store.put_row(ck) is None:
+                continue
+            yielded.append(i)
+        if not yielded:
+            return 0
+        gone = set(yielded)
+        requeued = [active[i] for i in yielded]
+        active[:] = [e for i, e in enumerate(active) if i not in gone]
+        rows[:] = [r for i, r in enumerate(rows) if i not in gone]
+        ages[:] = [a for i, a in enumerate(ages) if i not in gone]
+        # a yielded entry now lives in the bulk queue, not this loop:
+        # it must leave the batch's failure/orphan bookkeeping too, or
+        # a later batch failure would double-resolve it
+        gone_ids = {id(e) for e in requeued}
+        all_members[:] = [e for e in all_members
+                          if id(e) not in gone_ids]
+        with self._cond:
+            for e in requeued:
+                e.trace.event("bulk_yielded")
+                e.trace.begin("bulk")
+                self._bulk_queue.push_front(e.bucket_len, e)
+            self._n_bulk_yields += len(requeued)
+            self._c_bulk_yields.inc(len(requeued))
+            self._cond.notify_all()
+        return len(requeued)
+
     # -- fleet routing ---------------------------------------------------
 
     def _route(self, entry: _Entry, key: str) -> bool:
@@ -1497,6 +1791,18 @@ class Scheduler:
                 except Exception:
                     pass              # a full/broken store never blocks
         entry.resolve(response)
+        # a terminal state means the spilled checkpoint must not
+        # outlive the work (ISSUE 18): resumable survivors exist only
+        # for folds some ticket still waits on. Requeue/bisection/
+        # resume paths never come through here, so their checkpoints
+        # survive for the retry to consume.
+        if self._ckpt_store is not None:
+            key = self._entry_key(entry)
+            if key is not None:
+                try:
+                    self._ckpt_store.discard(key)
+                except Exception:
+                    pass
         if response.status == "shed" and self._promote_follower(entry):
             return
         self._settle_followers(entry, response)
@@ -1548,6 +1854,23 @@ class Scheduler:
                     "recycles_lost": self._n_recycles_lost,
                     "row_poison_isolations": self._n_row_isolations,
                 })
+            # durable spill (ISSUE 18): keys appear only when the
+            # checkpoint_spill knob names a directory — same identity
+            # discipline as the ISSUE-14 block above
+            if self._ckpt_store is not None:
+                stats["resilience"]["checkpoint_spill"] = dict(
+                    self._ckpt_store.snapshot(),
+                    spill_resumes=self._n_spill_resumes,
+                    survivors_at_boot=self._boot_survivors)
+        if self.bulk is not None:
+            stats["bulk"] = {
+                "pending": len(self._bulk_queue),
+                "admits": self._n_bulk_admits,
+                "yields": self._n_bulk_yields,
+                "rejected": self._n_bulk_rejected,
+                "gated": self._bulk_gated_flag,
+                "max_burn": self.bulk.max_burn,
+            }
         if self.mesh_policy is not None:
             with self._cond:
                 folds = {label: {"batches": self._mesh_batches[label],
@@ -1630,8 +1953,13 @@ class Scheduler:
                         and self._running:
                     # timed wait only while entries pend (max_wait_ms /
                     # deadline bookkeeping needs the clock); a fully
-                    # idle scheduler parks until submit()/stop() notify
-                    if any(self._pending.values()):
+                    # idle scheduler parks until submit()/stop() notify.
+                    # Pending BULK work also forces the timed wait: the
+                    # burn gate reopens on its own (no notify), so a
+                    # parked worker would never found the gated backlog
+                    if any(self._pending.values()) \
+                            or (self._bulk_queue is not None
+                                and len(self._bulk_queue)):
                         self._cond.wait(timeout=poll_s)
                     else:
                         self._cond.wait()
@@ -1688,7 +2016,9 @@ class Scheduler:
                 continue
             if stopping:
                 with self._cond:
-                    if self._incoming or any(self._pending.values()):
+                    if self._incoming or any(self._pending.values()) \
+                            or (self._bulk_queue is not None
+                                and len(self._bulk_queue)):
                         if self._allocator is not None:
                             # every eligible slice is busy: wait for a
                             # completion to free one, don't hot-spin
@@ -1796,12 +2126,27 @@ class Scheduler:
                                          or cand[0] < best[0]):
                     best = (cand[0], bucket_len, cand[1])
             if best is None:
-                return None
-            _, bucket_len, take = best
-            taken = {id(e) for e in take}
-            self._pending[bucket_len] = [
-                e for e in self._pending[bucket_len]
-                if id(e) not in taken]
+                # bulk founding (ISSUE 18) is legal only when NO online
+                # work is pending anywhere — checked under the same
+                # lock that admits online work, so a racing submit
+                # either lands before this check (and wins the batch)
+                # or after (and waits exactly one bulk loop, the same
+                # as any work behind a running batch)
+                online_idle = (self._bulk_queue is not None
+                               and not self._incoming
+                               and not any(self._pending.values()))
+            else:
+                # selection + removal stay ONE atomic step against
+                # pool-thread row admission takes
+                _, bucket_len, take = best
+                taken = {id(e) for e in take}
+                self._pending[bucket_len] = [
+                    e for e in self._pending[bucket_len]
+                    if id(e) not in taken]
+        if best is None:
+            if online_idle:
+                return self._form_bulk_batch(stopping)
+            return None
         if self._breaker is not None:
             self._breaker.begin_probe()  # no-op unless half-open
         self._resolve_removed(take)
@@ -2165,6 +2510,15 @@ class Scheduler:
                                         contact_planned, any_nonfinite,
                                         waste, t0)
                 return
+            # durable resume (ISSUE 18): a founder whose fold died
+            # with a spilled checkpoint (this process's previous life,
+            # or a dead peer reached through the store's backend/peer
+            # tiers) restarts at its checkpointed age — its row's
+            # just-initialized carry is overwritten with the spilled
+            # one, which is exactly PR 14's restore path per row
+            if self._ckpt_store is not None and active:
+                state = self._resume_from_spill(
+                    state, active, rows, ages, range(len(active)))
 
             def _plan_contact(st, members):
                 """Re-plan the step mask from the batch's OWN pair
@@ -2416,6 +2770,16 @@ class Scheduler:
                             # (not can_repack: rows retire in place —
                             # the position -> row map already shrank
                             # above)
+                        # bulk yield (ISSUE 18): under online burn,
+                        # bulk rows checkpoint-and-yield at this gap —
+                        # spill to the durable store, requeue as
+                        # resumable, free the row for the online
+                        # admission right below
+                        if self._bulk_queue is not None and active \
+                                and self._ckpt_store is not None \
+                                and self._bulk_gated():
+                            self._yield_bulk_rows(state, active, rows,
+                                                  ages, all_members)
                         admitted = []
                         if continuous and active:
                             if lease is None:
@@ -2466,7 +2830,8 @@ class Scheduler:
                                     coords_np, conf_np,
                                     [0] * len(admitted))
                         if ckpt_every and active and \
-                                (admitted or r % ckpt_every == 0):
+                                (admitted or self._draining
+                                 or r % ckpt_every == 0):
                             # cadence checkpoints, plus one at every
                             # admission gap: a resume must never
                             # restore a pre-admission carry out from
@@ -2474,7 +2839,12 @@ class Scheduler:
                             # (a failed checkpoint keeps the previous
                             # one — resume then requeues the admitted
                             # entries as orphans, losing progress but
-                            # never tickets)
+                            # never tickets). While DRAINING, every
+                            # gap checkpoints: with a spill store on,
+                            # drain() leaves the freshest possible
+                            # resume point for whoever inherits the
+                            # fold (ISSUE 18)
+
                             ckpt = self._checkpoint_loop(
                                 state, batch, active, rows, ages, r,
                                 step_kernel) or ckpt
@@ -2983,6 +3353,12 @@ class Scheduler:
                     bucket_len, depth, ages, bool(placements), inline)
                 if taken is not None:
                     e, decision = taken
+            if e is None and self._bulk_queue is not None:
+                # bulk work-stealing (ISSUE 18): every online take —
+                # same-bucket and cross-bucket — came up empty, so a
+                # freed row may carry the lowest QoS class (gated by
+                # online burn rate inside the take)
+                e = self._take_bulk_candidate(bucket_len, depth)
             if e is None:
                 break
             # HBM guard, mirroring submit() but RE-PRICED AT THE HOST
@@ -3141,6 +3517,15 @@ class Scheduler:
                 row_mask = np.zeros((cfg.max_batch_size,), bool)
                 for row, _ in placements:
                     row_mask[row] = True
+        # durable resume (ISSUE 18): an admitted entry may be a fold
+        # some dead replica (or this one's previous life, or a yielded
+        # bulk loop) already carried to age N — consult the spill
+        # store and continue it there instead of from the init state
+        if self._ckpt_store is not None and admitted:
+            adm = {id(e) for e in admitted}
+            state = self._resume_from_spill(
+                state, active, rows, ages,
+                [i for i, e in enumerate(active) if id(e) in adm])
         return new_batch, state, admitted
 
     def _retire_entry(self, e: _Entry, bucket_len: int, coords_row,
@@ -3242,9 +3627,99 @@ class Scheduler:
         except Exception:
             return None
         self._n_checkpoints += 1
+        if self._ckpt_store is not None:
+            self._spill_rows(snap_state, active, rows, ages)
         return _StepCheckpoint(snap_state, snap_host, list(rows),
                                list(ages), list(active), int(step),
                                kernel)
+
+    def _spill_rows(self, snap_state, active: List[_Entry],
+                    rows: List[int], ages: List[int]):
+        """Durable spill (ISSUE 18): every in-memory checkpoint also
+        writes each row's slice of the snapshot to the CheckpointStore
+        keyed by (fold_key, model_tag, age) — one npz per row, so a
+        single fold migrates without its batch mates. Rides the
+        snapshot `_checkpoint_loop` already paid for; per-row trouble
+        (unkeyable request, unsliceable carry, disk errors) skips that
+        row, never the loop — the store counts it."""
+        store = self._ckpt_store
+        from alphafold2_tpu.cache.checkpoints import row_checkpoint
+        for i, e in enumerate(active):
+            key = self._entry_key(e)
+            if key is None:
+                continue
+            try:
+                ck = row_checkpoint(
+                    snap_state, rows[i], fold_key=key,
+                    model_tag=self.model_tag, age=ages[i],
+                    seq=e.request.seq, msa=e.request.msa)
+            except ValueError:
+                store.stats.bump("spill_errors")
+                continue
+            if store.put_row(ck) is not None:
+                e.trace.event("checkpoint_spilled", recycle=ages[i])
+
+    def _resume_from_spill(self, state, active: List[_Entry],
+                           rows: List[int], ages: List[int],
+                           positions):
+        """Durable resume (ISSUE 18): consult the CheckpointStore for
+        each just-initialized position; on a validated hit, overwrite
+        that row's slice of every carry leaf with the spilled one and
+        set its age — `.at[row].set` of the stored values does no
+        arithmetic, so the continued loop is byte-equal to the
+        uninterrupted one. ANY validation trouble (leaf count, shape,
+        dtype, reference drift, a different sequence under a colliding
+        key) discards the checkpoint and keeps age 0: refold-from-zero
+        is always the safe fallback. Mutates ages in place; returns
+        the (possibly updated) state."""
+        store = self._ckpt_store
+        import jax
+        import jax.numpy as jnp
+        leaves = treedef = None
+        for i in positions:
+            e = active[i]
+            key = self._entry_key(e)
+            if key is None:
+                continue
+            ckpt = store.latest(key, trace=e.trace)
+            if ckpt is None:
+                continue
+            if not (ckpt.seq.shape == e.request.seq.shape
+                    and bool(np.array_equal(ckpt.seq, e.request.seq))
+                    and 0 < ckpt.age < self.config.num_recycles):
+                store.discard(key)
+                continue
+            try:
+                restored = ckpt.restore_leaves()
+                if leaves is None:
+                    leaves, treedef = jax.tree_util.tree_flatten(state)
+                if len(restored) != len(leaves):
+                    raise ValueError("carry leaf count drifted")
+                row = rows[i]
+                new_leaves = list(leaves)
+                for j, new in enumerate(restored):
+                    cur = leaves[j]
+                    if isinstance(cur, jax.Array):
+                        arr = jnp.asarray(new)
+                        if arr.shape[1:] != cur.shape[1:] \
+                                or arr.dtype != cur.dtype:
+                            raise ValueError(
+                                f"carry leaf {j} shape/dtype drifted")
+                        new_leaves[j] = cur.at[row].set(arr[0])
+                    elif new != cur:
+                        raise ValueError(
+                            f"reference leaf {j} drifted")
+                leaves = new_leaves
+            except Exception:
+                store.discard(key)
+                continue
+            ages[i] = int(ckpt.age)
+            self._n_spill_resumes += 1
+            self._c_spill_resumes.inc()
+            e.trace.event("spill_resume", recycle=ckpt.age)
+        if leaves is not None:
+            state = jax.tree_util.tree_unflatten(treedef, leaves)
+        return state
 
     def _scan_nonfinite_rows(self, active: List[_Entry],
                              rows: List[int], ages: List[int],
@@ -3919,6 +4394,10 @@ class Scheduler:
             self._pending.clear()
             self._depth -= len(leftovers)
             self._cond.notify_all()
+        # bulk entries live outside _depth: drain them AFTER the depth
+        # adjustment so the online accounting stays exact
+        if self._bulk_queue is not None:
+            leftovers.extend(self._bulk_queue.drain())
         return leftovers
 
     def _cancel_remaining(self):

@@ -1,0 +1,426 @@
+"""Bulk tier tests (ISSUE 18): BulkPolicy validation, the per-bucket
+BulkQueue, FoldRequest.qos + the X-Qos wire header, and the scheduler
+choreography — bulk founds batches only when online is idle, steals
+freed rows under continuous admission, a full queue rejects, a burn
+gate (stub SLO engine) blocks founding but not a draining stop, an
+undrained stop cancels, and the headline move: in-flight bulk rows
+checkpoint-and-yield when online burn crosses BulkPolicy.max_burn,
+then resume from the spilled checkpoint byte-equal once burn recedes.
+
+The scripted stub carries a PYTREE state (spillable carry) whose
+coords accumulate multiplicatively per step, so a resumed loop is
+distinguishable from a refold by its step count while staying
+byte-comparable to an uninterrupted reference run.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu.fleet.rpc import (decode_request, encode_request,
+                                      request_headers)
+from alphafold2_tpu.obs.registry import MetricsRegistry
+from alphafold2_tpu.serve import (BucketPolicy, BulkPolicy, BulkQueue,
+                                  FoldRequest, QueueFullError,
+                                  RecyclePolicy, RetryPolicy, Scheduler,
+                                  SchedulerConfig, ServeMetrics)
+
+
+# -- pytree-carry step stub (own class: pytree registration is global
+# per type, so this file registers its own, never test_checkpoints') --
+
+
+class _BkState:
+    def __init__(self, coords, confidence, ids, counts):
+        self.coords = coords
+        self.confidence = confidence
+        self.ids = ids
+        self.counts = counts
+
+
+jax.tree_util.register_pytree_node(
+    _BkState,
+    lambda s: ((s.coords, s.confidence, s.ids, s.counts), None),
+    lambda aux, ch: _BkState(*ch))
+
+
+class _BkStub:
+    """Deterministic pytree-carry executor with a one-shot gate: the
+    step at `gate_at` blocks until `release` so the test can flip the
+    burn signal (or submit racing work) while a loop is provably
+    mid-flight."""
+
+    def __init__(self):
+        self.calls = []
+        self.reached = threading.Event()
+        self.release = threading.Event()
+        self.gate_at = None
+        self._lock = threading.Lock()
+
+    def run_init(self, batch, trace=None, devices=None, mesh_shape=None):
+        seq = np.asarray(batch["seq"])
+        b, n = seq.shape
+        with self._lock:
+            self.calls.append(("init", [int(i) for i in seq[:, 0]]))
+        return _BkState(jnp.zeros((b, n, 3), jnp.float32),
+                        jnp.zeros((b, n), jnp.float32),
+                        jnp.asarray(seq[:, 0], jnp.int32),
+                        jnp.zeros((b,), jnp.int32))
+
+    def run_init_rows(self, batch, state, row_mask, trace=None,
+                      devices=None, mesh_shape=None, span_attrs=None):
+        seq = np.asarray(batch["seq"])
+        b, n = seq.shape
+        mask = jnp.asarray(np.asarray(row_mask))
+        with self._lock:
+            self.calls.append(
+                ("init_rows",
+                 [int(i) for i in seq[:, 0][np.asarray(row_mask)]]))
+        return _BkState(
+            jnp.where(mask[:, None, None],
+                      jnp.zeros((b, n, 3), jnp.float32), state.coords),
+            jnp.where(mask[:, None],
+                      jnp.zeros((b, n), jnp.float32), state.confidence),
+            jnp.where(mask, jnp.asarray(seq[:, 0], jnp.int32), state.ids),
+            jnp.where(mask, 0, state.counts))
+
+    def run_step(self, batch, state, recycle_index, trace=None,
+                 devices=None, mesh_shape=None, span_attrs=None):
+        with self._lock:
+            self.calls.append(("step", int(recycle_index)))
+            gated = self.gate_at is not None \
+                and recycle_index == self.gate_at
+            if gated:
+                self.gate_at = None
+        if gated:
+            self.reached.set()
+            assert self.release.wait(timeout=60)
+        return _BkState(
+            state.coords * jnp.float32(1.01) + jnp.float32(1.0)
+            + state.ids[:, None, None].astype(jnp.float32) * 0.001,
+            state.confidence, state.ids, state.counts + 1)
+
+    def stats(self):
+        return {"calls": len(self.calls)}
+
+    def steps(self):
+        with self._lock:
+            return sum(1 for c in self.calls if c[0] == "step")
+
+    def kinds(self):
+        with self._lock:
+            return [c[0] for c in self.calls]
+
+
+class _Slo:
+    """SLO engine stand-in with a dial: report() mirrors the real
+    engine's classes->latency->burn_rate shape."""
+
+    def __init__(self, burn=0.0):
+        self.burn = burn
+
+    def report(self):
+        return {"classes": {"online": {"latency":
+                                       {"burn_rate": self.burn}}}}
+
+
+def _sched(stub, num_recycles=6, spill_dir=None, bulk=None, slo=None,
+           continuous=False, max_batch=2, registry=None, **kw):
+    registry = registry or MetricsRegistry()
+    retry_kw = dict(backoff_base_s=0.0, jitter=0.0)
+    if spill_dir is not None:
+        retry_kw.update(checkpoint_every=1, checkpoint_spill=spill_dir)
+    return Scheduler(
+        stub, BucketPolicy((32,)),
+        SchedulerConfig(max_batch_size=max_batch, max_wait_ms=5.0,
+                        num_recycles=num_recycles, msa_depth=0,
+                        poll_ms=2.0),
+        recycle_policy=RecyclePolicy(converge_tol=0.0,
+                                     continuous=continuous),
+        retry=RetryPolicy(**retry_kw),
+        metrics=ServeMetrics(registry=registry), registry=registry,
+        bulk=bulk, slo=slo, **kw)
+
+
+def _req(token=7, length=12, qos="online", deadline_s=None):
+    return FoldRequest(seq=np.full(length, token, np.int32), qos=qos,
+                       deadline_s=deadline_s)
+
+
+def _wait_for(cond, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# -- policy + queue units ---------------------------------------------
+
+
+class TestBulkPolicy:
+    def test_defaults_valid(self):
+        p = BulkPolicy()
+        assert p.max_burn == 1.0 and p.max_pending == 10000 \
+            and p.check_interval_s == 1.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            BulkPolicy(max_burn=0.0)
+        with pytest.raises(ValueError):
+            BulkPolicy(max_pending=0)
+        with pytest.raises(ValueError):
+            BulkPolicy(check_interval_s=-1.0)
+
+
+class _Item:
+    def __init__(self, name, enqueued_at):
+        self.name = name
+        self.enqueued_at = enqueued_at
+
+
+class TestBulkQueue:
+    def test_fifo_per_bucket_and_push_front(self):
+        q = BulkQueue()
+        q.push(32, "a")
+        q.push(32, "b")
+        q.push(64, "c")
+        assert len(q) == 3
+        assert q.pending_for(32) == 2
+        q.push_front(32, "z")      # a yielded loop jumps the campaign
+        assert [q.take(32) for _ in range(3)] == ["z", "a", "b"]
+        assert q.take(32) is None
+        assert q.take(64) == "c"
+        assert len(q) == 0
+
+    def test_buckets_oldest_head_first(self):
+        q = BulkQueue()
+        q.push(64, _Item("late", 20.0))
+        q.push(32, _Item("early", 10.0))
+        q.push(64, _Item("later", 30.0))   # behind "late" in its bucket
+        assert q.buckets() == [32, 64]
+
+    def test_drain_and_snapshot(self):
+        q = BulkQueue()
+        q.push(32, "a")
+        q.push(64, "b")
+        assert q.snapshot() == {"pending": 2,
+                                "buckets": {32: 1, 64: 1}}
+        out = q.drain()
+        assert sorted(out) == ["a", "b"]
+        assert len(q) == 0 and q.snapshot()["pending"] == 0
+
+
+# -- qos field + wire header ------------------------------------------
+
+
+class TestQosWire:
+    def test_request_qos_validation(self):
+        assert _req().qos == "online"
+        assert _req(qos="bulk").qos == "bulk"
+        with pytest.raises(ValueError):
+            _req(qos="batchy")
+
+    def test_online_request_has_no_qos_header(self):
+        h = request_headers(_req())
+        assert "X-Qos" not in h
+
+    def test_bulk_qos_roundtrips_over_the_wire(self):
+        req = _req(token=5, qos="bulk")
+        h = request_headers(req)
+        assert h["X-Qos"] == "bulk"
+        got = decode_request(encode_request(req), h)
+        assert got.qos == "bulk"
+        assert np.array_equal(got.seq, req.seq)
+
+    def test_absent_header_decodes_online(self):
+        req = _req(token=5)
+        got = decode_request(encode_request(req),
+                             request_headers(req))
+        assert got.qos == "online"
+
+
+# -- scheduler choreography -------------------------------------------
+
+
+class TestSchedulerBulk:
+    def test_bulk_founds_when_idle(self):
+        """An idle scheduler folds bulk work and counts the admit."""
+        stub = _BkStub()
+        with _sched(stub, num_recycles=2, bulk=BulkPolicy()) as sched:
+            resp = sched.submit(_req(qos="bulk")).result(timeout=60)
+            assert resp.ok and resp.source == "fold"
+            stats = sched.serve_stats()["bulk"]
+            assert stats["admits"] == 1 and stats["pending"] == 0
+            assert stats["yields"] == 0 and not stats["gated"]
+
+    def test_online_founds_first(self):
+        """A racing online + bulk pair: online founds the first batch;
+        bulk (never a founder while online work is pending) follows."""
+        stub = _BkStub()
+        stub.gate_at = 1           # step indexes are 1-based
+        with _sched(stub, num_recycles=2, max_batch=1,
+                    bulk=BulkPolicy()) as sched:
+            t_on = sched.submit(_req(token=3))
+            assert stub.reached.wait(timeout=30)
+            t_bk = sched.submit(_req(token=9, qos="bulk"))
+            stub.release.set()
+            assert t_on.result(timeout=60).ok
+            assert t_bk.result(timeout=60).ok
+            inits = [c for c in stub.calls if c[0] == "init"]
+            assert inits[0][1] == [3] and [9] in [c[1] for c in inits]
+
+    def test_bulk_steals_freed_row_under_continuous_admission(self):
+        """With continuous admission on, queued bulk work rides a
+        freed row of a RUNNING online batch (init_rows, not a founded
+        batch) once the online queues are empty."""
+        stub = _BkStub()
+        stub.gate_at = 1
+        with _sched(stub, num_recycles=6, continuous=True,
+                    bulk=BulkPolicy()) as sched:
+            t_on = sched.submit(_req(token=3))
+            assert stub.reached.wait(timeout=30)
+            t_bk = sched.submit(_req(token=9, qos="bulk"))
+            stub.release.set()
+            assert t_on.result(timeout=60).ok
+            assert t_bk.result(timeout=60).ok
+            assert ("init_rows", [9]) in stub.calls
+            assert sched.serve_stats()["bulk"]["admits"] == 1
+
+    def test_without_bulk_policy_qos_folds_online(self):
+        """No BulkPolicy -> qos='bulk' is just an online fold: no bulk
+        stats key, no bulk metric names minted."""
+        stub = _BkStub()
+        reg = MetricsRegistry()
+        with _sched(stub, num_recycles=2, registry=reg) as sched:
+            assert sched.submit(_req(qos="bulk")).result(timeout=60).ok
+            assert "bulk" not in sched.serve_stats()
+        names = set(reg.snapshot())
+        assert not {"serve_bulk_admits_total", "serve_bulk_yields_total",
+                    "serve_bulk_gated"} & names
+
+    def test_bulk_metric_names_minted_with_policy(self):
+        reg = MetricsRegistry()
+        sched = _sched(_BkStub(), bulk=BulkPolicy(), registry=reg)
+        assert {"serve_bulk_admits_total", "serve_bulk_yields_total",
+                "serve_bulk_gated"} <= set(reg.snapshot())
+        sched.stop(drain=False)
+
+    def test_queue_full_rejects_and_drain_ignores_gate(self):
+        """max_pending bounds the bulk queue (QueueFullError, counted
+        as rejected); a draining stop resolves the gated backlog —
+        terminal resolution beats throttling."""
+        stub = _BkStub()
+        slo = _Slo(burn=10.0)      # gate closed: nothing founds
+        sched = _sched(stub, num_recycles=2, slo=slo,
+                       bulk=BulkPolicy(max_pending=1,
+                                       check_interval_s=0.0))
+        sched.start()
+        t1 = sched.submit(_req(token=3, qos="bulk"))
+        with pytest.raises(QueueFullError):
+            sched.submit(_req(token=9, qos="bulk"))
+        stats = sched.serve_stats()["bulk"]
+        assert stats["pending"] == 1 and stats["rejected"] == 1
+        sched.stop(drain=True)
+        assert t1.result(timeout=60).ok
+
+    def test_stop_without_drain_cancels_pending_bulk(self):
+        stub = _BkStub()
+        sched = _sched(stub, slo=_Slo(burn=10.0),
+                       bulk=BulkPolicy(check_interval_s=0.0))
+        sched.start()
+        t1 = sched.submit(_req(qos="bulk"))
+        sched.stop(drain=False)
+        assert t1.result(timeout=60).status == "cancelled"
+
+    def test_expired_bulk_sheds_at_admission(self):
+        """Bulk entries shed at take time, not via the online sweep."""
+        stub = _BkStub()
+        slo = _Slo(burn=10.0)
+        sched = _sched(stub, slo=slo,
+                       bulk=BulkPolicy(check_interval_s=0.0))
+        sched.start()
+        try:
+            t1 = sched.submit(_req(qos="bulk", deadline_s=0.01))
+            time.sleep(0.05)
+            slo.burn = 0.0         # open the gate; admission finds it dead
+            resp = t1.result(timeout=60)
+            assert resp.status == "shed"
+        finally:
+            sched.stop(drain=False)
+
+
+class TestYieldUnderBurn:
+    def test_bulk_yields_then_resumes_byte_equal(self, tmp_path):
+        """The acceptance choreography: a mid-flight bulk loop
+        checkpoint-and-yields at the first admission gap after online
+        burn crosses max_burn (admits gate, the row frees), then —
+        burn receding — resumes from the spilled checkpoint and
+        finishes byte-equal to an uninterrupted run with ZERO repeated
+        recycles."""
+        stub = _BkStub()
+        stub.gate_at = 1
+        slo = _Slo(burn=0.0)
+        sched = _sched(stub, num_recycles=6,
+                       spill_dir=str(tmp_path / "spill"), slo=slo,
+                       bulk=BulkPolicy(max_burn=1.0,
+                                       check_interval_s=0.0))
+        sched.start()
+        try:
+            t1 = sched.submit(_req(token=9, qos="bulk"))
+            assert stub.reached.wait(timeout=30)
+            slo.burn = 10.0        # online burn spikes mid-step
+            stub.release.set()
+            _wait_for(
+                lambda: sched.serve_stats()["bulk"]["yields"] >= 1,
+                what="bulk yield")
+            stats = sched.serve_stats()["bulk"]
+            assert stats["gated"] and stats["pending"] == 1
+            assert not t1.done()
+            steps_at_yield = stub.steps()
+            slo.burn = 0.0         # burn recedes: the campaign resumes
+            resp = t1.result(timeout=60)
+            assert resp.ok and resp.source == "fold"
+        finally:
+            sched.stop(drain=False)
+        # no recycle ran twice: resumed exactly at the spilled age
+        assert stub.steps() == 6
+        assert steps_at_yield < 6
+        spill = sched.serve_stats()["resilience"]["checkpoint_spill"]
+        assert spill["spill_resumes"] >= 1
+        assert sched.serve_stats()["bulk"]["yields"] == 1
+
+        # byte-equality against an uninterrupted reference loop
+        ref_stub = _BkStub()
+        with _sched(ref_stub, num_recycles=6) as ref:
+            ref_resp = ref.submit(_req(token=9)).result(timeout=60)
+        assert ref_resp.ok
+        assert np.array_equal(resp.coords, ref_resp.coords)
+        assert np.array_equal(resp.confidence, ref_resp.confidence)
+
+    def test_gate_reopens_without_yield_when_no_store(self, tmp_path):
+        """Without a spill store a yield would refold from zero, so
+        bulk rows run to completion even under burn."""
+        stub = _BkStub()
+        stub.gate_at = 1
+        slo = _Slo(burn=0.0)
+        sched = _sched(stub, num_recycles=4, slo=slo,
+                       bulk=BulkPolicy(max_burn=1.0,
+                                       check_interval_s=0.0))
+        sched.start()
+        try:
+            t1 = sched.submit(_req(token=9, qos="bulk"))
+            assert stub.reached.wait(timeout=30)
+            slo.burn = 10.0
+            stub.release.set()
+            resp = t1.result(timeout=60)
+            assert resp.ok
+            assert sched.serve_stats()["bulk"]["yields"] == 0
+        finally:
+            sched.stop(drain=False)
+        assert stub.steps() == 4

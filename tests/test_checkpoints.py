@@ -1,0 +1,584 @@
+"""Durable mid-loop checkpoint tests (ISSUE 18): the codec (roundtrip
+byte-equality, self-identification, sharding specs on a single chip
+AND an 8-fake-device mesh), the ByteStore enumeration satellites
+(keys()/scan(), TTL sweep during scan, the disk-TTL bugfix), the
+CheckpointStore tiers (spill/prune/latest/discard/survivors, stale-tag
+discard + counter, backend mirror, the peer duck-type), and the
+scheduler integration: spill-at-cadence, restart -> resume-at-age
+byte-equality with bounded recycles_lost, terminal discard, and the
+knob-off scrubbed-stats + metric-name identity pin.
+
+Scheduler tests run a pytree-carry scripted stub (numpy-only stubs
+snapshot as opaque reference leaves and are correctly refused by
+row_checkpoint) — coords accumulate multiplicatively so a refold from
+zero with fewer steps CANNOT byte-match a resumed loop.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu.cache.bytestore import ByteStore
+from alphafold2_tpu.cache.checkpoints import (CheckpointStore,
+                                              RowCheckpoint,
+                                              checkpoint_group,
+                                              checkpoint_key,
+                                              decode_checkpoint,
+                                              encode_checkpoint, key_age,
+                                              row_checkpoint,
+                                              sharding_from_spec,
+                                              sharding_spec)
+from alphafold2_tpu.obs.registry import MetricsRegistry
+from alphafold2_tpu.serve import (BucketPolicy, FoldRequest,
+                                  RecyclePolicy, RetryPolicy, Scheduler,
+                                  SchedulerConfig, ServeMetrics)
+
+
+# -- pytree-carry step stub -------------------------------------------
+
+
+class _PtState:
+    def __init__(self, coords, confidence, ids, counts):
+        self.coords = coords
+        self.confidence = confidence
+        self.ids = ids
+        self.counts = counts
+
+
+jax.tree_util.register_pytree_node(
+    _PtState,
+    lambda s: ((s.coords, s.confidence, s.ids, s.counts), None),
+    lambda aux, ch: _PtState(*ch))
+
+
+class _PtStub:
+    def __init__(self):
+        self.calls = []
+
+    def run_init(self, batch, trace=None, devices=None, mesh_shape=None):
+        seq = np.asarray(batch["seq"])
+        b, n = seq.shape
+        self.calls.append(("init", [int(i) for i in seq[:, 0]]))
+        return _PtState(jnp.zeros((b, n, 3), jnp.float32),
+                        jnp.zeros((b, n), jnp.float32),
+                        jnp.asarray(seq[:, 0], jnp.int32),
+                        jnp.zeros((b,), jnp.int32))
+
+    def run_init_rows(self, batch, state, row_mask, trace=None,
+                      devices=None, mesh_shape=None, span_attrs=None):
+        seq = np.asarray(batch["seq"])
+        b, n = seq.shape
+        mask = jnp.asarray(np.asarray(row_mask))
+        self.calls.append(("init_rows", int(np.asarray(row_mask).sum())))
+        return _PtState(
+            jnp.where(mask[:, None, None],
+                      jnp.zeros((b, n, 3), jnp.float32), state.coords),
+            jnp.where(mask[:, None],
+                      jnp.zeros((b, n), jnp.float32), state.confidence),
+            jnp.where(mask, jnp.asarray(seq[:, 0], jnp.int32), state.ids),
+            jnp.where(mask, 0, state.counts))
+
+    def run_step(self, batch, state, recycle_index, trace=None,
+                 devices=None, mesh_shape=None, span_attrs=None):
+        self.calls.append(("step", int(recycle_index)))
+        return _PtState(
+            state.coords * jnp.float32(1.01) + jnp.float32(1.0)
+            + state.ids[:, None, None].astype(jnp.float32) * 0.001,
+            state.confidence, state.ids, state.counts + 1)
+
+    def stats(self):
+        return {"calls": len(self.calls)}
+
+    def steps(self):
+        return sum(1 for c in self.calls if c[0] == "step")
+
+
+def _sched(stub, spill_dir, num_recycles=6, registry=None,
+           checkpoint_every=1, **kw):
+    registry = registry or MetricsRegistry()
+    return Scheduler(
+        stub, BucketPolicy((32,)),
+        SchedulerConfig(max_batch_size=2, max_wait_ms=5.0,
+                        num_recycles=num_recycles, msa_depth=0,
+                        poll_ms=2.0),
+        recycle_policy=RecyclePolicy(converge_tol=0.0),
+        retry=RetryPolicy(checkpoint_every=checkpoint_every,
+                          checkpoint_spill=spill_dir or "",
+                          backoff_base_s=0.0, jitter=0.0),
+        metrics=ServeMetrics(registry=registry), registry=registry,
+        **kw)
+
+
+def _req(token=7, length=12):
+    return FoldRequest(seq=np.full(length, token, np.int32))
+
+
+def _mk_ckpt(fold_key="fk", tag="t@1", age=3, n=8, with_msa=False,
+             leaves=None):
+    return RowCheckpoint(
+        fold_key=fold_key, model_tag=tag, age=age,
+        seq=np.arange(n, dtype=np.int32),
+        msa=(np.ones((2, n), np.int32) if with_msa else None),
+        leaves=(leaves if leaves is not None else
+                [("dev", np.arange(n * 3, dtype=np.float32)
+                  .reshape(1, n, 3), None),
+                 ("ref", 5, None)]),
+        created_s=123.0)
+
+
+# -- keys -------------------------------------------------------------
+
+
+class TestKeys:
+    def test_group_prefix_and_age_order(self):
+        g = checkpoint_group("fk", "t@1")
+        keys = [checkpoint_key("fk", "t@1", a) for a in (0, 2, 10)]
+        assert all(k.startswith(g + "-a") for k in keys)
+        assert sorted(keys) == keys            # zero-pad == age order
+        assert [key_age(k) for k in keys] == [0, 2, 10]
+
+    def test_tag_namespaces_group(self):
+        assert checkpoint_group("fk", "t@1") != checkpoint_group(
+            "fk", "t@2")
+        assert checkpoint_group("fk", "t@1") != checkpoint_group(
+            "other", "t@1")
+
+
+# -- codec ------------------------------------------------------------
+
+
+class TestCodec:
+    def test_roundtrip_byte_equality(self):
+        ck = _mk_ckpt(with_msa=True)
+        key = checkpoint_key(ck.fold_key, ck.model_tag, ck.age)
+        out = decode_checkpoint(key, encode_checkpoint(key, ck))
+        assert out.fold_key == ck.fold_key
+        assert out.model_tag == ck.model_tag
+        assert out.age == ck.age and out.created_s == ck.created_s
+        assert np.array_equal(out.seq, ck.seq)
+        assert np.array_equal(out.msa, ck.msa)
+        assert [k for k, _v, _s in out.leaves] == ["dev", "ref"]
+        assert out.leaves[0][1].tobytes() == ck.leaves[0][1].tobytes()
+        assert out.leaves[0][1].dtype == np.float32
+        assert out.leaves[1][1] == 5
+
+    def test_bfloat16_leaf_roundtrips(self):
+        import ml_dtypes
+        arr = np.arange(6, dtype=np.float32).reshape(1, 6).astype(
+            ml_dtypes.bfloat16)
+        ck = _mk_ckpt(leaves=[("dev", arr, None)])
+        key = checkpoint_key(ck.fold_key, ck.model_tag, ck.age)
+        out = decode_checkpoint(key, encode_checkpoint(key, ck))
+        assert out.leaves[0][1].dtype == arr.dtype
+        assert out.leaves[0][1].tobytes() == arr.tobytes()
+
+    def test_embedded_key_mismatch_raises(self):
+        ck = _mk_ckpt()
+        key = checkpoint_key(ck.fold_key, ck.model_tag, ck.age)
+        data = encode_checkpoint(key, ck)
+        with pytest.raises(ValueError):
+            decode_checkpoint(
+                checkpoint_key("other", ck.model_tag, ck.age), data)
+
+    def test_corrupt_bytes_raise(self):
+        ck = _mk_ckpt()
+        key = checkpoint_key(ck.fold_key, ck.model_tag, ck.age)
+        data = encode_checkpoint(key, ck)
+        with pytest.raises(Exception):
+            decode_checkpoint(key, data[: len(data) // 2])
+
+    def test_multi_row_leaf_refused(self):
+        ck = _mk_ckpt(leaves=[("dev", np.zeros((2, 4), np.float32),
+                               None)])
+        key = checkpoint_key(ck.fold_key, ck.model_tag, ck.age)
+        with pytest.raises(ValueError):
+            decode_checkpoint(key, encode_checkpoint(key, ck))
+
+
+# -- row slicing ------------------------------------------------------
+
+
+class TestRowCheckpoint:
+    def _snapshot(self, b=3, n=4):
+        from alphafold2_tpu.predict import snapshot_step_state
+        state = _PtState(
+            jnp.arange(b * n * 3, dtype=jnp.float32).reshape(b, n, 3),
+            jnp.ones((b, n), jnp.float32),
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.full((b,), 5, jnp.int32))
+        return snapshot_step_state(state)
+
+    def test_slices_one_row(self):
+        snap = self._snapshot()
+        ck = row_checkpoint(snap, 1, fold_key="fk", model_tag="t",
+                            age=2, seq=np.arange(4, dtype=np.int32))
+        coords = ck.leaves[0][1]
+        assert coords.shape == (1, 4, 3)
+        assert np.array_equal(
+            coords[0],
+            np.arange(12, dtype=np.float32).reshape(4, 3) + 12)
+        assert ck.leaves[3][1][0] == 5     # counts row
+
+    def test_opaque_reference_leaf_refused(self):
+        from alphafold2_tpu.predict import snapshot_step_state
+        snap = snapshot_step_state({"arr": jnp.zeros((2, 3)),
+                                    "opaque": object()})
+        with pytest.raises(ValueError):
+            row_checkpoint(snap, 0, fold_key="fk", model_tag="t",
+                           age=1, seq=np.arange(3, dtype=np.int32))
+
+    def test_restore_leaves_byte_equal(self):
+        snap = self._snapshot()
+        ck = row_checkpoint(snap, 2, fold_key="fk", model_tag="t",
+                            age=2, seq=np.arange(4, dtype=np.int32))
+        key = checkpoint_key("fk", "t", 2)
+        out = decode_checkpoint(key, encode_checkpoint(key, ck))
+        restored = out.restore_leaves()
+        assert len(restored) == 4
+        assert np.asarray(restored[0]).tobytes() == \
+            np.asarray(snap[1][0][1][2:3]).tobytes()
+
+
+# -- sharding specs ---------------------------------------------------
+
+
+class TestShardingSpecs:
+    def test_single_device_spec_is_none(self):
+        arr = jnp.zeros((2, 3))
+        assert sharding_spec(arr.sharding) is None or \
+            sharding_from_spec(sharding_spec(arr.sharding)) is not None
+
+    def test_mesh_spec_roundtrip_8_devices(self):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        devs = jax.devices()
+        assert len(devs) >= 8, "conftest forces 8 fake devices"
+        mesh = Mesh(np.asarray(devs[:8]).reshape(2, 4), ("dp", "mp"))
+        sh = NamedSharding(mesh, PartitionSpec(None, "mp"))
+        spec = sharding_spec(sh)
+        assert spec == {"kind": "named", "axes": ["dp", "mp"],
+                        "sizes": [2, 4], "spec": [None, "mp"]}
+        back = sharding_from_spec(spec)
+        assert back is not None
+        arr = jax.device_put(
+            np.arange(32, dtype=np.float32).reshape(4, 8), back)
+        assert np.array_equal(np.asarray(arr),
+                              np.arange(32, dtype=np.float32)
+                              .reshape(4, 8))
+
+    def test_mesh_sharded_checkpoint_roundtrips(self):
+        """The resume contract on a mesh: a leaf snapshotted from a
+        NamedSharding re-uploads byte-equal through its wire spec."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        from alphafold2_tpu.predict import snapshot_step_state
+        devs = jax.devices()
+        mesh = Mesh(np.asarray(devs[:8]).reshape(8), ("mp",))
+        sh = NamedSharding(mesh, PartitionSpec(None, "mp"))
+        coords = jax.device_put(
+            np.arange(2 * 8 * 3, dtype=np.float32).reshape(2, 8, 3),
+            NamedSharding(mesh, PartitionSpec(None, "mp", None)))
+        del sh
+        snap = snapshot_step_state({"coords": coords})
+        ck = row_checkpoint(snap, 1, fold_key="fk", model_tag="t",
+                            age=3, seq=np.arange(8, dtype=np.int32))
+        key = checkpoint_key("fk", "t", 3)
+        out = decode_checkpoint(key, encode_checkpoint(key, ck))
+        assert out.leaves[0][2] is not None        # spec traveled
+        restored = out.restore_leaves()[0]
+        assert np.asarray(restored).tobytes() == \
+            np.asarray(coords[1:2]).tobytes()
+
+
+# -- ByteStore enumeration satellites ---------------------------------
+
+
+def _bytestore(tmp_path, ttl_s=None, clock=time.time):
+    return ByteStore(
+        encode=lambda k, v: v, decode=lambda k, b: b,
+        max_bytes=1 << 20, max_entries=64, ttl_s=ttl_s,
+        disk_dir=str(tmp_path / "bs"), clock=clock)
+
+
+class TestByteStoreEnumeration:
+    def test_keys_sorted_and_prefix_filtered(self, tmp_path):
+        bs = _bytestore(tmp_path)
+        for k in ("aa1", "aa2", "bb1"):
+            bs.disk_put(k, b"v-" + k.encode())
+        assert bs.keys() == ["aa1", "aa2", "bb1"]
+        assert bs.keys("aa") == ["aa1", "aa2"]
+        assert bs.keys("zz") == []
+
+    def test_scan_yields_values(self, tmp_path):
+        bs = _bytestore(tmp_path)
+        bs.disk_put("aa1", b"one")
+        bs.disk_put("ab2", b"two")
+        assert dict(bs.scan("a")) == {"aa1": b"one", "ab2": b"two"}
+
+    def test_keys_sweeps_expired_from_disk(self, tmp_path):
+        """ISSUE-18 bugfix: disk TTL is enforced during enumeration,
+        not just on point get — the expired file is REMOVED, so a
+        restart-survivor sweep leaves no unreachable garbage. The
+        disk clock is file mtime, so expiry is simulated by
+        backdating the file."""
+        bs = _bytestore(tmp_path, ttl_s=10.0)
+        bs.disk_put("aa1", b"old")
+        bs.disk_put("aa2", b"new")
+        old = time.time() - 60
+        os.utime(bs.path("aa1"), (old, old))
+        assert bs.keys() == ["aa2"]
+        assert not os.path.exists(bs.path("aa1"))
+        assert bs.disk_get("aa2") is not None
+
+    def test_scan_quarantines_corrupt(self, tmp_path):
+        bs = ByteStore(
+            encode=lambda k, v: v,
+            decode=lambda k, b: (_ for _ in ()).throw(
+                ValueError("corrupt")) if b == b"bad" else b,
+            max_bytes=1 << 20, max_entries=64,
+            disk_dir=str(tmp_path / "bs"))
+        bs.disk_put("aa1", b"ok")
+        bs.disk_put("aa2", b"bad")
+        assert dict(bs.scan()) == {"aa1": b"ok"}
+
+
+# -- CheckpointStore --------------------------------------------------
+
+
+class TestCheckpointStore:
+    def test_put_prunes_older_ages_and_latest_wins(self, tmp_path):
+        st = CheckpointStore(str(tmp_path / "ck"), model_tag="t@1",
+                             registry=MetricsRegistry())
+        assert st.put_row(_mk_ckpt(age=1, tag="t@1")) is not None
+        assert st.put_row(_mk_ckpt(age=4, tag="t@1")) is not None
+        got = st.latest("fk")
+        assert got is not None and got.age == 4
+        # older age pruned from disk
+        assert st.store.keys(st.group("fk")) == [
+            checkpoint_key("fk", "t@1", 4)]
+
+    def test_discard_and_miss(self, tmp_path):
+        st = CheckpointStore(str(tmp_path / "ck"), model_tag="t@1",
+                             registry=MetricsRegistry())
+        st.put_row(_mk_ckpt(age=2))
+        st.discard("fk")
+        assert st.latest("fk") is None
+        assert st.stats.snapshot()["discards"] >= 1
+
+    def test_survivors_newest_per_group(self, tmp_path):
+        st = CheckpointStore(str(tmp_path / "ck"), model_tag="t@1",
+                             registry=MetricsRegistry())
+        st.put_row(_mk_ckpt(fold_key="f1", age=2))
+        st.put_row(_mk_ckpt(fold_key="f2", age=5))
+        got = {ck.fold_key: ck.age for _k, ck in st.survivors()}
+        assert got == {"f1": 2, "f2": 5}
+
+    def test_stale_tag_survivors_swept_with_counter(self, tmp_path):
+        st = CheckpointStore(str(tmp_path / "ck"), model_tag="t@1",
+                             registry=MetricsRegistry())
+        st.put_row(_mk_ckpt(age=2, tag="t@1"))
+        st.model_tag = "t@2"        # rollout re-tag
+        assert list(st.survivors()) == []
+        assert st.stats.snapshot()["stale_tag_discards"] >= 1
+
+    def test_latest_ignores_other_tag(self, tmp_path):
+        a = CheckpointStore(str(tmp_path / "ck"), model_tag="t@1",
+                            registry=MetricsRegistry())
+        a.put_row(_mk_ckpt(age=2, tag="t@1"))
+        a.model_tag = "t@2"
+        assert a.latest("fk") is None
+
+    def test_ttl_expires_checkpoints(self, tmp_path):
+        st = CheckpointStore(str(tmp_path / "ck"), model_tag="t@1",
+                             ttl_s=10.0, registry=MetricsRegistry())
+        key = st.put_row(_mk_ckpt(age=2))
+        old = time.time() - 60
+        os.utime(st.store.path(key), (old, old))
+        assert st.latest("fk") is None
+        assert list(st.survivors()) == []
+
+    def test_backend_mirror_and_fetch(self, tmp_path):
+        backend = {}
+        bk = type("Bk", (), {
+            "put": lambda self, k, v: backend.__setitem__(k, v),
+            "get": lambda self, k: backend.get(k)})()
+        a = CheckpointStore(str(tmp_path / "a"), model_tag="t@1",
+                            backend=bk, registry=MetricsRegistry())
+        a.put_row(_mk_ckpt(age=3))
+        assert len(backend) == 1       # mirrored under the GROUP key
+        assert set(backend) == {a.group("fk")}
+        # a different replica, same backend, empty local disk
+        b = CheckpointStore(str(tmp_path / "b"), model_tag="t@1",
+                            backend=bk, registry=MetricsRegistry())
+        got = b.latest("fk")
+        assert got is not None and got.age == 3
+        assert b.stats.snapshot()["backend_hits"] == 1
+        # promoted: next lookup is local
+        assert b.store.keys(b.group("fk"))
+
+    def test_peer_duck_type_fetch(self, tmp_path):
+        ck = _mk_ckpt(age=4)
+        key = checkpoint_key("fk", "t@1", 4)
+        raw = encode_checkpoint(key, ck)
+
+        class _Peer:
+            def fetch_checkpoint(self, group, model_tag=""):
+                return raw if group == checkpoint_group(
+                    "fk", "t@1") else None
+
+        st = CheckpointStore(str(tmp_path / "ck"), model_tag="t@1",
+                             peer=_Peer(), registry=MetricsRegistry())
+        got = st.latest("fk")
+        assert got is not None and got.age == 4
+        assert st.stats.snapshot()["peer_hits"] == 1
+        assert st.latest("other") is None
+
+    def test_latest_raw_serves_wire_bytes(self, tmp_path):
+        st = CheckpointStore(str(tmp_path / "ck"), model_tag="t@1",
+                             registry=MetricsRegistry())
+        st.put_row(_mk_ckpt(age=2))
+        raw = st.latest_raw(st.group("fk"))
+        assert raw is not None
+        out = decode_checkpoint(checkpoint_key("fk", "t@1", 2), raw)
+        assert out.age == 2
+        assert st.latest_raw("nope") is None
+
+
+# -- scheduler integration --------------------------------------------
+
+
+class TestSchedulerSpillResume:
+    def test_kill_restart_resume_byte_equal(self, tmp_path):
+        """The acceptance choreography: spill on, loop interrupted
+        (simulated by keeping the terminal checkpoint), restarted
+        scheduler resumes at the checkpointed age — coords byte-equal
+        to the uninterrupted run, recycles_lost <= checkpoint_every,
+        and the survivor shows up in the boot count."""
+        spill = str(tmp_path / "spill")
+        stub_a = _PtStub()
+        sa = _sched(stub_a, spill)
+        # simulate dying before retirement: keep the last spill
+        sa._ckpt_store.discard = lambda key: None
+        with sa:
+            ra = sa.submit(_req()).result(timeout=60)
+        assert ra.ok and stub_a.steps() == 6
+
+        stub_b = _PtStub()
+        sb = _sched(stub_b, spill)
+        assert sb._boot_survivors == 1
+        with sb:
+            rb = sb.submit(_req()).result(timeout=60)
+        assert rb.ok
+        st = sb.serve_stats()["resilience"]["checkpoint_spill"]
+        assert st["spill_resumes"] == 1
+        assert st["survivors_at_boot"] == 1
+        # checkpoint_every=1 -> at most 1 recycle refolds
+        assert stub_b.steps() <= 1
+        assert np.array_equal(ra.coords, rb.coords)
+        assert np.array_equal(ra.confidence, rb.confidence)
+
+    def test_terminal_resolution_discards_checkpoint(self, tmp_path):
+        spill = str(tmp_path / "spill")
+        stub = _PtStub()
+        s = _sched(stub, spill)
+        with s:
+            assert s.submit(_req()).result(timeout=60).ok
+        st = s.serve_stats()["resilience"]["checkpoint_spill"]
+        assert st["stats"]["spills"] >= 1
+        assert st["stats"]["discards"] >= 1
+        # nothing survives a clean completion
+        assert sum(1 for _ in s._ckpt_store.survivors()) == 0
+
+    def test_different_sequence_never_resumes(self, tmp_path):
+        """A colliding store key cannot inject another fold's carry:
+        the resume path validates the stored sequence against the
+        request's before touching the state."""
+        spill = str(tmp_path / "spill")
+        stub_a = _PtStub()
+        sa = _sched(stub_a, spill)
+        sa._ckpt_store.discard = lambda key: None
+        with sa:
+            assert sa.submit(_req(token=3)).result(timeout=60).ok
+
+        stub_b = _PtStub()
+        sb = _sched(stub_b, spill)
+        with sb:
+            rb = sb.submit(_req(token=9)).result(timeout=60)
+        assert rb.ok
+        st = sb.serve_stats()["resilience"]["checkpoint_spill"]
+        assert st["spill_resumes"] == 0
+        assert stub_b.steps() == 6      # refolded from zero
+
+    def test_rollout_retag_invalidates_survivors(self, tmp_path):
+        spill = str(tmp_path / "spill")
+        stub_a = _PtStub()
+        sa = _sched(stub_a, spill, model_tag="m@1")
+        sa._ckpt_store.discard = lambda key: None
+        with sa:
+            assert sa.submit(_req()).result(timeout=60).ok
+
+        stub_b = _PtStub()
+        sb = _sched(stub_b, spill, model_tag="m@1")
+        sb.model_tag = "m@2"           # rollout before the fold
+        with sb:
+            rb = sb.submit(_req()).result(timeout=60)
+        assert rb.ok
+        assert sb.serve_stats()["resilience"]["checkpoint_spill"][
+            "spill_resumes"] == 0
+        assert stub_b.steps() == 6
+
+
+class TestOffIdentity:
+    def test_spill_off_stats_and_metric_names_identical(self):
+        """checkpoint_spill off is byte-for-byte the PR 16 surface:
+        scrubbed serve_stats() identical to a policy that never heard
+        of the field, and none of the new metric names are minted."""
+        def scrub(obj):
+            if isinstance(obj, dict):
+                return {k: scrub(v) for k, v in sorted(obj.items())
+                        if k != "traces" and not k.endswith("_s")}
+            if isinstance(obj, list):
+                return [scrub(v) for v in obj]
+            return obj
+
+        def run_one(retry):
+            reg = MetricsRegistry()
+            sched = Scheduler(
+                _PtStub(), BucketPolicy((32,)),
+                SchedulerConfig(max_batch_size=2, max_wait_ms=5.0,
+                                num_recycles=2, msa_depth=0,
+                                poll_ms=2.0),
+                recycle_policy=RecyclePolicy(converge_tol=0.0),
+                retry=retry, metrics=ServeMetrics(registry=reg),
+                registry=reg)
+            with sched:
+                assert sched.submit(_req()).result(timeout=60).ok
+            return scrub(sched.serve_stats()), set(reg.snapshot())
+
+        off, names_off = run_one(
+            RetryPolicy(max_attempts=3, jitter=0.0,
+                        checkpoint_every=1, checkpoint_spill=""))
+        base, names_base = run_one(
+            RetryPolicy(max_attempts=3, jitter=0.0,
+                        checkpoint_every=1))
+        assert json.dumps(off, sort_keys=True, default=str) == \
+            json.dumps(base, sort_keys=True, default=str)
+        assert names_off == names_base
+        new = {"serve_spill_resumes_total",
+               "fold_checkpoint_events_total"}
+        assert not (new & names_base)
+
+    def test_spill_on_mints_new_names(self, tmp_path):
+        reg = MetricsRegistry()
+        _sched(_PtStub(), str(tmp_path / "s"), registry=reg)
+        names = set(reg.snapshot())
+        assert {"serve_spill_resumes_total",
+                "fold_checkpoint_events_total"} <= names
+
+    def test_spill_requires_checkpoint_cadence(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(checkpoint_spill="/tmp/x", checkpoint_every=0)

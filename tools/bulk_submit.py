@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""Proteome-scale bulk campaign driver (ISSUE 18).
+
+Reads a manifest of sequences (FASTA or JSONL), tokenizes CLIENT-side
+(data.featurize.tokenize — the bulk tier rides the tokenized front-door
+path; the raw/featurize pipeline stays online-only), and submits every
+unfinished sequence as `FoldRequest(qos="bulk")` against one replica's
+front door. The receiving scheduler parks bulk work in its BulkQueue:
+admitted only by work-stealing through freed batch rows, never ahead of
+online traffic, throttled by the SLO engine's burn rate
+(`serve.BulkPolicy`).
+
+The campaign is DURABLE and IDEMPOTENT:
+
+- every terminal result appends one JSONL record to the --ledger
+  (`{"id", "key", "status", "ts", ...}`);
+- a re-run loads the ledger first and skips sequences whose latest
+  status is done ("ok", "poisoned", "too_large" — refolding a poison
+  input or an impossible shape buys nothing), while "error"/"shed"/
+  "cancelled"/"degraded"/unrecorded sequences are submitted again;
+- kill the driver at any point and re-run with the same flags — the
+  ledger is the only state.
+
+--max-inflight bounds outstanding submissions (the replica's bulk
+queue has its own max_pending; a full queue or closed front door is
+retried with --retry-wait backoff). Exit 0 iff every manifest sequence
+has a terminal ledger state when the run ends.
+
+Usage:
+    python tools/bulk_submit.py proteome.fasta \
+        --url http://127.0.0.1:8000 --ledger campaign.jsonl \
+        --max-inflight 8
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# latest ledger status in this set == done forever; anything else is
+# retried on the next run
+DONE_STATUSES = ("ok", "poisoned", "too_large")
+
+
+def parse_manifest(path):
+    """Yield (id, seq_string) from FASTA (>id\\nSEQ) or JSONL
+    ({"id":..., "seq":...}) — sniffed per file from the first
+    non-blank character."""
+    with open(path) as fh:
+        first = ""
+        for line in fh:
+            if line.strip():
+                first = line.strip()[0]
+                break
+    if first == ">":
+        return list(_parse_fasta(path))
+    return list(_parse_jsonl(path))
+
+
+def _parse_fasta(path):
+    name, chunks = None, []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith(">"):
+                if name is not None and chunks:
+                    yield name, "".join(chunks)
+                name, chunks = line[1:].split()[0], []
+            else:
+                chunks.append(line)
+    if name is not None and chunks:
+        yield name, "".join(chunks)
+
+
+def _parse_jsonl(path):
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            rid = str(row.get("id", f"row-{lineno}"))
+            yield rid, str(row["seq"])
+
+
+def load_ledger(path):
+    """id -> latest recorded status (later lines win: the ledger is
+    append-only, one record per terminal result)."""
+    state = {}
+    if not path or not os.path.exists(path):
+        return state
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue          # torn tail from a killed driver
+            if "id" in rec and "status" in rec:
+                state[str(rec["id"])] = str(rec["status"])
+    return state
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("manifest", help="FASTA or JSONL sequence manifest")
+    ap.add_argument("--url", required=True,
+                    help="replica front-door base URL")
+    ap.add_argument("--ledger", required=True,
+                    help="campaign ledger JSONL (created if missing)")
+    ap.add_argument("--max-inflight", type=int, default=8,
+                    help="outstanding submissions bound")
+    ap.add_argument("--deadline-s", type=float, default=0.0,
+                    help="per-fold deadline (0 = none; bulk work "
+                         "usually wants none — the tier already "
+                         "yields to online load)")
+    ap.add_argument("--retry-wait", type=float, default=0.5,
+                    help="backoff when submit itself is refused "
+                         "(full bulk queue, draining front door)")
+    ap.add_argument("--submit-tries", type=int, default=20,
+                    help="submit attempts per sequence before "
+                         "recording a transport error for this run")
+    ap.add_argument("--poll-budget-s", type=float, default=600.0,
+                    help="max wait for one fold's terminal result")
+    args = ap.parse_args(argv)
+
+    import numpy as np  # noqa: F401  (transport decodes need it)
+
+    from alphafold2_tpu.data.featurize import tokenize
+    from alphafold2_tpu.fleet.rpc import HttpTransport
+    from alphafold2_tpu.serve import FoldRequest
+
+    rows = parse_manifest(args.manifest)
+    done = load_ledger(args.ledger)
+    todo = [(rid, seq) for rid, seq in rows
+            if done.get(rid) not in DONE_STATUSES]
+    print(f"manifest: {len(rows)} sequences, "
+          f"{len(rows) - len(todo)} already done, {len(todo)} to fold")
+    if not todo:
+        return 0
+
+    transport = HttpTransport(args.url,
+                              poll_budget_s=args.poll_budget_s)
+    ledger_lock = threading.Lock()
+    ledger_fh = open(args.ledger, "a")
+    sem = threading.Semaphore(max(1, args.max_inflight))
+    outstanding = []              # (id, ticket) for the final wait
+    statuses = {}
+
+    def record(rid, status, **extra):
+        rec = dict(id=rid, status=status, ts=time.time(), **extra)
+        with ledger_lock:
+            statuses[rid] = status
+            ledger_fh.write(json.dumps(rec) + "\n")
+            ledger_fh.flush()
+
+    def on_done(rid, t0):
+        def _cb(resp):
+            record(rid, resp.status, key=resp.request_id,
+                   latency_s=round(time.monotonic() - t0, 3),
+                   source=resp.source,
+                   **({"error": resp.error} if resp.error else {}))
+            sem.release()
+        return _cb
+
+    for rid, seq in todo:
+        sem.acquire()
+        try:
+            tokens = tokenize(seq)
+        except Exception as exc:
+            record(rid, "error", error=f"tokenize: {exc}")
+            sem.release()
+            continue
+        req = FoldRequest(
+            seq=tokens, qos="bulk",
+            deadline_s=(args.deadline_s or None))
+        ticket = None
+        for attempt in range(max(1, args.submit_tries)):
+            try:
+                ticket = transport.submit(req)
+                break
+            except Exception as exc:
+                err = str(exc)
+                time.sleep(args.retry_wait)
+        if ticket is None:
+            # transport never accepted it: NOT terminal-done — the
+            # next run retries this sequence
+            record(rid, "error", error=f"submit: {err}")
+            sem.release()
+            continue
+        t0 = time.monotonic()
+        ticket.add_done_callback(on_done(rid, t0))
+        outstanding.append((rid, ticket))
+
+    for rid, ticket in outstanding:
+        try:
+            ticket.result(timeout=args.poll_budget_s + 30.0)
+        except TimeoutError:
+            record(rid, "error", error="result timeout")
+    ledger_fh.close()
+
+    final = load_ledger(args.ledger)
+    missing = [rid for rid, _ in rows
+               if final.get(rid) not in DONE_STATUSES]
+    counts = {}
+    for rid, _ in rows:
+        counts[final.get(rid, "missing")] = \
+            counts.get(final.get(rid, "missing"), 0) + 1
+    print(f"campaign: {json.dumps(counts, sort_keys=True)}")
+    if missing:
+        print(f"{len(missing)} sequences NOT terminal-done "
+              f"(re-run to retry): {missing[:8]}"
+              f"{'...' if len(missing) > 8 else ''}")
+        return 1
+    print("campaign complete: every sequence terminal")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -614,6 +614,12 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="one JSON summary line instead of the human "
                          "report")
+    ap.add_argument("--since", type=float, default=None, metavar="TS",
+                    help="only consider controller decision records "
+                         "with ts >= TS (unix seconds) — pairs with "
+                         "the controller's decision-log retention so "
+                         "a long-lived fleet's report reads one "
+                         "window, not the whole history")
     args = ap.parse_args(argv)
 
     trace_files, prom_files, decision_files, _keys = gather_paths(
@@ -626,6 +632,9 @@ def main(argv=None) -> int:
         problems.append(f"no trace records under {args.paths}")
     decisions, decision_problems = load_decisions(decision_files)
     problems += decision_problems
+    if args.since is not None:
+        decisions = [d for d in decisions
+                     if float(d.get("ts", 0)) >= args.since]
 
     prom_by_source: Dict[str, str] = {}
     for path in prom_files:

@@ -206,7 +206,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 DURATION="${SMOKE_DURATION_S:-30}"
-PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8,9,10,11,12,13,14,15}"
+PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16}"
 
 phase_on() {
     case ",${PHASES}," in
@@ -1205,6 +1205,221 @@ print(f"CONTROL-PLANE SMOKE OK: zero operator verbs — "
       f"warm from telemetry predicted "
       f"{warm.get('predicted_hit_ratio'):.2f} "
       f"(realized {warm.get('realized_hit_ratio'):.2f})",
+      file=sys.stderr)
+EOF
+fi
+
+
+# phase 16: migratable folds + the bulk tier (ISSUE 18) — one replica
+# process with durable checkpoint spill + the bulk QoS class, a
+# proteome campaign (tools/bulk_submit.py: FASTA manifest -> durable
+# idempotent ledger) running UNDER an online wave, then a kill -9 +
+# restart + campaign re-run. Gates: bulk admits freeze at ZERO while
+# online work is pending and recover after the wave (the tier never
+# founds a batch ahead of online traffic); checkpoints actually
+# spill; the post-kill re-run skips already-done sequences
+# (idempotent ledger) and ends with EVERY manifest sequence in a
+# terminal state. The burn-rate yield choreography is pinned
+# in-process by tests/test_bulk.py (a stub SLO engine makes it
+# deterministic; wall-clock burn in a smoke is not).
+if phase_on 16; then
+rm -rf /tmp/serve_smoke_bulk
+mkdir -p /tmp/serve_smoke_bulk
+
+timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python - <<'EOF'
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+from alphafold2_tpu.data.featurize import tokenize
+from alphafold2_tpu.fleet.procfleet import ProcFleet
+from alphafold2_tpu.fleet.rpc import HttpTransport
+from alphafold2_tpu.serve import FoldRequest
+
+ROOT = "/tmp/serve_smoke_bulk"
+MANIFEST = os.path.join(ROOT, "proteome.fasta")
+LEDGER = os.path.join(ROOT, "campaign.jsonl")
+AAS = "ACDEFGHIKLMNPQRSTVWY"
+N_SEQS = 32
+
+# unique lengths/content per entry: no two campaign folds coalesce
+rng = random.Random(18)
+with open(MANIFEST, "w") as fh:
+    for i in range(N_SEQS):
+        seq = "".join(rng.choice(AAS) for _ in range(rng.randint(12, 24)))
+        fh.write(f">seq{i:03d}\n{seq}\n")
+
+
+def campaign(tag):
+    """One bulk_submit run; returns (exit_code, stdout)."""
+    p = subprocess.run(
+        [sys.executable, "tools/bulk_submit.py", MANIFEST,
+         "--url", URL, "--ledger", LEDGER, "--max-inflight", "4",
+         "--retry-wait", "0.25", "--submit-tries", "40",
+         "--poll-budget-s", "240"],
+        capture_output=True, text=True)
+    sys.stderr.write(f"[campaign {tag}] exit={p.returncode}\n"
+                     f"{p.stdout}{p.stderr}\n")
+    return p.returncode, p.stdout
+
+
+def ledger_counts():
+    done, seen = 0, set()
+    state = {}
+    if os.path.exists(LEDGER):
+        with open(LEDGER) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                state[rec.get("id")] = rec.get("status")
+    seen = set(state)
+    done = sum(1 for s in state.values()
+               if s in ("ok", "poisoned", "too_large"))
+    return done, seen
+
+
+problems = []
+# recycle= turns the step loop on: durable spill rides the step-mode
+# cadence gaps, so an opaque-fold replica would never spill
+fleet = ProcFleet(1, os.path.join(ROOT, "fleet"), buckets=(32,),
+                  max_batch=2, num_recycles=2,
+                  model={"dim": 32, "depth": 1, "msa_depth": 0},
+                  recycle={"converge_tol": 0.0},
+                  checkpoint_spill=True,
+                  bulk={"max_burn": 1.0, "check_interval_s": 0.25})
+fleet.start()
+try:
+    URL = fleet.replicas[0].frontdoor_url
+
+    def bulk_stats():
+        s = fleet.stats(0) or {}
+        return s.get("bulk") or {}
+
+    # run 1 rides in the background while the online wave lands
+    t0 = time.monotonic()
+    c1 = {}
+    th1 = threading.Thread(
+        target=lambda: c1.update(zip(("rc", "out"), campaign("run1"))),
+        daemon=True)
+    th1.start()
+
+    # the campaign must actually be folding before the wave starts
+    while not bulk_stats().get("admits"):
+        if time.monotonic() - t0 > 120:
+            problems.append("no bulk admits within 120s of campaign "
+                            f"start (stats {bulk_stats()})")
+            break
+        time.sleep(0.2)
+
+    # ONLINE WAVE: 24 folds submitted at once — while any of them is
+    # pending, the bulk tier must not found a single batch
+    transport = HttpTransport(URL, poll_budget_s=240.0)
+    wave_rng = random.Random(81)
+    tickets = []
+    for i in range(24):
+        seq = "".join(wave_rng.choice(AAS)
+                      for _ in range(wave_rng.randint(12, 24)))
+        tickets.append(transport.submit(
+            FoldRequest(seq=tokenize(seq))))
+    admits_a = bulk_stats().get("admits", 0)
+    mid = [t.result(timeout=240) for t in tickets[:12]]
+    admits_b = bulk_stats().get("admits", 0)
+    rest = [t.result(timeout=240) for t in tickets[12:]]
+    wave_ok = sum(1 for r in mid + rest if r.ok)
+    if wave_ok != 24:
+        problems.append(f"online wave: {wave_ok}/24 ok")
+    if admits_b != admits_a:
+        problems.append(
+            f"bulk admitted {admits_b - admits_a} batch slots while "
+            f"online work was pending (the tier must starve, not "
+            f"compete)")
+
+    # recovery: with the wave done, the campaign's admits move again
+    rec_t0 = time.monotonic()
+    while bulk_stats().get("admits", 0) <= admits_b:
+        if c1.get("rc") is not None and th1 is not None \
+                and not th1.is_alive():
+            break            # run 1 already finished — also recovery
+        if time.monotonic() - rec_t0 > 120:
+            problems.append("bulk admits never recovered after the "
+                            "online wave")
+            break
+        time.sleep(0.2)
+
+    # kill -9 mid-campaign (if run 1 is still going), restart, re-run:
+    # the ledger is the only state — the re-run must skip done work
+    # and finish the rest
+    killed = False
+    if th1.is_alive():
+        fleet.kill(0)
+        killed = True
+        th1.join(timeout=300)
+        fleet.restart(0)
+    else:
+        sys.stderr.write("[phase16] run 1 finished before the kill "
+                         "window; kill exercised on the re-run fleet\n")
+        fleet.kill(0)
+        killed = True
+        fleet.restart(0)
+
+    done_before, seen_before = ledger_counts()
+    rc2, out2 = campaign("run2")
+    if rc2 != 0:
+        # one more pass: run 2 itself may have straddled the restart
+        rc3, out3 = campaign("run3")
+        if rc3 != 0:
+            problems.append(f"campaign re-run exit {rc3} (run2 {rc2})")
+    done_after, seen_after = ledger_counts()
+    if done_after != N_SEQS:
+        problems.append(f"{N_SEQS - done_after} sequences not "
+                        f"terminal-done after re-run")
+    if killed and done_before < 1:
+        problems.append("kill landed before ANY sequence was done — "
+                        "idempotent-skip path never exercised")
+
+    stats = fleet.stats(0) or {}
+    spill = (stats.get("resilience") or {}).get("checkpoint_spill") or {}
+    spill_stats = spill.get("stats") or {}
+    final_bulk = stats.get("bulk") or {}
+    if not final_bulk.get("admits"):
+        problems.append("restarted replica shows no bulk admits")
+    # spills happen at every cadence gap while the knob is on — a run
+    # with zero spills means the spill store never engaged
+    if not spill_stats.get("spills"):
+        problems.append(f"no checkpoint spills recorded ({spill})")
+finally:
+    fleet.stop()
+
+summary = dict(problems=problems, wave_ok=wave_ok,
+               admits_frozen=(admits_b - admits_a) == 0,
+               done=done_after, total=N_SEQS,
+               done_before_rerun=done_before,
+               spills=spill_stats.get("spills"),
+               spill_resumes=spill.get("spill_resumes"),
+               survivors_at_boot=spill.get("survivors_at_boot"),
+               bulk=final_bulk)
+print(json.dumps(summary, indent=1, sort_keys=True, default=str))
+if problems:
+    print("BULK SMOKE FAIL: " + "; ".join(problems), file=sys.stderr)
+    sys.exit(1)
+print(f"BULK SMOKE OK: {N_SEQS}/{N_SEQS} sequences terminal across a "
+      f"kill -9 (ledger-idempotent re-run, {done_before} already done"
+      f"), bulk admits frozen at {admits_a} through a 24-fold online "
+      f"wave and recovered, {spill_stats.get('spills')} checkpoint "
+      f"spills, "
+      f"{spill.get('spill_resumes')} spill resumes, "
+      f"{spill.get('survivors_at_boot')} survivors at boot",
       file=sys.stderr)
 EOF
 fi
