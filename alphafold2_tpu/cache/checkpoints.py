@@ -327,6 +327,12 @@ class CheckpointStats:
             setattr(self, field, getattr(self, field) + n)
         self._m_events.inc(n, event=field)
 
+    def event(self, event: str, n: int = 1):
+        """Registry-only event, no snapshot field: occasional lifecycle
+        events (orphan sweeps) ride the same metric family without
+        widening FIELDS — snapshot()'s schema is pinned."""
+        self._m_events.inc(n, event=event)
+
     def snapshot(self) -> dict:
         with self._lock:
             return {f: getattr(self, f) for f in self.FIELDS}
@@ -367,6 +373,7 @@ class CheckpointStore:
         self.peer = peer
         self.stats = CheckpointStats(registry)
         self._clock = clock
+        self.orphan_sweeps = 0         # groups GC'd by sweep_orphans
         self.store = ByteStore(
             encode=encode_checkpoint, decode=decode_checkpoint,
             max_bytes=0, max_entries=0,      # durable tier only
@@ -518,6 +525,29 @@ class CheckpointStore:
         if removed:
             self.stats.bump("discards", removed)
 
+    def sweep_orphans(self, terminal_fold_keys) -> int:
+        """GC beyond TTL (ISSUE 19): drop every checkpoint group whose
+        fold key is in `terminal_fold_keys` — folds the ledger or the
+        quarantine already recorded as finished for good (served,
+        poisoned, permanently failed). TTL alone can strand these for
+        hours: a bulk campaign's served fold has no reason to keep its
+        mid-loop carry on disk until the clock runs out, and a
+        quarantined key's checkpoint would only ever resume into
+        another poisoning. Returns the number of GROUPS swept; counted
+        as `fold_checkpoint_events_total{event="orphan_sweep"}` (the
+        removed files themselves land in the ordinary `discards`
+        counter via discard())."""
+        swept = 0
+        for fold_key in terminal_fold_keys:
+            if not self.store.keys(self.group(fold_key)):
+                continue
+            self.discard(fold_key)
+            swept += 1
+        if swept:
+            self.orphan_sweeps += swept
+            self.stats.event("orphan_sweep", swept)
+        return swept
+
     def survivors(self, trace=NULL_TRACE
                   ) -> Iterator[Tuple[str, RowCheckpoint]]:
         """Boot-time discovery: every (store_key, checkpoint) the disk
@@ -561,10 +591,15 @@ class CheckpointStore:
             return 0
 
     def snapshot(self) -> dict:
-        return {"model_tag": self.model_tag,
-                "disk_dir": self.store.disk_dir,
-                "resident_keys": len(self.store.keys()),
-                "stats": self.stats.snapshot()}
+        out = {"model_tag": self.model_tag,
+               "disk_dir": self.store.disk_dir,
+               "resident_keys": len(self.store.keys()),
+               "stats": self.stats.snapshot()}
+        if self.orphan_sweeps:
+            # only after a sweep: a GC-less store's snapshot stays
+            # byte-identical to PR 18
+            out["orphan_sweeps"] = self.orphan_sweeps
+        return out
 
 
 def _peek_age(data: bytes) -> int:

@@ -74,6 +74,29 @@ class ModelConfig:
                 "window": self.sparse_window}
 
 
+def draft_preset(base: ModelConfig) -> ModelConfig:
+    """The draft-tier config derived from a flagship config (ISSUE 19).
+
+    HelixFold-style tiered efficiency: half the width, a third of the
+    depth (floored at 1) — the quadratic-in-dim trunk cost drops
+    roughly an order of magnitude while the architecture, attention
+    menu, and structure module stay the flagship's, so every serving
+    path (bucketing, kernel policy, mesh planning) works on the draft
+    unchanged. Deriving instead of hardcoding keeps the pair coupled:
+    a flagship config change cannot strand a stale draft preset.
+
+    The returned config is a DIFFERENT model with different params —
+    the cascade keys its cache entries apart by model_tag, never by
+    config digest, so the tag discipline (serve.cascade) still applies.
+    """
+    return dataclasses.replace(
+        base,
+        dim=max(base.dim // 2, 1),
+        depth=max(base.depth // 3, 1),
+        structure_module_depth=max(base.structure_module_depth // 2, 1),
+    )
+
+
 @dataclass
 class DataConfig:
     crop_len: int = 128

@@ -191,6 +191,110 @@ def merge_key_profiles(paths) -> List[dict]:
 
 # -- the controller -------------------------------------------------------
 
+def terminal_fold_keys(ledger_paths=(), quarantine_paths=()):
+    """Fold keys with a TERMINAL record — the sweep set for
+    `CheckpointStore.sweep_orphans` (ISSUE 19).
+
+    ledger_paths: bulk-campaign ledgers (tools/bulk_submit.py JSONL);
+        a record contributes when it carries a `fold_key` AND its
+        status is done-forever ("ok"/"poisoned"/"too_large" — the
+        driver's own DONE set; retryable statuses keep their
+        checkpoints, a resumed campaign wants them).
+    quarantine_paths: Quarantine persistence JSONL ({"key", "reason"});
+        every quarantined key is terminal by definition — its
+        checkpoint would only resume into another poisoning.
+
+    Unreadable files and torn lines are skipped: GC is best-effort and
+    must never take down the reconcile loop over a disk error.
+    """
+    done = ("ok", "poisoned", "too_large")
+    keys = set()
+    for path in ledger_paths:
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    fk = rec.get("fold_key")
+                    if fk and str(rec.get("status")) in done:
+                        keys.add(str(fk))
+        except OSError:
+            continue
+    for path in quarantine_paths:
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("key"):
+                        keys.add(str(rec["key"]))
+        except OSError:
+            continue
+    return keys
+
+
+class CheckpointGC:
+    """Reconcile-wired checkpoint GC (ISSUE 19): rate-limited
+    `sweep_orphans` over the terminal fold keys the campaign ledgers
+    and quarantine files record. TTL already bounds checkpoint
+    lifetime; this reclaims the disk EARLY for folds that provably
+    finished for good — a proteome campaign's served checkpoints must
+    not sit out their TTL on every replica's spill volume.
+
+    store: `cache.CheckpointStore` (anything with sweep_orphans).
+    ledger_paths / quarantine_paths: JSONL sources (static paths or a
+        zero-arg callable returning paths, for actuators whose
+        replica set moves).
+    interval_s: minimum seconds between sweeps — the reconcile loop
+        runs ~1/s and re-reading ledgers that often buys nothing.
+    """
+
+    def __init__(self, store, ledger_paths=(), quarantine_paths=(),
+                 interval_s: float = 60.0, clock=time.monotonic):
+        if store is None or not hasattr(store, "sweep_orphans"):
+            raise ValueError(
+                "CheckpointGC.store must expose sweep_orphans()")
+        if interval_s <= 0:
+            raise ValueError("interval_s must be > 0")
+        self.store = store
+        self.ledger_paths = ledger_paths
+        self.quarantine_paths = quarantine_paths
+        self.interval_s = float(interval_s)
+        self._clock = clock
+        self._last: Optional[float] = None
+        self.sweeps = 0
+        self.swept_groups = 0
+
+    def _paths(self, spec):
+        return list(spec() if callable(spec) else spec)
+
+    def run(self, now: Optional[float] = None) -> int:
+        """One rate-limited sweep; returns groups swept (0 when the
+        interval has not elapsed)."""
+        now = self._clock() if now is None else now
+        if self._last is not None and now - self._last < self.interval_s:
+            return 0
+        self._last = now
+        keys = terminal_fold_keys(self._paths(self.ledger_paths),
+                                  self._paths(self.quarantine_paths))
+        if not keys:
+            return 0
+        swept = int(self.store.sweep_orphans(sorted(keys)))
+        self.sweeps += 1
+        self.swept_groups += swept
+        return swept
+
+
 class FleetController:
     """One reconcile loop over an actuator exposing the fleet verbs.
 
@@ -221,6 +325,16 @@ class FleetController:
     warm / warm_top_k / warm_min_count / warm_max_inflight: telemetry-
         driven warming of the served-traffic head (needs the actuator's
         key_log_paths and replicas running `Scheduler(key_log=)`).
+        Warm folds ride `qos="bulk"` (ISSUE 19): on replicas with a
+        BulkPolicy they park in the bulk queue and are admitted only
+        through freed batch rows, so warming NEVER competes with
+        online traffic; bulk-less replicas serve them on the online
+        queue at priority -1, the old behavior.
+    checkpoint_gc: optional `CheckpointGC` — each reconcile runs one
+        rate-limited `CheckpointStore.sweep_orphans` pass over the
+        fold keys the campaign ledgers / quarantine files record as
+        terminal (ISSUE 19). None (default) = no GC, byte-identical
+        reconcile records.
     resize: feature-pool resize actuation on/off.
     boot_grace_s: how long a spawned-but-not-yet-joined endpoint
         counts as PENDING toward quorum and the max bound. A replica
@@ -247,6 +361,7 @@ class FleetController:
                  boot_grace_s: float = 180.0,
                  decision_log_max_bytes: int = 0,
                  decision_log_max_age_s: Optional[float] = None,
+                 checkpoint_gc: Optional[CheckpointGC] = None,
                  clock=time.monotonic):
         self.fleet = fleet
         self.policy = policy or ScalingPolicy()
@@ -262,6 +377,7 @@ class FleetController:
         self.rollout_attempts = int(rollout_attempts)
         self.rollout_backoff_s = float(rollout_backoff_s)
         self.boot_grace_s = float(boot_grace_s)
+        self.checkpoint_gc = checkpoint_gc
         # decision-log retention (ISSUE 18): a controller that runs
         # for weeks appends one JSONL record per reconcile — unbounded
         # by default (byte-identical to PR 16/17 behavior). When
@@ -464,6 +580,17 @@ class FleetController:
         warmed = self._warm_from_telemetry(endpoints, health) \
             if self.warm else 0
 
+        # 9. checkpoint GC (ISSUE 19): reclaim spill disk for folds
+        # the ledgers/quarantine prove finished for good
+        gc_swept = 0
+        if self.checkpoint_gc is not None:
+            try:
+                gc_swept = self.checkpoint_gc.run(now)
+            except Exception as exc:
+                # GC is best-effort; a disk error must not stop
+                # scaling/rollout actuation
+                record["checkpoint_gc_error"] = repr(exc)
+
         record.update({
             "joined": joined, "left": left, "swept": swept,
             "announced": announced,
@@ -484,6 +611,10 @@ class FleetController:
             "rollout_stragglers": stragglers,
             "warm_submissions": warmed,
         })
+        if self.checkpoint_gc is not None:
+            # only with the knob on: default reconcile records keep
+            # their PR-18 shape
+            record["checkpoint_gc_swept"] = gc_swept
         return record
 
     # -- membership fan-out ------------------------------------------------
@@ -750,7 +881,12 @@ class FleetController:
                     msa=(None if rec.get("msa") is None
                          else np.asarray(rec["msa"], np.int32)),
                     request_id=f"warm-{rec['digest'][:12]}",
-                    priority=-1)       # traffic always outranks warming
+                    priority=-1,       # traffic always outranks warming
+                    # bulk tier (ISSUE 19): on a BulkPolicy replica a
+                    # warm fold is admitted only through freed rows;
+                    # without one it rides online at priority -1 as
+                    # before — either way warming never preempts
+                    qos="bulk")
                 ticket = transport.submit(req)
             except Exception:
                 continue               # warm is best-effort by definition

@@ -127,7 +127,8 @@ class ProcFleet:
                  key_log: bool = False,
                  controller: Optional[dict] = None,
                  checkpoint_spill: bool = False,
-                 bulk: Optional[dict] = None):
+                 bulk: Optional[dict] = None,
+                 cascade: Optional[dict] = None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self.run_dir = os.path.abspath(run_dir)
@@ -156,7 +157,16 @@ class ProcFleet:
             checkpoint_spill=bool(checkpoint_spill),
             # bulk tier (ISSUE 18): serve.BulkPolicy kwargs; None =
             # no BulkQueue, qos="bulk" submits fold as plain online
-            bulk=(None if bulk is None else dict(bulk)))
+            bulk=(None if bulk is None else dict(bulk)),
+            # speculative cascade (ISSUE 19): each replica builds a
+            # small DRAFT model + scheduler (its own registry, shared
+            # fold cache under a distinct model_tag) and serves
+            # interactive traffic draft-first behind a confidence
+            # gate. Keys: model (draft model dims, default dim 16 /
+            # depth 1), num_recycles, accept_plddt, max_entropy,
+            # escalation_priority, draft_deadline_s. None = no
+            # cascade, byte-identical replicas
+            cascade=(None if cascade is None else dict(cascade)))
         # optional control plane (ISSUE 16, OFF when None — the
         # default, byte-identical to a controller-less fleet): dict of
         # fleet.ScalingPolicy knobs + FleetController kwargs; start()
@@ -228,6 +238,8 @@ class ProcFleet:
             retry=k["retry"],
             checkpoint_spill=k.get("checkpoint_spill", False),
             bulk=(None if k.get("bulk") is None else dict(k["bulk"])),
+            cascade=(None if k.get("cascade") is None
+                     else dict(k["cascade"])),
             peers=[p for p in all_rows
                    if p["replica_id"] != row["replica_id"]])
         if k["key_log"]:
@@ -728,7 +740,20 @@ def replica_main(config: dict) -> int:
             workers=int(feat_cfg.get("workers", 2)),
             cache=FeatureCache(disk_dir=os.path.join(
                 config["cache_dir"], "features")),
-            latency_s=float(feat_cfg.get("latency_ms", 0.0)) / 1000.0)
+            latency_s=float(feat_cfg.get("latency_ms", 0.0)) / 1000.0,
+            # featurize executor backend (ISSUE 19): "process" runs
+            # the pure featurize computation on a ProcessPoolExecutor
+            # (the GIL prerequisite for real jackhmmer/mmseqs)
+            executor=str(feat_cfg.get("executor", "thread")),
+            # express lane (ISSUE 19): the deterministic stub embedder
+            # stands in for a pretrained embedding-injection model, so
+            # qos="express" raw submits skip MSA prep entirely
+            express=(serve.StubEmbedder(
+                dim=int(feat_cfg.get("express_dim", 16)))
+                if feat_cfg.get("express") else None),
+            express_deadline_s=(
+                float(feat_cfg["express_deadline_ms"]) / 1000.0
+                if feat_cfg.get("express_deadline_ms") else None))
     # per-replica mesh policy from the fleet config (PR-7 ROADMAP item:
     # each replica pins its own chip SUBSET): the config's
     # mesh_device_share = [i, n] hands this replica the i-th 1/n chunk
@@ -767,6 +792,42 @@ def replica_main(config: dict) -> int:
     if config.get("key_log_path"):
         from alphafold2_tpu.serve.metrics import KeyFrequencyLog
         key_log = KeyFrequencyLog(config["key_log_path"])
+    # speculative cascade (ISSUE 19): a small draft model + scheduler
+    # on an ISOLATED registry (draft series must not sum into this
+    # replica's scrape), SHARING the fold cache under a distinct
+    # model_tag — tier isolation is by cache key construction
+    casc_cfg = config.get("cascade")
+    cascade_policy = None
+    draft_scheduler = None
+    if casc_cfg:
+        dcfg = dict(casc_cfg.get("model") or {"dim": 16, "depth": 1})
+        draft_model = Alphafold2(
+            dim=int(dcfg.get("dim", 16)),
+            depth=int(dcfg.get("depth", 1)), heads=2, dim_head=16,
+            predict_coords=True, structure_module_depth=1)
+        draft_params = draft_model.init(
+            jax.random.PRNGKey(1),
+            jnp.zeros((1, n0), jnp.int32), **init_kwargs)
+        draft_executor = serve.FoldExecutor(
+            draft_model, draft_params, max_entries=policy.num_buckets)
+        draft_scheduler = serve.build_draft_scheduler(
+            draft_executor, policy,
+            config=serve.SchedulerConfig(
+                max_batch_size=int(config["max_batch"]),
+                max_wait_ms=float(config["max_wait_ms"]),
+                num_recycles=int(casc_cfg.get("num_recycles", 0)),
+                msa_depth=msa_depth,
+                confidence_summary=True),
+            model_tag=f"{rollout.tag}#draft",
+            cache=cache)
+        cascade_policy = serve.CascadePolicy(
+            draft=draft_scheduler,
+            gate=serve.ConfidenceGate(
+                accept_plddt=float(casc_cfg.get("accept_plddt", 0.70)),
+                max_entropy=casc_cfg.get("max_entropy")),
+            escalation_priority=int(
+                casc_cfg.get("escalation_priority", 10)),
+            draft_deadline_s=casc_cfg.get("draft_deadline_s"))
     scheduler = serve.Scheduler(
         executor, policy,
         serve.SchedulerConfig(
@@ -780,7 +841,8 @@ def replica_main(config: dict) -> int:
         mesh_policy=mesh_policy, recycle_policy=recycle_policy,
         feature_pool=feature_pool, slo=slo_engine, key_log=key_log,
         bulk=(None if not config.get("bulk")
-              else serve.BulkPolicy(**config["bulk"])))
+              else serve.BulkPolicy(**config["bulk"])),
+        cascade=cascade_policy)
     # fleet tiers for the durable checkpoint store (ISSUE 18): this
     # replica's spills become fetchable by failover peers
     # (checkpoint_source below), and ITS resume path can pull a dead
@@ -797,6 +859,10 @@ def replica_main(config: dict) -> int:
 
     def _on_rollout(tag, epoch):
         scheduler.model_tag = tag    # O(1) under the state lock
+        if draft_scheduler is not None:
+            # the draft tier follows the rollout under its derived
+            # tag, so cross-tier key distinctness survives re-tagging
+            draft_scheduler.model_tag = f"{tag}#draft"
         rewarm.set()
 
     rollout.subscribe(_on_rollout)

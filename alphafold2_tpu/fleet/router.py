@@ -49,6 +49,26 @@ def _point(s: str) -> int:
         hashlib.blake2b(s.encode("utf-8"), digest_size=8).digest(), "big")
 
 
+def static_owner_for(key: str, replica_ids, vnodes: int = 64
+                     ) -> Optional[str]:
+    """Pure consistent-hash owner over a STATIC membership list — the
+    identical blake2b/vnode scheme ConsistentHashRouter builds over a
+    live registry, computable client-side with no registry at all.
+    Campaign drivers (tools/bulk_submit.py --fleet, ISSUE 19) use it
+    to shard a manifest exactly where the data plane's ring will look
+    for each fold key, so coalescing leadership, peer-cache homes, and
+    checkpoint locality all line up with the submit target. Returns
+    None on an empty membership list."""
+    ids = list(replica_ids)
+    if not ids:
+        return None
+    pairs = sorted((_point(f"{rid}#{i}"), rid)
+                   for rid in ids for i in range(int(vnodes)))
+    points = [p for p, _ in pairs]
+    start = bisect.bisect_left(points, _point(key)) % len(points)
+    return pairs[start][1]
+
+
 @dataclass
 class RouteDecision:
     """Where one key should fold and why."""

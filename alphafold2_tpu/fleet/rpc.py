@@ -68,9 +68,15 @@ _HDR_BUCKET = "X-Bucket-Len"
 _HDR_ERROR = "X-Error"
 _HDR_RECYCLES = "X-Recycles"         # step-mode: iterations executed
 _HDR_RECYCLE = "X-Recycle"           # progressive result: its iteration
-_HDR_QOS = "X-Qos"                   # "bulk" marks the background tier
-#                                      (absent == "online", so the
-#                                      pre-ISSUE-18 wire is unchanged)
+_HDR_QOS = "X-Qos"                   # "bulk" / "express" mark the
+#                                      non-default tiers (absent ==
+#                                      "online", so the pre-ISSUE-18
+#                                      wire is unchanged)
+# cascade provenance (ISSUE 19) — all absent outside a cascade, so the
+# pre-cascade response wire is byte-identical
+_HDR_TIER = "X-Tier"
+_HDR_ESCALATED = "X-Escalated"
+_HDR_CONFIDENCE = "X-Confidence-Score"
 
 
 # -- wire format ---------------------------------------------------------
@@ -158,6 +164,8 @@ def encode_raw_request(raw) -> tuple:
                "Content-Type": "application/json"}
     if raw.deadline_s is not None:
         headers[_HDR_DEADLINE] = repr(float(raw.deadline_s))
+    if getattr(raw, "qos", "online") != "online":
+        headers[_HDR_QOS] = raw.qos
     return body, headers
 
 
@@ -198,11 +206,13 @@ def decode_raw_request(body: bytes, headers):
     rid = headers.get(_HDR_REQUEST_ID)
     if rid:
         kwargs["request_id"] = rid
+    # an unknown qos raises ValueError from RawFoldRequest itself -> 400
     return RawFoldRequest(
         seq=seq, msa=msa,
         priority=int(headers.get(_HDR_PRIORITY, "0") or 0),
         deadline_s=None if deadline is None else float(deadline),
         forwarded=headers.get(_HDR_FORWARDED, "0") == "1",
+        qos=headers.get(_HDR_QOS) or "online",
         **kwargs)
 
 
@@ -238,6 +248,15 @@ def encode_response(response: FoldResponse) -> tuple:
     recycles = getattr(response, "recycles", None)
     if recycles is not None:
         headers[_HDR_RECYCLES] = str(int(recycles))
+    # getattr: pre-ISSUE-19 peers' responses have no cascade fields
+    tier = getattr(response, "tier", "")
+    if tier:
+        headers[_HDR_TIER] = tier
+    if getattr(response, "escalated", False):
+        headers[_HDR_ESCALATED] = "1"
+    confidence_score = getattr(response, "confidence_score", None)
+    if confidence_score is not None:
+        headers[_HDR_CONFIDENCE] = repr(float(confidence_score))
     if response.error:
         # headers must be latin-1-safe single-line; errors are ours
         headers[_HDR_ERROR] = str(response.error)[:512].replace(
@@ -266,6 +285,7 @@ def decode_response(body: bytes, headers) -> FoldResponse:
         raise ValueError("ok result fails shape validation")
     bucket = headers.get(_HDR_BUCKET)
     recycles = headers.get(_HDR_RECYCLES)
+    confidence_score = headers.get(_HDR_CONFIDENCE)
     return FoldResponse(
         request_id=headers.get(_HDR_REQUEST_ID, "?"),
         status=status, coords=coords, confidence=confidence,
@@ -273,7 +293,11 @@ def decode_response(body: bytes, headers) -> FoldResponse:
         error=headers.get(_HDR_ERROR) or None,
         source=headers.get(_HDR_SOURCE, "fold"),
         attempts=int(headers.get(_HDR_ATTEMPTS, "1") or 1),
-        recycles=None if recycles is None else int(recycles))
+        recycles=None if recycles is None else int(recycles),
+        tier=headers.get(_HDR_TIER) or "",
+        escalated=headers.get(_HDR_ESCALATED, "0") == "1",
+        confidence_score=(None if confidence_score is None
+                          else float(confidence_score)))
 
 
 # -- transports ----------------------------------------------------------

@@ -58,6 +58,12 @@ DEFAULT_BAD_STATUSES: Tuple[str, ...] = ("error", "poisoned")
 
 _LATENCY_METRIC = "serve_request_latency_seconds"
 _OUTCOME_METRIC = "serve_requests_total"
+# the express lane's own series (ISSUE 19): the scheduler tallies
+# qos="express" traffic under these IN ADDITION to the shared serve_*
+# pair, so an express SLO class reads the express tail without the
+# online majority diluting it
+_EXPRESS_LATENCY_METRIC = "serve_express_latency_seconds"
+_EXPRESS_OUTCOME_METRIC = "serve_express_requests_total"
 
 
 def burn_rate(bad_frac: float, allowed_frac: float) -> float:
@@ -86,6 +92,12 @@ class SLOClass:
     availability: floor on the good-terminal fraction; None = no
         availability objective.
     bad_statuses: terminal outcomes that spend availability budget.
+    latency_metric / outcome_metric: the registry series this class
+        evaluates over. The defaults are the shared serve_* pair every
+        request lands in; the express class (ISSUE 19) points at the
+        serve_express_* pair so its burn rate answers for ONLY the
+        express tail. Any override must keep ServeMetrics' label
+        schema — histogram labeled by bucket_len, counter by outcome.
     """
 
     name: str
@@ -94,10 +106,15 @@ class SLOClass:
     buckets: Tuple[int, ...] = ()
     availability: Optional[float] = 0.99
     bad_statuses: Tuple[str, ...] = DEFAULT_BAD_STATUSES
+    latency_metric: str = _LATENCY_METRIC
+    outcome_metric: str = _OUTCOME_METRIC
 
     def __post_init__(self):
         if not self.name:
             raise ValueError("SLOClass needs a name")
+        if not self.latency_metric or not self.outcome_metric:
+            raise ValueError(
+                "latency_metric/outcome_metric must be non-empty")
         if self.target_s is not None and self.target_s <= 0:
             raise ValueError(f"target_s must be > 0, got {self.target_s}")
         if not (0.0 < self.percentile <= 100.0):
@@ -134,8 +151,10 @@ class SLOPolicy:
         """The one CLI surface (`serve_loadtest --slo`, procfleet
         configs): comma-separated `CLASS=P99_MS` items where CLASS is
         a bucket edge (int — the class covers that bucket, named
-        "bucket<edge>") or `all`/`fleet` (every bucket, named as
-        given). The value is the latency target in MILLISECONDS, or
+        "bucket<edge>"), `all`/`fleet` (every bucket, named as
+        given), or `express` (every bucket, evaluated over the
+        serve_express_* series — the express lane's own SLO class,
+        ISSUE 19). The value is the latency target in MILLISECONDS, or
         `auto` (target_s None — a driver-side calibration hook;
         SLOEngine evaluates such a class availability-only, as
         procfleet replicas fed the driver's auto spec rely on).
@@ -165,6 +184,13 @@ class SLOPolicy:
                     name=key.lower(), target_s=target_s,
                     percentile=percentile, buckets=(),
                     availability=availability))
+            elif key.lower() == "express":
+                classes.append(SLOClass(
+                    name="express", target_s=target_s,
+                    percentile=percentile, buckets=(),
+                    availability=availability,
+                    latency_metric=_EXPRESS_LATENCY_METRIC,
+                    outcome_metric=_EXPRESS_OUTCOME_METRIC))
             else:
                 try:
                     edge = int(key)
@@ -265,14 +291,22 @@ class SLOEngine:
         self._reg = reg
         # the read side: get-or-create with the exact label schema
         # ServeMetrics declares, so engine-before-scheduler and
-        # scheduler-before-engine construction orders both work
-        self._h_latency = reg.histogram(
-            _LATENCY_METRIC,
-            "submit-to-resolve latency of served requests",
-            ("bucket_len",))
-        self._c_outcomes = reg.counter(
-            _OUTCOME_METRIC,
-            "terminal request outcomes by state", ("outcome",))
+        # scheduler-before-engine construction orders both work. One
+        # handle pair per DISTINCT metric pair the policy references —
+        # the shared serve_* pair for ordinary classes, the
+        # serve_express_* pair for an express class (ISSUE 19)
+        self._h_latency: Dict[str, object] = {}
+        self._c_outcomes: Dict[str, object] = {}
+        for c in policy.classes:
+            if c.latency_metric not in self._h_latency:
+                self._h_latency[c.latency_metric] = reg.histogram(
+                    c.latency_metric,
+                    "submit-to-resolve latency of served requests",
+                    ("bucket_len",))
+            if c.outcome_metric not in self._c_outcomes:
+                self._c_outcomes[c.outcome_metric] = reg.counter(
+                    c.outcome_metric,
+                    "terminal request outcomes by state", ("outcome",))
         # the signal surface: one gauge family per quantity, labeled
         # by objective (class) name
         self._g_attain = reg.gauge(
@@ -296,29 +330,38 @@ class SLOEngine:
             "windowed availability error-budget burn rate",
             ("objective",))
         self._lock = threading.Lock()
-        # (t, {"lat": {bucket_len: {edge_str: cum, "__count": n}},
-        #      "out": {outcome: n}}) — newest last. Seeded with an
-        # EMPTY boot snapshot so the first report() covers boot→now
-        # instead of differencing a snapshot against itself (zero
-        # requests on a server that just folded a hundred)
+        # (t, {"lat": {metric: {bucket_len: {edge_str: cum,
+        #                                    "__count": n}}},
+        #      "out": {metric: {outcome: n}}}) — newest last, keyed by
+        # metric name since classes may read different series. Seeded
+        # with an EMPTY boot snapshot so the first report() covers
+        # boot→now instead of differencing a snapshot against itself
+        # (zero requests on a server that just folded a hundred)
         self._samples: deque = deque(
             [(self._clock(), {"lat": {}, "out": {}})])
 
     # -- snapshots ---------------------------------------------------------
 
     def _counts(self) -> dict:
-        lat: Dict[int, dict] = {}
-        for sample in self._h_latency.samples():
-            try:
-                bucket_len = int(sample["labels"]["bucket_len"])
-            except (KeyError, ValueError):
-                continue
-            counts = dict(sample["buckets"])
-            counts["__count"] = sample["count"]
-            lat[bucket_len] = counts
-        out = {}
-        for sample in self._c_outcomes.samples():
-            out[sample["labels"].get("outcome", "?")] = sample["value"]
+        lat: Dict[str, dict] = {}
+        for metric, hist in self._h_latency.items():
+            per_bucket: Dict[int, dict] = {}
+            for sample in hist.samples():
+                try:
+                    bucket_len = int(sample["labels"]["bucket_len"])
+                except (KeyError, ValueError):
+                    continue
+                counts = dict(sample["buckets"])
+                counts["__count"] = sample["count"]
+                per_bucket[bucket_len] = counts
+            lat[metric] = per_bucket
+        out: Dict[str, dict] = {}
+        for metric, ctr in self._c_outcomes.items():
+            per_outcome = {}
+            for sample in ctr.samples():
+                per_outcome[sample["labels"].get("outcome", "?")] = \
+                    sample["value"]
+            out[metric] = per_outcome
         return {"lat": lat, "out": out}
 
     def _window_delta(self, now: float) -> Tuple[dict, dict, float]:
@@ -344,10 +387,12 @@ class SLOEngine:
     def _lat_delta(base: dict, snap: dict, cls_: SLOClass,
                    edge_key: str) -> Tuple[int, int]:
         good = total = 0
-        for bucket_len, counts in snap["lat"].items():
+        base_lat = base["lat"].get(cls_.latency_metric, {})
+        for bucket_len, counts in \
+                snap["lat"].get(cls_.latency_metric, {}).items():
             if not cls_.covers(bucket_len):
                 continue
-            b = base["lat"].get(bucket_len, {})
+            b = base_lat.get(bucket_len, {})
             good += counts.get(edge_key, 0) - b.get(edge_key, 0)
             total += counts.get("__count", 0) - b.get("__count", 0)
         return max(int(good), 0), max(int(total), 0)
@@ -356,8 +401,10 @@ class SLOEngine:
     def _out_delta(base: dict, snap: dict,
                    cls_: SLOClass) -> Tuple[int, int]:
         bad = total = 0
-        for outcome, n in snap["out"].items():
-            d = n - base["out"].get(outcome, 0)
+        base_out = base["out"].get(cls_.outcome_metric, {})
+        for outcome, n in \
+                snap["out"].get(cls_.outcome_metric, {}).items():
+            d = n - base_out.get(outcome, 0)
             total += d
             if outcome in cls_.bad_statuses:
                 bad += d
@@ -375,8 +422,9 @@ class SLOEngine:
             q_target = q_key = None
             good = total = 0
             if cls_.target_s is not None:
-                q_target = quantize_target(cls_.target_s,
-                                           self._h_latency.buckets)
+                q_target = quantize_target(
+                    cls_.target_s,
+                    self._h_latency[cls_.latency_metric].buckets)
                 q_key = f"{q_target:g}"
                 good, total = self._lat_delta(base, snap, cls_, q_key)
             bad_term, total_term = self._out_delta(base, snap, cls_)

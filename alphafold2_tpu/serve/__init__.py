@@ -46,6 +46,16 @@ The serving stack, bottom-up:
              queues' batches immediately, counting on admission to
              top them up (README "Iteration-level scheduling" /
              "Continuous batching")
+- cascade:   CascadePolicy/build_draft_scheduler + confidence:
+             ConfidenceGate/score_response — pass `Scheduler(cascade=
+             CascadePolicy(draft=build_draft_scheduler(...)))` and
+             interactive submits fold on a small draft tier first; a
+             confidence gate (mean pLDDT, optional distogram entropy)
+             accepts the draft or escalates to the flagship through
+             the ordinary submit seam. `qos="express"` +
+             `FeaturePool(express=StubEmbedder())` adds the MSA-free
+             express lane with its own metric/SLO class (README
+             "Model cascade & express lane")
 - resilience: RetryPolicy/CircuitBreaker/Quarantine — pass
              `Scheduler(..., retry=RetryPolicy(...))` for transient-
              batch retry, poison isolation by bisection + quarantine,
@@ -81,11 +91,19 @@ from alphafold2_tpu.obs import (MetricsRegistry, Tracer,  # noqa: F401
                                 get_registry, prometheus_text)
 from alphafold2_tpu.serve.bucketing import BucketPolicy, default_policy  # noqa: F401
 from alphafold2_tpu.serve.bulk import BulkPolicy, BulkQueue  # noqa: F401
+from alphafold2_tpu.serve.cascade import (CascadePolicy,  # noqa: F401
+                                          build_draft_scheduler)
+from alphafold2_tpu.serve.confidence import (ConfidenceGate,  # noqa: F401
+                                             ConfidenceScore,
+                                             distogram_entropy,
+                                             plddt_score, score_response)
 from alphafold2_tpu.serve.executor import FoldExecutor  # noqa: F401
 from alphafold2_tpu.serve.faults import FaultInjected, FaultPlan  # noqa: F401
 from alphafold2_tpu.serve.features import (FeaturePool,  # noqa: F401
                                            PipelineScheduler,
-                                           RawFoldRequest, featurize_raw,
+                                           RawFoldRequest, StubEmbedder,
+                                           express_featurize,
+                                           featurize_raw,
                                            featurizer_config_digest)
 from alphafold2_tpu.ops.block_sparse import KernelSpec  # noqa: F401
 from alphafold2_tpu.serve.kernelpolicy import KernelPolicy  # noqa: F401

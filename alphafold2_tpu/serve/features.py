@@ -58,9 +58,10 @@ queue-depth gauge, and featurize latency lands in a registry histogram
 
 from __future__ import annotations
 
+import functools
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence, Union
 
@@ -105,6 +106,12 @@ class RawFoldRequest:
         the WHOLE pipeline, featurize time included.
     forwarded: this job already took its one feature-key routing hop
         (fleet mode) — the receiver featurizes and folds locally.
+    qos: FoldRequest semantics, plus the raw-path meaning of
+        "express" (ISSUE 19): skip MSA prep entirely — the pool's
+        embedding-injection featurizer (FeaturePool(express=...))
+        builds single-sequence features, and the fold rides the
+        express deadline/SLO class. "online" (default) is byte-
+        for-byte the pre-express path.
     """
 
     seq: Union[str, np.ndarray]
@@ -113,6 +120,13 @@ class RawFoldRequest:
     priority: int = 0
     deadline_s: Optional[float] = None
     forwarded: bool = False
+    qos: str = "online"
+
+    def __post_init__(self):
+        if self.qos not in ("online", "bulk", "express"):
+            raise ValueError(
+                f"RawFoldRequest.qos must be 'online', 'bulk' or "
+                f"'express', got {self.qos!r}")
 
     @property
     def length(self) -> int:
@@ -157,6 +171,84 @@ def featurize_raw(raw: RawFoldRequest) -> FeaturizedInput:
     return FeaturizedInput(seq=tokens, msa=msa_tokens)
 
 
+# -- express lane: MSA-free featurization (ISSUE 19) ----------------------
+
+
+class StubEmbedder:
+    """Deterministic stand-in for a pretrained single-sequence embedder
+    (the `embeds.py` ESM/ProtTran wrappers' `embed_batch` contract):
+    per-position embeddings derived from the tokens by pure integer
+    numpy, byte-stable across processes and platforms — what CPU tests
+    and the loadtest need where a real language model would load
+    checkpoints. dim: embedding width (kept tiny; express features
+    only quantize it back down)."""
+
+    def __init__(self, dim: int = 16):
+        if dim < 1:
+            raise ValueError("StubEmbedder dim must be >= 1")
+        self.dim = int(dim)
+
+    @property
+    def digest(self) -> str:
+        """Identity folded into express feature keys — a different
+        embedder must never share cached features."""
+        return f"stub-embedder-v1-d{self.dim}"
+
+    def embed_batch(self, seq, msa=None):
+        """(n,) int tokens -> ((n, dim) float32 embedding, None).
+        Mirrors the reference wrappers' (seq_embed, msa_embed) return
+        shape; the stub has no MSA track."""
+        tokens = np.asarray(seq, dtype=np.int64).reshape(-1)
+        pos = np.arange(tokens.shape[0], dtype=np.int64)[:, None]
+        ch = np.arange(self.dim, dtype=np.int64)[None, :]
+        # LCG-style integer mix: deterministic, alphabet-sized inputs
+        # spread over the full int range before the float squash
+        mixed = (tokens[:, None] * 2654435761 + pos * 40503
+                 + ch * 69621 + 12345) % 2147483647
+        embed = (mixed.astype(np.float32) / 2147483647.0) * 2.0 - 1.0
+        return embed, None
+
+
+def express_featurize(raw: RawFoldRequest, embedder) -> FeaturizedInput:
+    """MSA-free express featurization: tokenize the sequence, embed it
+    with the single-sequence embedder, and inject the embedding into
+    the MSA track as one pseudo-row behind the query (HelixFold-
+    single's trick: the MSA transformer reads a derived row instead of
+    a real alignment, so the model runs at constant shallow depth with
+    no search — two rows here, query-first per the bucketing
+    convention). The
+    pseudo-row is the embedding quantized back into the token
+    alphabet — deterministic for a deterministic embedder, which is
+    what the byte-determinism test pins. Any raw MSA on the request is
+    IGNORED by design: express means "don't wait for alignments"."""
+    seq = raw.seq
+    tokens = tokenize(seq.strip()) if isinstance(seq, str) \
+        else np.asarray(seq, np.int32)
+    if tokens.ndim != 1 or tokens.shape[0] == 0:
+        raise ValueError(
+            f"express seq must featurize to a non-empty 1-D token "
+            f"array, got shape {tokens.shape}")
+    embed, _ = embedder.embed_batch(tokens)
+    embed = np.asarray(embed)
+    if embed.ndim != 2 or embed.shape[0] != tokens.shape[0]:
+        raise ValueError(
+            f"embedder returned shape {embed.shape}, expected "
+            f"({tokens.shape[0]}, d)")
+    # quantize each position's embedding into the token vocabulary:
+    # scale the per-position mean into [0, 1), then index the alphabet
+    vocab = len(constants.AA_ALPHABET)
+    mean = embed.mean(axis=-1)
+    lo, hi = float(mean.min()), float(mean.max())
+    span = hi - lo
+    if span <= 0:
+        pseudo = np.zeros_like(tokens)
+    else:
+        unit = (mean - lo) / span
+        pseudo = np.minimum((unit * vocab).astype(np.int32), vocab - 1)
+    msa = np.stack([tokens, pseudo], 0).astype(np.int32)
+    return FeaturizedInput(seq=tokens, msa=msa)
+
+
 class _Waiter:
     """One raw job parked on an in-flight featurize leader."""
 
@@ -195,6 +287,27 @@ class FeaturePool:
         coalesced waiter exactly like a real featurize failure;
         injected latency exercises the feature-deadline path). None
         (default) costs nothing.
+    executor: "thread" (default — byte-identical behavior) or
+        "process": featurize COMPUTATIONS run on a shared
+        ProcessPoolExecutor, sidestepping the GIL (the prerequisite
+        for real jackhmmer/mmseqs featurizers whose parsing is
+        CPU-bound Python). All coordination — coalescing, cache,
+        deadlines, traces, fold handoff — stays on the thread pool;
+        only the pure `featurize_fn(raw)` call crosses the process
+        boundary, so the semantics are identical. An unpicklable
+        featurize_fn/raw or a broken child degrades that job to
+        in-thread featurization (counted in snapshot
+        "process_fallbacks"), never to an error.
+    express: optional single-sequence embedder (the `embed_batch`
+        contract — StubEmbedder, or a real ESM/ProtTran wrapper)
+        enabling `RawFoldRequest(qos="express")`: MSA prep is bypassed
+        via `express_featurize`, keyed under the embedder's own digest
+        namespace so express and online features never collide. None
+        (default): express raw jobs resolve as errors.
+    express_deadline_s: cap on the FOLD deadline of express jobs (the
+        express lane's promise is tight tail latency — an express fold
+        that can't run promptly sheds instead of queueing). None =
+        no cap beyond the request's own deadline.
 
     Duplicate raw traffic dedups at this tier independently of fold
     traffic: an in-flight featurize of the same feature key coalesces
@@ -210,9 +323,18 @@ class FeaturePool:
                  featurize_fn: Optional[Callable] = None,
                  config_digest: Optional[str] = None,
                  faults=None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 executor: str = "thread",
+                 express=None,
+                 express_deadline_s: Optional[float] = None):
         if workers < 1:
             raise ValueError("FeaturePool needs at least 1 worker")
+        if executor not in ("thread", "process"):
+            raise ValueError(
+                f"FeaturePool executor must be 'thread' or 'process', "
+                f"got {executor!r}")
+        if express_deadline_s is not None and express_deadline_s <= 0:
+            raise ValueError("express_deadline_s must be > 0")
         self.workers = int(workers)
         self.cache = cache
         self.faults = faults
@@ -220,6 +342,21 @@ class FeaturePool:
         self.featurize_fn = featurize_fn or featurize_raw
         self.config_digest = (featurizer_config_digest()
                               if config_digest is None else config_digest)
+        self.executor = executor
+        self.express = express
+        self.express_deadline_s = express_deadline_s
+        # express features key under the embedder's identity, never the
+        # online featurizer's — a cached express pseudo-MSA must not
+        # serve an online job for the same sequence (or vice versa)
+        self._express_digest = None
+        if express is not None:
+            self._express_digest = stable_digest(
+                "express-featurizer", FEATURIZE_VERSION,
+                constants.AA_ALPHABET,
+                getattr(express, "digest", type(express).__name__))
+        self._proc_pool = (ProcessPoolExecutor(max_workers=self.workers)
+                           if executor == "process" else None)
+        self.process_fallbacks = 0     # jobs degraded to in-thread
         self._pool = ThreadPoolExecutor(
             max_workers=self.workers, thread_name_prefix="featurize")
         self._lock = threading.Lock()
@@ -275,6 +412,10 @@ class FeaturePool:
             self._retired_pools = []
         for pool in pools:
             pool.shutdown(wait=True)
+        # the process pool last: thread workers above may still be
+        # awaiting results from it
+        if self._proc_pool is not None:
+            self._proc_pool.shutdown(wait=True)
 
     def resize(self, workers: int) -> int:
         """Resize the worker pool IN PLACE (ISSUE 16 `/admin/resize`):
@@ -299,7 +440,13 @@ class FeaturePool:
             self._retired_pools.append(old)
             self.workers = workers
             self.resizes += 1
+            old_proc = self._proc_pool
+            if old_proc is not None:
+                self._proc_pool = ProcessPoolExecutor(
+                    max_workers=workers)
         old.shutdown(wait=False)     # drains queued jobs, blocks nothing
+        if self.executor == "process" and old_proc is not None:
+            old_proc.shutdown(wait=False)
         return workers
 
     def __enter__(self) -> "FeaturePool":
@@ -331,10 +478,20 @@ class FeaturePool:
             self._resolve_error(ticket, trace, raw,
                                 "feature pool stopped")
             return ticket
+        if getattr(raw, "qos", "online") == "express" \
+                and self.express is None:
+            # the async seam's ValueError: an express job without an
+            # embedder must fail loudly, not silently serve the full
+            # prep path under an express deadline it cannot meet
+            self._resolve_error(
+                ticket, trace, raw,
+                "qos='express' needs FeaturePool(express=...) — no "
+                "embedding-injection featurizer is configured")
+            return ticket
         key = None
         try:
             key = feature_key(raw.seq, raw.msa,
-                              config_digest=self.config_digest)
+                              config_digest=self._digest_for(raw))
         except Exception:
             pass          # unkeyable: featurize without dedup/caching
         if self._maybe_forward_raw(raw, key, scheduler, ticket, trace,
@@ -396,6 +553,46 @@ class FeaturePool:
             depth = self._depth
         self._g_depth.set(depth)
 
+    def _digest_for(self, raw) -> str:
+        """Feature-key config namespace for one raw job: the express
+        embedder's digest for express jobs, the featurizer's for
+        everything else — the two representations must never share
+        cache entries."""
+        if getattr(raw, "qos", "online") == "express" \
+                and self._express_digest is not None:
+            return f"express:{self._express_digest}"
+        return self.config_digest
+
+    def _fn_for(self, raw) -> Callable:
+        """The featurize implementation one raw job runs: the express
+        embedding-injection path for express jobs, the configured
+        featurize_fn otherwise. functools.partial keeps it picklable
+        for the process executor."""
+        if getattr(raw, "qos", "online") == "express":
+            return functools.partial(express_featurize,
+                                     embedder=self.express)
+        return self.featurize_fn
+
+    def _featurize_exec(self, raw, fn) -> FeaturizedInput:
+        """Run the pure featurize computation on the configured
+        executor. Process mode crosses the pickle boundary; anything
+        that breaks the CROSSING (unpicklable fn/raw, a killed child)
+        degrades to in-thread featurization — a real featurize failure
+        inside fn propagates either way."""
+        if self._proc_pool is None:
+            return fn(raw)
+        try:
+            return self._proc_pool.submit(fn, raw).result()
+        except Exception:
+            # pickling trouble, a killed child, a shut-down pool — and
+            # genuine featurize failures — all surface here; rather
+            # than classify exception types, re-run in-thread: a
+            # crossing problem succeeds inline, a real featurize
+            # failure raises the same error with its real reason
+            with self._lock:
+                self.process_fallbacks += 1
+            return fn(raw)
+
     # -- worker ----------------------------------------------------------
 
     def _run(self, key, raw, ticket, trace, t0, scheduler,
@@ -410,7 +607,7 @@ class FeaturePool:
                     self.faults.on_featurize(key)
                 if self.latency_s > 0:
                     time.sleep(self.latency_s)
-                feats = self.featurize_fn(raw)
+                feats = self._featurize_exec(raw, self._fn_for(raw))
             except Exception as exc:
                 self._settle_error(key, ticket, trace, raw,
                                    f"featurize failed: {exc!r}")
@@ -468,6 +665,7 @@ class FeaturePool:
         its ticket (terminal + progressive) onto the caller's. The
         remaining deadline is re-anchored: featurize time already spent
         counts against the raw job's budget."""
+        qos = getattr(raw, "qos", "online")
         deadline = raw.deadline_s
         if deadline is not None:
             deadline = deadline - (time.monotonic() - t0)
@@ -485,11 +683,19 @@ class FeaturePool:
                     error="deadline expired before features were ready "
                           "(feature_deadline_exceeded)"))
                 return
+        if qos == "express" and self.express_deadline_s is not None:
+            # the express promise is tail latency: the FOLD gets at
+            # most the express cap, even when the caller's own budget
+            # is looser — better an honest early shed than a p99 blown
+            # by queueing behind long folds
+            deadline = (self.express_deadline_s if deadline is None
+                        else min(deadline, self.express_deadline_s))
         try:
             request = FoldRequest(
                 seq=feats.seq, msa=feats.msa,
                 request_id=raw.request_id, priority=raw.priority,
-                deadline_s=deadline, forwarded=raw.forwarded)
+                deadline_s=deadline, forwarded=raw.forwarded,
+                qos=qos)
             inner = scheduler.submit(request, trace=trace)
         except Exception as exc:
             # the async seam cannot raise backpressure at the caller
@@ -545,7 +751,8 @@ class FeaturePool:
                                request_id=raw.request_id,
                                priority=raw.priority,
                                deadline_s=raw.deadline_s,
-                               forwarded=True),
+                               forwarded=True,
+                               qos=getattr(raw, "qos", "online")),
                 trace=trace)
         except Exception:
             try:
@@ -616,6 +823,17 @@ class FeaturePool:
             # only after a resize: an untouched pool's snapshot stays
             # byte-identical to PR 15 (controller-off stats pin)
             out["resizes"] = self.resizes
+        if self.executor != "thread":
+            # non-default executors only: the thread-pool snapshot
+            # stays byte-identical to PR 18
+            out["executor"] = self.executor
+            out["process_fallbacks"] = self.process_fallbacks
+        if self.express is not None:
+            out["express"] = {
+                "embedder": getattr(self.express, "digest",
+                                    type(self.express).__name__),
+                "deadline_s": self.express_deadline_s,
+            }
         out["featurize_p50_s"] = self._latency.percentile(50)
         out["featurize_p99_s"] = self._latency.percentile(99)
         if self.cache is not None:

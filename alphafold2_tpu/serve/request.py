@@ -39,10 +39,15 @@ class FoldRequest:
         locally regardless of its own ring view, so divergent membership
         views can bounce a request once, never loop it.
     qos: "online" (the default — every pre-bulk caller, byte-for-byte
-        the old behavior) or "bulk": lowest-QoS sweep work that rides
+        the old behavior), "bulk" (lowest-QoS sweep work that rides
         the scheduler's BulkQueue, admitted only by work-stealing and
-        throttled by online burn rate (ISSUE 18). Ignored by
-        schedulers constructed without a BulkPolicy.
+        throttled by online burn rate, ISSUE 18; ignored by schedulers
+        constructed without a BulkPolicy), or "express" (interactive
+        single-sequence traffic, ISSUE 19: rides the online queue —
+        same admission, same shedding — but is tallied under its own
+        metric/SLO class so tight-deadline traffic is observable and
+        burn-rate-gated separately; the MSA bypass itself lives in
+        serve.features, not here).
     """
 
     seq: np.ndarray
@@ -54,9 +59,9 @@ class FoldRequest:
     qos: str = "online"
 
     def __post_init__(self):
-        if self.qos not in ("online", "bulk"):
+        if self.qos not in ("online", "bulk", "express"):
             raise ValueError(
-                f"FoldRequest.qos must be 'online' or 'bulk', "
+                f"FoldRequest.qos must be 'online', 'bulk' or 'express', "
                 f"got {self.qos!r}")
         self.seq = np.asarray(self.seq, dtype=np.int32)
         if self.seq.ndim != 1:
@@ -119,6 +124,22 @@ class FoldResponse:
     # opaque-fold path). With early exit this can be < the configured
     # num_recycles: the element converged and skipped the rest.
     recycles: Optional[int] = None
+    # cascade provenance (ISSUE 19) — defaults are the non-cascade
+    # values, so every pre-cascade serving path is byte-identical.
+    # tier: "" outside a cascade; "draft" when a draft-tier result was
+    #       accepted by the confidence gate, "flagship" when the
+    #       flagship tier produced/served it under a cascade.
+    # escalated: the draft result failed the gate (or errored) and
+    #       this response came from the flagship escalation.
+    # confidence_score: the gate's scalar (ConfidenceScore.score) for
+    #       cascade-served results; None everywhere else.
+    tier: str = ""
+    escalated: bool = False
+    confidence_score: Optional[float] = None
+    # mean normalized distogram entropy, computed at batch finish only
+    # under SchedulerConfig(confidence_summary=True) — the distogram
+    # itself is (n, n, bins) and never rides a response
+    distogram_entropy: Optional[float] = None
 
     @property
     def ok(self) -> bool:

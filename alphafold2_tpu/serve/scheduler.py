@@ -138,6 +138,8 @@ from alphafold2_tpu.obs.registry import MetricsRegistry, get_registry
 from alphafold2_tpu.obs.trace import (MultiTrace, NULL_TRACE, NULL_TRACER,
                                       Tracer)
 from alphafold2_tpu.serve.bucketing import BucketPolicy
+from alphafold2_tpu.serve.confidence import (
+    distogram_entropy as _distogram_entropy, score_response)
 from alphafold2_tpu.serve.executor import FoldExecutor
 from alphafold2_tpu.serve.meshpolicy import (AdmissionPricer, MeshPolicy,
                                              SliceLease, chips_of)
@@ -184,6 +186,13 @@ class SchedulerConfig:
     # (an in-flight duplicate costs ~0 to serve). 0 (default) = off:
     # duplicates respect queue_limit exactly like novel work.
     parked_bytes_budget: int = 0
+    # Summarize the distogram head at batch finish (ISSUE 19): each ok
+    # response carries its mean normalized distogram entropy
+    # (FoldResponse.distogram_entropy) so a cascade confidence gate can
+    # read global uncertainty, not just pointwise pLDDT. Opaque-fold
+    # path only (the step loop discards per-step distograms); off by
+    # default — responses stay byte-identical.
+    confidence_summary: bool = False
 
     def __post_init__(self):
         if self.full_policy not in ("reject", "block"):
@@ -345,6 +354,17 @@ class Scheduler:
         rates from the registry's own histograms/counters;
         serve_stats()["slo"] carries the report and slo_* gauges ride
         every /metrics scrape (ISSUE 15).
+    cascade: optional serve.cascade.CascadePolicy (OFF when None — the
+        default, byte-for-byte PR-18 behavior pinned by scrubbed-stats
+        and metric-name-set identity tests). Interactive submits fold
+        on the policy's DRAFT scheduler first; a confidence gate
+        (serve/confidence.py) accepts the draft result (tier="draft")
+        or escalates to this flagship through the ordinary submit seam
+        (tier="flagship", escalated=True) with a priority boost and
+        the remaining deadline. The two tiers share a FoldCache under
+        distinct model_tags; a key collision is counted in
+        serve_cascade_cross_tier_hits_total (pinned to 0) and
+        escalated instead of served (ISSUE 19).
     """
 
     def __init__(self, executor: FoldExecutor, buckets: BucketPolicy,
@@ -364,7 +384,8 @@ class Scheduler:
                  kernel_policy=None,
                  slo=None,
                  key_log=None,
-                 bulk=None):
+                 bulk=None,
+                 cascade=None):
         self.executor = executor
         # optional serve.metrics.KeyFrequencyLog (OFF when None — the
         # default, byte-identical): ingress submits (forwarded hops
@@ -515,6 +536,40 @@ class Scheduler:
             self._g_bulk_gated = reg.gauge(
                 "serve_bulk_gated",
                 "1 while bulk admission is gated by online burn rate")
+        # speculative cascade (ISSUE 19): draft-first folding with a
+        # confidence gate, escalation through this very submit seam.
+        # OFF when None — the default, byte-identical stats and
+        # registry metric-name set (the identity tests pin it)
+        self.cascade = cascade
+        self._n_draft_accepted = 0
+        self._n_escalated = 0
+        self._n_draft_errors = 0
+        self._n_cross_tier_hits = 0
+        self._confidence_sum = 0.0        # over gate-scored drafts
+        self._confidence_n = 0
+        if cascade is not None:
+            if getattr(cascade.draft, "model_tag", "") == model_tag:
+                raise ValueError(
+                    f"cascade draft model_tag {model_tag!r} collides "
+                    f"with the flagship's — the shared FoldCache keys "
+                    f"tiers apart by tag, so they MUST differ")
+            self._c_cascade = reg.counter(
+                "serve_cascade_requests_total",
+                "cascaded submits by tier and gate outcome",
+                ("tier", "outcome"))
+            self._c_cross_tier = reg.counter(
+                "serve_cascade_cross_tier_hits_total",
+                "cascaded submits whose draft and flagship cache keys "
+                "collided (MUST stay 0: fold_key embeds model_tag; a "
+                "nonzero value means a keying regression could serve "
+                "draft structures to flagship callers)")
+        # express QoS lane (ISSUE 19): counters minted LAZILY on the
+        # first express submit so a scheduler that never sees express
+        # traffic keeps the registry metric-name set byte-identical
+        self._registry = reg
+        self._c_express = None
+        self._h_express = None
+        self._express_counts: Dict[str, int] = {}
         # step-mode recycle scheduling (before the mesh block: the LRU
         # autosizing below must know whether each (bucket, slice) needs
         # one executable or the init+step pair)
@@ -727,6 +782,10 @@ class Scheduler:
             self._running = True
             self._drain = True
             self._draining = False
+        # the cascade is one serving unit: the draft tier comes up with
+        # the flagship (unless its lifecycle is owned elsewhere)
+        if self.cascade is not None and self.cascade.manage_draft:
+            self.cascade.draft.start()
         if self._allocator is not None and self._mesh_pool is None:
             self._mesh_pool = ThreadPoolExecutor(
                 max_workers=max(1, self._allocator.total_devices),
@@ -747,6 +806,11 @@ class Scheduler:
         if self._worker is not None:
             self._worker.join()
             self._worker = None
+        # stop the draft AFTER the flagship worker: in-flight cascade
+        # callbacks may still escalate into (or resolve off) the draft
+        # until the flagship queue drained
+        if self.cascade is not None and self.cascade.manage_draft:
+            self.cascade.draft.stop(drain=drain)
         if self.key_log is not None:
             self.key_log.flush()   # profile durable across restarts
         if self._mesh_pool is not None:
@@ -942,17 +1006,29 @@ class Scheduler:
                 raise RuntimeError("Scheduler.submit() before start()")
 
     def submit(self, request: FoldRequest,
-               trace=None) -> FoldTicket:
+               trace=None, _escalation: bool = False) -> FoldTicket:
         """trace: an already-started obs.Trace to continue instead of
         minting a fresh one — the feature pool passes the raw job's
         trace so its `featurize` span and the fold stages land in ONE
         record. None (the default, every pre-pipeline caller) is
-        byte-for-byte the old behavior."""
+        byte-for-byte the old behavior.
+
+        _escalation (private): this submit IS a cascade escalation —
+        skip the cascade branch and ride the ordinary flagship path,
+        so an escalated request can never recurse into a second draft
+        attempt."""
         bucket_len = self.buckets.bucket_for(request.length)  # fail fast
         entry = _Entry(request, bucket_len)
         entry.trace = (trace if trace is not None
                        else self.tracer.start_trace(request.request_id))
         entry.trace.begin("submit")
+        # express lane accounting (ISSUE 19): every terminal outcome of
+        # an express-QoS request lands in its own metric class, armed
+        # here so each of submit()'s many terminal paths is covered
+        # uniformly. Lazy mint: no express traffic, no express metrics.
+        if getattr(request, "qos", "online") == "express" \
+                and not _escalation:
+            self._arm_express(entry)
         # draining beats everything, cache hits included: a replica
         # being rolled must shrink to empty, and its caller must take
         # the work to a peer that will still be alive to serve it
@@ -1009,6 +1085,20 @@ class Scheduler:
                 self._degraded_shed(entry)
                 return entry.ticket
             return self._submit_bulk(entry)
+        # speculative cascade (ISSUE 19): interactive classes fold on
+        # the draft tier first; the confidence gate accepts or
+        # escalates back through this seam (_escalation=True). This
+        # sits BEFORE the cache/coalesce block: a cascaded entry must
+        # not become a flagship coalescing LEADER — a draft-accepted
+        # leader would settle its flagship-keyed followers with a
+        # draft result. Bulk never cascades (background work has no
+        # latency to speculate for, and a draft+flagship double fold
+        # would cost MORE accelerator-seconds, the one thing bulk
+        # optimizes).
+        if self.cascade is not None and not _escalation \
+                and getattr(request, "qos", "online") != "bulk":
+            self._raise_unless_running(entry)
+            return self._submit_cascade(entry)
         if self.cache is not None or self.router is not None:
             self._raise_unless_running(entry)
             if self.cache is not None \
@@ -1099,11 +1189,20 @@ class Scheduler:
         from alphafold2_tpu.serve.features import featurize_raw
         if self.feature_pool is not None:
             return self.feature_pool.submit_raw(raw, self, trace=trace)
+        if getattr(raw, "qos", "online") == "express":
+            # the express lane IS the MSA-bypass featurizer — without a
+            # FeaturePool carrying one, "express" would silently serve
+            # the full prep path under an express deadline it can't meet
+            raise ValueError(
+                "qos='express' needs a FeaturePool with an express "
+                "featurizer (Scheduler(feature_pool=FeaturePool("
+                "express=...)))")
         feats = featurize_raw(raw)
         return self.submit(FoldRequest(
             seq=feats.seq, msa=feats.msa, request_id=raw.request_id,
             priority=raw.priority, deadline_s=raw.deadline_s,
-            forwarded=raw.forwarded), trace=trace)
+            forwarded=raw.forwarded,
+            qos=getattr(raw, "qos", "online")), trace=trace)
 
     # -- cache / coalescing ----------------------------------------------
 
@@ -1317,6 +1416,221 @@ class Scheduler:
         # becoming leader and the breaker check inherit the same state
         # (no-op for non-leaders)
         self._settle_followers(entry, resp)
+
+    # -- speculative cascade + express lane (ISSUE 19) --------------------
+
+    def _arm_express(self, entry: _Entry):
+        """Route every terminal outcome of an express-QoS request into
+        the express metric class (counter by outcome, latency histogram
+        by bucket) via a ticket done-callback — one hook covers all of
+        submit()'s terminal paths uniformly. Metrics are minted on the
+        FIRST express submit: a scheduler that never sees express
+        traffic keeps the registry metric-name set byte-identical."""
+        if self._c_express is None:
+            self._c_express = self._registry.counter(
+                "serve_express_requests_total",
+                "terminal outcomes of express-QoS requests",
+                ("outcome",))
+            self._h_express = self._registry.histogram(
+                "serve_express_latency_seconds",
+                "submit-to-resolve latency of served express requests",
+                ("bucket_len",))
+
+        def _done(resp, entry=entry):
+            outcome = "served" if resp.ok else resp.status
+            self._express_counts[outcome] = \
+                self._express_counts.get(outcome, 0) + 1
+            self._c_express.inc(outcome=outcome)
+            if resp.ok and resp.latency_s is not None:
+                self._h_express.observe(
+                    resp.latency_s,
+                    bucket_len=(resp.bucket_len
+                                if resp.bucket_len is not None
+                                else entry.bucket_len))
+
+        entry.ticket.add_done_callback(_done)
+
+    def _submit_cascade(self, entry: _Entry) -> FoldTicket:
+        """Draft-first fold: speculate on the cheap tier, gate on its
+        own confidence, escalate losers to the flagship through the
+        ordinary submit seam. The caller's ticket resolves exactly once
+        on every path (accept, escalate, draft refusal, expired
+        deadline, gate crash)."""
+        policy = self.cascade
+        request = entry.request
+        entry.trace.event("cascade")
+        # a flagship store hit short-circuits the draft: the
+        # full-quality result is free, speculating would only add a
+        # draft fold on top of it
+        flagship_key = None
+        cached = None
+        if self.cache is not None:
+            try:
+                flagship_key = self._cache_key_for(request)
+                cached = self.cache.get(flagship_key, trace=entry.trace)
+            except Exception:
+                flagship_key, cached = None, None
+        if cached is not None:
+            self.metrics.record_cache_hit()
+            self._c_cascade.inc(tier="flagship", outcome="cache_hit")
+            entry.resolve(FoldResponse(
+                request_id=request.request_id, status="ok",
+                coords=cached.coords.copy(),
+                confidence=cached.confidence.copy(),
+                bucket_len=entry.bucket_len,
+                latency_s=time.monotonic() - entry.enqueued_at,
+                source="cache", tier="flagship"))
+            return entry.ticket
+        # cross-tier tripwire: the shared FoldCache keys tiers apart by
+        # model_tag ALONE, so equal keys mean a keying regression that
+        # could serve draft structures under a flagship key. Never
+        # speculate across it — escalate straight to the flagship.
+        if flagship_key is not None:
+            try:
+                draft_key = policy.draft._cache_key_for(request)
+            except Exception:
+                draft_key = None
+            if draft_key is not None and draft_key == flagship_key:
+                self._n_cross_tier_hits += 1
+                self._c_cross_tier.inc()
+                entry.trace.event("cascade_cross_tier_key")
+                self._escalate_cascade(entry, None, "cross_tier_key")
+                return entry.ticket
+        remaining = None if entry.deadline is None else \
+            max(entry.deadline - time.monotonic(), 0.0)
+        draft_req = FoldRequest(
+            seq=request.seq, msa=request.msa,
+            request_id=request.request_id, priority=request.priority,
+            deadline_s=policy.draft_deadline(remaining))
+        entry.trace.begin("draft")
+        try:
+            inner = policy.draft.submit(draft_req)
+        except Exception as exc:
+            # a refusing draft (full queue, draining, stopped) costs
+            # the caller nothing but this failed speculation — the
+            # flagship still owes the fold
+            self._n_draft_errors += 1
+            self._c_cascade.inc(tier="draft", outcome="refused")
+            entry.trace.end("draft")
+            entry.trace.event("draft_refused", error=repr(exc))
+            self._escalate_cascade(entry, None, "draft_refused")
+            return entry.ticket
+
+        def _on_draft(resp, entry=entry):
+            # runs on the draft's resolving thread; done-callbacks
+            # swallow exceptions, so everything that can throw is
+            # guarded — the caller's ticket must terminate regardless
+            try:
+                entry.trace.end("draft")
+                if not resp.ok:
+                    self._n_draft_errors += 1
+                    self._c_cascade.inc(tier="draft", outcome=resp.status)
+                    self._escalate_cascade(entry, None,
+                                           f"draft_{resp.status}")
+                    return
+                score = score_response(resp)
+                self._confidence_sum += score.score
+                self._confidence_n += 1
+                if not policy.gate.accepts(score):
+                    self._c_cascade.inc(tier="draft", outcome="rejected")
+                    self._escalate_cascade(entry, score,
+                                           "low_confidence")
+                    return
+                self._n_draft_accepted += 1
+                self._c_cascade.inc(tier="draft", outcome="accepted")
+                latency = time.monotonic() - entry.enqueued_at
+                self.metrics.record_served(entry.bucket_len, latency)
+                entry.trace.event("draft_accepted",
+                                  confidence=round(score.score, 4))
+                entry.resolve(FoldResponse(
+                    request_id=entry.request.request_id, status="ok",
+                    coords=resp.coords, confidence=resp.confidence,
+                    bucket_len=entry.bucket_len, latency_s=latency,
+                    source=resp.source, attempts=resp.attempts,
+                    recycles=resp.recycles, tier="draft",
+                    confidence_score=score.score,
+                    distogram_entropy=resp.distogram_entropy))
+            except Exception as exc:
+                try:
+                    self.metrics.record_error()
+                    entry.resolve(FoldResponse(
+                        request_id=entry.request.request_id,
+                        status="error", bucket_len=entry.bucket_len,
+                        error=f"cascade gate failed: {exc!r}",
+                        tier="draft"))
+                except Exception:
+                    pass
+
+        inner.add_progress_callback(entry.ticket._publish_progress)
+        inner.add_done_callback(_on_draft)
+        return entry.ticket
+
+    def _escalate_cascade(self, entry: _Entry, score, reason: str):
+        """Hand a cascaded entry to the flagship tier: re-enter
+        submit() with the escalation flag, priority boosted, deadline
+        re-anchored to what remains of the CALLER's budget (the draft
+        attempt already spent some of it). Called from submit()'s
+        thread (cross-tier / draft-refused) or the draft's resolving
+        thread (gate reject, draft error) — never raises; every
+        failure resolves the caller's ticket."""
+        self._n_escalated += 1
+        self._c_cascade.inc(tier="flagship", outcome="escalated")
+        entry.trace.event("escalated", reason=reason)
+        request = entry.request
+        remaining = None
+        if entry.deadline is not None:
+            remaining = entry.deadline - time.monotonic()
+            if remaining <= 0:
+                # the draft ate the whole budget: shed, exactly as the
+                # queue would have — folding dead work helps nobody
+                self.metrics.record_shed()
+                entry.resolve(FoldResponse(
+                    request_id=request.request_id, status="shed",
+                    bucket_len=entry.bucket_len,
+                    latency_s=time.monotonic() - entry.enqueued_at,
+                    error=f"deadline exhausted before escalation "
+                          f"({reason})",
+                    tier="flagship", escalated=True,
+                    confidence_score=(None if score is None
+                                      else score.score)))
+                return
+        esc = FoldRequest(
+            seq=request.seq, msa=request.msa,
+            request_id=request.request_id,
+            priority=request.priority + self.cascade.escalation_priority,
+            deadline_s=remaining, forwarded=request.forwarded,
+            qos=request.qos)
+        try:
+            inner = self.submit(esc, trace=entry.trace, _escalation=True)
+        except Exception as exc:
+            # the inner submit already finished the (shared) trace and
+            # recorded its rejection; the outer ticket still owes the
+            # caller a terminal state
+            self.metrics.record_error()
+            entry.resolve(FoldResponse(
+                request_id=request.request_id, status="error",
+                bucket_len=entry.bucket_len,
+                latency_s=time.monotonic() - entry.enqueued_at,
+                error=f"escalation refused: {exc!r}",
+                tier="flagship", escalated=True))
+            return
+
+        def _on_flagship(resp, entry=entry, score=score):
+            try:
+                entry.resolve(dataclasses.replace(
+                    resp,
+                    latency_s=time.monotonic() - entry.enqueued_at,
+                    tier="flagship", escalated=True,
+                    confidence_score=(None if score is None
+                                      else score.score)))
+            except Exception:
+                try:
+                    entry.resolve(resp)
+                except Exception:
+                    pass
+
+        inner.add_progress_callback(entry.ticket._publish_progress)
+        inner.add_done_callback(_on_flagship)
 
     # -- bulk tier (ISSUE 18) --------------------------------------------
 
@@ -1871,6 +2185,41 @@ class Scheduler:
                 "gated": self._bulk_gated_flag,
                 "max_burn": self.bulk.max_burn,
             }
+        if self.cascade is not None:
+            decided = self._n_draft_accepted + self._n_escalated
+            stats["cascade"] = {
+                "draft_tag": getattr(self.cascade.draft, "model_tag",
+                                     ""),
+                "draft_accepted": self._n_draft_accepted,
+                "escalated": self._n_escalated,
+                "draft_errors": self._n_draft_errors,
+                "cross_tier_hits": self._n_cross_tier_hits,
+                "accept_rate": (self._n_draft_accepted / decided
+                                if decided else 0.0),
+                "mean_confidence": (self._confidence_sum
+                                    / self._confidence_n
+                                    if self._confidence_n else None),
+                "accept_plddt": self.cascade.gate.accept_plddt,
+                "max_entropy": self.cascade.gate.max_entropy,
+            }
+            draft_stats = getattr(self.cascade.draft, "serve_stats",
+                                  None)
+            if draft_stats is not None:
+                try:
+                    d = draft_stats()
+                    stats["cascade"]["draft"] = {
+                        "served": d.get("served", 0),
+                        "errors": d.get("errors", 0),
+                        "shed": d.get("shed", 0),
+                        "queue_depth": d.get("queue_depth", 0),
+                        "batches": d.get("batches", 0),
+                    }
+                except Exception:
+                    pass       # obs must never fail stats
+        # express section only once express traffic minted its metrics
+        # (keeps the no-express snapshot byte-identical)
+        if self._c_express is not None:
+            stats["express"] = dict(self._express_counts)
         if self.mesh_policy is not None:
             with self._cond:
                 folds = {label: {"batches": self._mesh_batches[label],
@@ -2299,6 +2648,11 @@ class Scheduler:
                                         kernel=kspec)
             coords = np.asarray(result.coords)
             confidence = np.asarray(result.confidence)
+            distogram = None
+            if cfg.confidence_summary:
+                dg = getattr(result, "distogram", None)
+                if dg is not None:
+                    distogram = np.asarray(dg)
         except Exception as exc:  # resolve/retry, never kill the worker
             if self._handle_batch_failure(bucket_len, entries, exc, t0):
                 return            # retried, bisected, or quarantined
@@ -2337,6 +2691,12 @@ class Scheduler:
                     continue
                 latency = now - e.enqueued_at
                 self.metrics.record_served(bucket_len, latency)
+                ent = None
+                if distogram is not None:
+                    try:
+                        ent = _distogram_entropy(distogram[i, :n, :n])
+                    except Exception:
+                        ent = None  # a summary must never fail a serve
                 self._resolve_entry(e, FoldResponse(
                     request_id=e.request.request_id, status="ok",
                     # copy: a view would pin the whole padded batch in
@@ -2344,7 +2704,7 @@ class Scheduler:
                     coords=coords[i, :n].copy(),
                     confidence=confidence[i, :n].copy(),
                     bucket_len=bucket_len, latency_s=latency,
-                    attempts=e.attempts))
+                    attempts=e.attempts, distogram_entropy=ent))
         except Exception as exc:
             # resolution machinery failed mid-batch (e.g. MemoryError on
             # a response copy): entries already left the queue, so

@@ -1,0 +1,641 @@
+"""Speculative cascade + express lane tests (ISSUE 19): the confidence
+gate units (pLDDT, distogram entropy, gate thresholds), CascadePolicy
+validation and the draft-scheduler builder, the accept/escalate flow
+end-to-end against stub executors, cross-tier cache isolation in BOTH
+directions plus the keying tripwire, express featurization
+byte-determinism and the FeaturePool express seams, the off-by-default
+identity (scrubbed serve_stats + registry metric-name set), ProcFleet
+config plumbing, and loadtest flag rot.
+
+Scheduler-level tests run against stub executors choreographed by the
+batch content (no model, no XLA), same pattern as tests/test_features:
+the first token of a sequence decides its draft confidence, so one
+suite exercises both gate outcomes deterministically.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from alphafold2_tpu import obs
+from alphafold2_tpu.cache import FeatureCache, FoldCache
+from alphafold2_tpu.data.featurize import tokenize
+from alphafold2_tpu.obs.trace import NULL_TRACE
+from alphafold2_tpu.serve import (BucketPolicy, CascadePolicy,
+                                  ConfidenceGate, ConfidenceScore,
+                                  FeaturePool, FoldRequest, FoldResponse,
+                                  FoldTicket, RawFoldRequest, Scheduler,
+                                  SchedulerConfig, ServeMetrics,
+                                  StubEmbedder, build_draft_scheduler,
+                                  distogram_entropy, express_featurize,
+                                  plddt_score, score_response)
+
+SEQ = "MKVLAARNDC"
+MSA = ["MKVLAARNDC", "MKVLA-RNDC", "MKVRAARND-"]
+
+# first-token choreography: the stub executor emits confidence HI for
+# rows whose leading token clears HI_TOK, LO otherwise
+HI_TOK = 5
+HI, LO = 0.9, 0.2
+HI_SEQ = np.full(10, 7, np.int32)     # draft folds confidently -> accept
+LO_SEQ = np.full(10, 2, np.int32)     # draft is unsure -> escalate
+
+
+class _TierStub:
+    """Executor stand-in for one cascade tier: coords are a constant
+    per-tier marker (so a response proves which tier produced it),
+    confidence follows the first token, and the distogram head is
+    optional — "sharp" (entropy ~ 0), "uniform" (entropy = 1), or
+    absent, matching SchedulerConfig(confidence_summary) plumbing."""
+
+    def __init__(self, marker, distogram=None):
+        self.marker = float(marker)
+        self.distogram = distogram
+        self.runs = 0
+
+    def run(self, batch, num_recycles, trace=NULL_TRACE, **kw):
+        self.runs += 1
+        seq = np.asarray(batch["seq"])
+        b, n = seq.shape
+        coords = np.full((b, n, 3), self.marker, np.float32)
+        conf = np.where(seq[:, :1] >= HI_TOK, HI, LO)
+        confidence = np.broadcast_to(conf, (b, n)).astype(np.float32).copy()
+
+        class _R:
+            pass
+
+        res = _R()
+        res.coords = coords
+        res.confidence = confidence
+        if self.distogram == "sharp":
+            dg = np.zeros((b, n, n, 8), np.float32)
+            dg[..., 0] = 50.0
+            res.distogram = dg
+        elif self.distogram == "uniform":
+            res.distogram = np.zeros((b, n, n, 8), np.float32)
+        return res
+
+    def stats(self):
+        return {"hits": 0, "misses": 0, "evictions": 0, "resident": 0,
+                "max_entries": 1, "keys": []}
+
+
+def _cascade_pair(gate=None, cache=None, draft_distogram=None,
+                  flagship_kwargs=None, **policy_kwargs):
+    """(flagship scheduler, draft scheduler, draft stub, flagship stub,
+    flagship registry) wired the production way: shared FoldCache,
+    distinct model_tags, isolated registries."""
+    cache = FoldCache() if cache is None else cache
+    draft_exec = _TierStub(1.0, distogram=draft_distogram)
+    draft = build_draft_scheduler(
+        draft_exec, BucketPolicy((16,)),
+        config=SchedulerConfig(max_batch_size=2, max_wait_ms=5.0,
+                               num_recycles=0, confidence_summary=True),
+        model_tag="draft", cache=cache)
+    reg = obs.MetricsRegistry()
+    flag_exec = _TierStub(2.0)
+    sched = Scheduler(
+        flag_exec, BucketPolicy((16,)),
+        SchedulerConfig(max_batch_size=2, max_wait_ms=5.0,
+                        num_recycles=0,
+                        **(flagship_kwargs or {})),
+        ServeMetrics(registry=reg), cache=cache, model_tag="flagship",
+        registry=reg,
+        cascade=CascadePolicy(
+            draft=draft,
+            gate=gate or ConfidenceGate(accept_plddt=0.7),
+            **policy_kwargs))
+    return sched, draft, draft_exec, flag_exec, reg
+
+
+@pytest.mark.quick
+class TestConfidenceUnits:
+    def test_plddt_mean_and_mask(self):
+        conf = np.array([0.2, 0.4, 0.6, 0.8])
+        assert plddt_score(conf) == pytest.approx(0.5)
+        mask = np.array([0.0, 0.0, 1.0, 1.0])
+        assert plddt_score(conf, mask) == pytest.approx(0.7)
+        # batch shape works the same
+        assert plddt_score(np.stack([conf, conf])) == pytest.approx(0.5)
+
+    def test_plddt_validation(self):
+        with pytest.raises(ValueError):
+            plddt_score(np.zeros((0,)))
+        with pytest.raises(ValueError):
+            plddt_score(np.ones(4), mask=np.ones(3))
+        with pytest.raises(ValueError):
+            plddt_score(np.ones(4), mask=np.zeros(4))
+
+    def test_distogram_entropy_extremes(self):
+        sharp = np.zeros((3, 3, 8))
+        sharp[..., 0] = 60.0
+        assert distogram_entropy(sharp) == pytest.approx(0.0, abs=1e-6)
+        # all-equal logits: exactly uniform, normalized entropy 1
+        assert distogram_entropy(np.zeros((3, 3, 8))) == pytest.approx(1.0)
+
+    def test_distogram_entropy_mask_and_validation(self):
+        lg = np.zeros((2, 2, 8))
+        lg[0, :, 0] = 60.0            # row 0 sharp, row 1 uniform
+        mask = np.array([[1.0, 1.0], [0.0, 0.0]])
+        assert distogram_entropy(lg, mask) == pytest.approx(0.0, abs=1e-6)
+        with pytest.raises(ValueError):
+            distogram_entropy(np.zeros((3, 3, 1)))   # <2 bins
+        with pytest.raises(ValueError):
+            distogram_entropy(lg, mask=np.ones((3, 3)))
+        with pytest.raises(ValueError):
+            distogram_entropy(lg, mask=np.zeros((2, 2)))
+
+    def test_score_scalar(self):
+        assert ConfidenceScore(plddt=0.8).score == pytest.approx(0.8)
+        assert ConfidenceScore(plddt=0.8, entropy=0.25).score \
+            == pytest.approx(0.6)
+
+    def test_score_response(self):
+        resp = FoldResponse(request_id="r", status="ok",
+                            confidence=np.array([0.6, 0.8]),
+                            distogram_entropy=0.5)
+        s = score_response(resp)
+        assert s.plddt == pytest.approx(0.7)
+        assert s.entropy == pytest.approx(0.5)
+        with pytest.raises(ValueError):
+            score_response(FoldResponse(request_id="r", status="ok"))
+
+    def test_gate_thresholds(self):
+        gate = ConfidenceGate(accept_plddt=0.7)
+        assert gate.accepts(ConfidenceScore(plddt=0.71))
+        assert not gate.accepts(ConfidenceScore(plddt=0.69))
+        # entropy ceiling only consulted when the score carries one
+        gate = ConfidenceGate(accept_plddt=0.5, max_entropy=0.4)
+        assert gate.accepts(ConfidenceScore(plddt=0.9))
+        assert gate.accepts(ConfidenceScore(plddt=0.9, entropy=0.3))
+        assert not gate.accepts(ConfidenceScore(plddt=0.9, entropy=0.5))
+
+    def test_gate_validation(self):
+        with pytest.raises(ValueError):
+            ConfidenceGate(accept_plddt=1.5)
+        with pytest.raises(ValueError):
+            ConfidenceGate(max_entropy=-0.1)
+
+
+@pytest.mark.quick
+class TestCascadePolicy:
+    def test_draft_shape_required(self):
+        with pytest.raises(ValueError):
+            CascadePolicy(draft=None)
+        with pytest.raises(ValueError):
+            CascadePolicy(draft=object())      # no .submit
+
+        class _SubmitOnly:
+            def submit(self, request):
+                raise NotImplementedError
+
+        with pytest.raises(ValueError):
+            CascadePolicy(draft=_SubmitOnly())  # no .model_tag
+
+    def test_knob_bounds(self):
+        class _Draft:
+            model_tag = "draft"
+
+            def submit(self, request):
+                raise NotImplementedError
+
+        with pytest.raises(ValueError):
+            CascadePolicy(draft=_Draft(), escalation_priority=-1)
+        with pytest.raises(ValueError):
+            CascadePolicy(draft=_Draft(), draft_deadline_s=0.0)
+
+    def test_draft_deadline_combinations(self):
+        class _Draft:
+            model_tag = "draft"
+
+            def submit(self, request):
+                raise NotImplementedError
+
+        uncapped = CascadePolicy(draft=_Draft())
+        assert uncapped.draft_deadline(None) is None
+        assert uncapped.draft_deadline(5.0) == pytest.approx(5.0)
+        capped = CascadePolicy(draft=_Draft(), draft_deadline_s=2.0)
+        assert capped.draft_deadline(None) == pytest.approx(2.0)
+        assert capped.draft_deadline(5.0) == pytest.approx(2.0)
+        assert capped.draft_deadline(1.0) == pytest.approx(1.0)
+
+    def test_attach_rejects_tag_collision(self):
+        cache = FoldCache()
+        draft = build_draft_scheduler(
+            _TierStub(1.0), BucketPolicy((16,)),
+            config=SchedulerConfig(max_batch_size=2, max_wait_ms=5.0,
+                                   num_recycles=0),
+            model_tag="same-tag", cache=cache)
+        reg = obs.MetricsRegistry()
+        with pytest.raises(ValueError):
+            Scheduler(_TierStub(2.0), BucketPolicy((16,)),
+                      SchedulerConfig(max_batch_size=2, max_wait_ms=5.0,
+                                      num_recycles=0),
+                      ServeMetrics(registry=reg), cache=cache,
+                      model_tag="same-tag", registry=reg,
+                      cascade=CascadePolicy(draft=draft))
+
+    def test_builder_isolates_registry_and_forces_summary(self):
+        before = len(obs.get_registry().metrics())
+        draft = build_draft_scheduler(_TierStub(1.0), BucketPolicy((16,)))
+        # nothing minted into the global registry; the draft carries its
+        # own (ServeMetrics mirrors dedup by NAME — a shared registry
+        # would silently sum draft and flagship series)
+        assert len(obs.get_registry().metrics()) == before
+        assert draft._registry is not obs.get_registry()
+        assert len(draft._registry.metrics()) > 0
+        # default config folds the distogram summary in for the gate
+        assert draft.config.confidence_summary is True
+        assert draft.model_tag == "draft"
+
+
+class TestCascadeFlow:
+    def test_confident_draft_accepted(self):
+        sched, draft, draft_exec, flag_exec, reg = _cascade_pair()
+        with sched:
+            resp = sched.submit(FoldRequest(seq=HI_SEQ)).result(timeout=30)
+        assert resp.ok
+        assert resp.tier == "draft"
+        assert resp.escalated is False
+        assert float(resp.coords[0, 0]) == pytest.approx(1.0)
+        assert resp.confidence_score == pytest.approx(HI, abs=1e-6)
+        assert draft_exec.runs == 1
+        assert flag_exec.runs == 0
+        snap = sched.serve_stats()
+        casc = snap["cascade"]
+        assert casc["draft_tag"] == "draft"
+        assert casc["draft_accepted"] == 1
+        assert casc["escalated"] == 0
+        assert casc["cross_tier_hits"] == 0
+        assert casc["accept_rate"] == pytest.approx(1.0)
+        assert casc["mean_confidence"] == pytest.approx(HI, abs=1e-6)
+        assert casc["draft"]["served"] == 1
+        # an accepted draft still counts as flagship-side served work
+        assert snap["served"] == 1
+
+    def test_unsure_draft_escalates(self):
+        sched, draft, draft_exec, flag_exec, reg = _cascade_pair()
+        with sched:
+            resp = sched.submit(FoldRequest(seq=LO_SEQ)).result(timeout=30)
+        assert resp.ok
+        assert resp.tier == "flagship"
+        assert resp.escalated is True
+        assert float(resp.coords[0, 0]) == pytest.approx(2.0)
+        assert resp.confidence_score == pytest.approx(LO, abs=1e-6)
+        assert draft_exec.runs == 1
+        assert flag_exec.runs == 1
+        casc = sched.serve_stats()["cascade"]
+        assert casc["draft_accepted"] == 0
+        assert casc["escalated"] == 1
+        assert casc["accept_rate"] == pytest.approx(0.0)
+
+    def test_entropy_ceiling_escalates_confident_plddt(self):
+        """A pointwise-confident but globally undecided draft (uniform
+        distogram, entropy 1.0) must escalate under an entropy gate."""
+        sched, draft, draft_exec, flag_exec, reg = _cascade_pair(
+            gate=ConfidenceGate(accept_plddt=0.5, max_entropy=0.5),
+            draft_distogram="uniform")
+        with sched:
+            resp = sched.submit(FoldRequest(seq=HI_SEQ)).result(timeout=30)
+        assert resp.tier == "flagship" and resp.escalated
+        # score = plddt * (1 - entropy) = 0.9 * 0 = 0
+        assert resp.confidence_score == pytest.approx(0.0, abs=1e-6)
+        assert flag_exec.runs == 1
+
+    def test_sharp_distogram_accepted_with_entropy_on_response(self):
+        sched, draft, draft_exec, flag_exec, reg = _cascade_pair(
+            gate=ConfidenceGate(accept_plddt=0.5, max_entropy=0.5),
+            draft_distogram="sharp")
+        with sched:
+            resp = sched.submit(FoldRequest(seq=HI_SEQ)).result(timeout=30)
+        assert resp.tier == "draft"
+        assert resp.distogram_entropy == pytest.approx(0.0, abs=1e-6)
+        assert resp.confidence_score == pytest.approx(HI, abs=1e-4)
+        assert flag_exec.runs == 0
+
+    def test_refusing_draft_fails_over_to_flagship(self):
+        """An unstarted draft refuses every submit; the caller must
+        still get a flagship fold — the failed speculation costs them
+        nothing but the attempt."""
+        sched, draft, draft_exec, flag_exec, reg = _cascade_pair(
+            manage_draft=False)       # flagship start() leaves draft down
+        with sched:
+            resp = sched.submit(FoldRequest(seq=HI_SEQ)).result(timeout=30)
+        assert resp.ok
+        assert resp.tier == "flagship" and resp.escalated
+        assert float(resp.coords[0, 0]) == pytest.approx(2.0)
+        assert draft_exec.runs == 0
+        casc = sched.serve_stats()["cascade"]
+        assert casc["draft_errors"] == 1
+        assert casc["escalated"] == 1
+
+    def test_bulk_never_cascades(self):
+        sched, draft, draft_exec, flag_exec, reg = _cascade_pair()
+        with sched:
+            resp = sched.submit(
+                FoldRequest(seq=HI_SEQ, qos="bulk")).result(timeout=30)
+        assert resp.ok
+        assert resp.tier == ""            # plain flagship path
+        assert float(resp.coords[0, 0]) == pytest.approx(2.0)
+        assert draft_exec.runs == 0
+        assert sched.serve_stats()["cascade"]["draft_accepted"] == 0
+
+    def test_express_cascades_and_mints_lazy_metrics(self):
+        sched, draft, draft_exec, flag_exec, reg = _cascade_pair()
+        names = {m.name for m in reg.metrics()}
+        assert "serve_cascade_requests_total" in names     # armed at attach
+        assert "serve_express_requests_total" not in names  # lazy
+        with sched:
+            assert "express" not in sched.serve_stats()
+            resp = sched.submit(
+                FoldRequest(seq=HI_SEQ, qos="express")).result(timeout=30)
+        assert resp.ok and resp.tier == "draft"
+        assert sched.serve_stats()["express"] == {"served": 1}
+        names = {m.name for m in reg.metrics()}
+        assert "serve_express_requests_total" in names
+        assert "serve_express_latency_seconds" in names
+
+
+class TestCrossTierIsolation:
+    def test_accepted_draft_caches_under_draft_key_only(self):
+        cache = FoldCache()
+        sched, draft, draft_exec, flag_exec, reg = _cascade_pair(
+            cache=cache)
+        req = FoldRequest(seq=HI_SEQ)
+        with sched:
+            assert sched.submit(req).result(timeout=30).tier == "draft"
+            draft_key = draft._cache_key_for(req)
+            flagship_key = sched._cache_key_for(req)
+            assert draft_key != flagship_key
+            assert cache.get(draft_key) is not None
+            assert cache.get(flagship_key) is None
+            # a repeat serves from the DRAFT's cache tier: zero new
+            # executions on either tier, still labelled draft
+            resp2 = sched.submit(FoldRequest(seq=HI_SEQ)).result(timeout=30)
+        assert resp2.tier == "draft" and resp2.source == "cache"
+        assert draft_exec.runs == 1
+        assert flag_exec.runs == 0
+
+    def test_flagship_store_hit_short_circuits_draft(self):
+        cache = FoldCache()
+        sched, draft, draft_exec, flag_exec, reg = _cascade_pair(
+            cache=cache)
+        with sched:
+            first = sched.submit(FoldRequest(seq=LO_SEQ)).result(timeout=30)
+            assert first.escalated and flag_exec.runs == 1
+            draft_runs = draft_exec.runs
+            # the flagship result is cached now; a repeat must NOT
+            # speculate a draft fold on top of a free full-quality hit
+            resp = sched.submit(FoldRequest(seq=LO_SEQ)).result(timeout=30)
+        assert resp.tier == "flagship" and resp.source == "cache"
+        assert resp.escalated is False
+        assert float(resp.coords[0, 0]) == pytest.approx(2.0)
+        assert draft_exec.runs == draft_runs
+        assert flag_exec.runs == 1
+
+    def test_cross_tier_keying_tripwire(self):
+        """Force the keying regression the tripwire exists for: equal
+        draft/flagship cache keys must never speculate — straight to
+        the flagship, counted in the pinned counter."""
+        sched, draft, draft_exec, flag_exec, reg = _cascade_pair()
+        draft.model_tag = "flagship"      # simulate the regression
+        with sched:
+            resp = sched.submit(FoldRequest(seq=HI_SEQ)).result(timeout=30)
+        assert resp.ok
+        assert resp.tier == "flagship" and resp.escalated
+        assert draft_exec.runs == 0       # never speculated across it
+        assert sched.serve_stats()["cascade"]["cross_tier_hits"] == 1
+        assert reg.counter(
+            "serve_cascade_cross_tier_hits_total").value() == 1
+
+
+@pytest.mark.quick
+class TestExpressFeaturizer:
+    def test_byte_determinism(self):
+        emb = StubEmbedder()
+        f1 = express_featurize(RawFoldRequest(SEQ, qos="express"), emb)
+        f2 = express_featurize(RawFoldRequest(SEQ, qos="express"),
+                               StubEmbedder())
+        assert f1.seq.tobytes() == f2.seq.tobytes()
+        assert f1.msa.tobytes() == f2.msa.tobytes()
+        # two rows, query first (bucketing convention)
+        assert f1.msa.shape == (2, len(SEQ))
+        assert np.array_equal(f1.msa[0], f1.seq)
+
+    def test_raw_msa_ignored_by_design(self):
+        emb = StubEmbedder()
+        with_msa = express_featurize(
+            RawFoldRequest(SEQ, msa=MSA, qos="express"), emb)
+        without = express_featurize(RawFoldRequest(SEQ, qos="express"), emb)
+        assert with_msa.msa.tobytes() == without.msa.tobytes()
+
+    def test_embedder_digest_namespaces(self):
+        assert StubEmbedder(16).digest == "stub-embedder-v1-d16"
+        assert StubEmbedder(16).digest != StubEmbedder(8).digest
+        pool = FeaturePool(workers=1, express=StubEmbedder(),
+                           registry=obs.MetricsRegistry())
+        express_digest = pool._digest_for(
+            RawFoldRequest(SEQ, qos="express"))
+        assert express_digest.startswith("express:")
+        assert express_digest != pool.config_digest
+        # online jobs key under the featurizer's digest, untouched
+        assert pool._digest_for(RawFoldRequest(SEQ)) == pool.config_digest
+
+    def test_qos_validation(self):
+        with pytest.raises(ValueError):
+            RawFoldRequest(SEQ, qos="turbo")
+        with pytest.raises(ValueError):
+            FoldRequest(seq=tokenize(SEQ), qos="turbo")
+        with pytest.raises(ValueError):
+            FeaturePool(workers=1, express_deadline_s=0.0)
+
+    def test_express_without_embedder_errors_loudly(self):
+        pool = FeaturePool(workers=1, registry=obs.MetricsRegistry())
+        sink = _SinkScheduler()
+        ticket = pool.submit_raw(RawFoldRequest(SEQ, qos="express"), sink)
+        resp = ticket.result(timeout=10)
+        assert resp.status == "error"
+        assert "express" in resp.error
+        assert sink.requests == []        # never reached the fold tier
+        pool.stop()
+
+    def test_express_bypasses_featurize_fn(self):
+        """The online featurizer (MSA prep) must never run for an
+        express job — that is the lane's whole point."""
+        def boom(raw):
+            raise AssertionError("online featurizer ran for express")
+
+        pool = FeaturePool(workers=1, featurize_fn=boom,
+                           config_digest="boom-cfg",
+                           express=StubEmbedder(),
+                           express_deadline_s=30.0,
+                           registry=obs.MetricsRegistry())
+        sink = _SinkScheduler()
+        resp = pool.submit_raw(
+            RawFoldRequest(SEQ, qos="express"), sink).result(timeout=10)
+        assert resp.ok
+        assert len(sink.requests) == 1
+        req = sink.requests[0]
+        assert req.qos == "express"
+        assert req.msa.shape == (2, len(SEQ))
+        # express fold deadline capped by the lane's promise
+        assert req.deadline_s is not None and req.deadline_s <= 30.0
+        # the online path still runs (and here, fails through) boom
+        online = pool.submit_raw(RawFoldRequest(SEQ), sink).result(
+            timeout=10)
+        assert online.status == "error"
+        pool.stop()
+
+    def test_express_end_to_end_and_feature_cache_namespace(self):
+        """Express raw jobs fold for real on a scheduler, and their
+        cached features live under the embedder's namespace — an online
+        job for the same sequence must featurize separately."""
+        reg = obs.MetricsRegistry()
+        fcache = FeatureCache(registry=reg)
+        pool = FeaturePool(workers=1, cache=fcache,
+                           express=StubEmbedder(), registry=reg)
+        sched = Scheduler(_TierStub(3.0), BucketPolicy((16,)),
+                          SchedulerConfig(max_batch_size=2,
+                                          max_wait_ms=5.0,
+                                          num_recycles=0),
+                          ServeMetrics(registry=reg), registry=reg)
+        with sched:
+            ex1 = pool.submit_raw(
+                RawFoldRequest(SEQ, qos="express"), sched).result(
+                    timeout=30)
+            ex2 = pool.submit_raw(
+                RawFoldRequest(SEQ, qos="express"), sched).result(
+                    timeout=30)
+            online = pool.submit_raw(
+                RawFoldRequest(SEQ), sched).result(timeout=30)
+        pool.stop()
+        assert ex1.ok and ex2.ok and online.ok
+        snap = pool.snapshot()
+        # the express repeat hit the feature cache; the online job for
+        # the SAME sequence missed it (distinct key namespace)
+        assert snap["cache_hits"] == 1
+        assert snap["executions"] == 2
+
+
+class _SinkScheduler:
+    """Fold-scheduler stand-in for FeaturePool seam tests: records the
+    FoldRequests it is handed and resolves them immediately."""
+
+    def __init__(self):
+        self.tracer = obs.Tracer()
+        self.requests = []
+
+    def submit(self, request, trace=None):
+        self.requests.append(request)
+        ticket = FoldTicket(request.request_id)
+        ticket._resolve(FoldResponse(request_id=request.request_id,
+                                     status="ok"))
+        return ticket
+
+
+class TestOffByDefault:
+    def _run_one(self, pass_kwarg):
+        reg = obs.MetricsRegistry()
+        kwargs = {"cascade": None} if pass_kwarg else {}
+        sched = Scheduler(_TierStub(2.0), BucketPolicy((16,)),
+                          SchedulerConfig(max_batch_size=2,
+                                          max_wait_ms=5.0,
+                                          num_recycles=0),
+                          ServeMetrics(registry=reg), registry=reg,
+                          **kwargs)
+        with sched:
+            for seq in (HI_SEQ, LO_SEQ, HI_SEQ[:8]):
+                resp = sched.submit(FoldRequest(seq=seq)).result(timeout=30)
+                assert resp.ok and resp.tier == "" \
+                    and resp.escalated is False
+        return sched.serve_stats(), {m.name for m in reg.metrics()}
+
+    def test_scrubbed_stats_and_metric_name_identity(self):
+        """The off switch: cascade=None (the default) must leave both
+        serve_stats() and the registry metric-name set byte-identical
+        to a scheduler built without the kwarg at all, with no cascade/
+        express surface anywhere."""
+        def scrub(obj):
+            if isinstance(obj, dict):
+                return {k: scrub(v) for k, v in sorted(obj.items())
+                        if k != "traces" and not k.endswith("_s")}
+            if isinstance(obj, list):
+                return [scrub(v) for v in obj]
+            return obj
+
+        stats_a, names_a = self._run_one(pass_kwarg=True)
+        stats_b, names_b = self._run_one(pass_kwarg=False)
+        assert json.dumps(scrub(stats_a), sort_keys=True, default=str) \
+            == json.dumps(scrub(stats_b), sort_keys=True, default=str)
+        assert names_a == names_b
+        for stats in (stats_a, stats_b):
+            assert "cascade" not in stats
+            assert "express" not in stats
+        for names in (names_a, names_b):
+            assert not any(n.startswith("serve_cascade_") for n in names)
+            assert not any(n.startswith("serve_express_") for n in names)
+
+
+class TestProcFleetPlumbing:
+    def test_cascade_knob_round_trips_to_replica_configs(self, tmp_path):
+        from alphafold2_tpu.fleet.procfleet import ProcFleet
+        casc = {"model": {"dim": 16, "depth": 1}, "accept_plddt": 0.8,
+                "max_entropy": 0.9, "escalation_priority": 5,
+                "draft_deadline_s": 2.0}
+        fleet = ProcFleet(2, str(tmp_path / "run"), cascade=casc)
+        assert len(fleet.replicas) == 2
+        for handle in fleet.replicas:
+            with open(handle.config_path) as fh:
+                cfg = json.load(fh)
+            assert cfg["cascade"] == casc
+
+    def test_cascade_defaults_off(self, tmp_path):
+        from alphafold2_tpu.fleet.procfleet import ProcFleet
+        fleet = ProcFleet(1, str(tmp_path / "run"))
+        with open(fleet.replicas[0].config_path) as fh:
+            cfg = json.load(fh)
+        assert cfg["cascade"] is None
+
+
+class TestLoadtestFlags:
+    """Flag-rot guard: the documented --cascade/--draft-accept-rate/
+    --express-rate knobs must parse, run, and report (same pattern as
+    the continuous/bulk loadtest flag tests)."""
+
+    def _main(self):
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                        "..", "tools"))
+        import serve_loadtest
+        return serve_loadtest.main
+
+    def test_cascade_rejects_multi_process_modes(self, capsys):
+        main = self._main()
+        assert main(["--cascade", "--procs", "2"]) == 2
+        assert main(["--express-rate", "0.5", "--replicas", "2"]) == 2
+
+    def test_cascade_and_express_report(self, capsys):
+        main = self._main()
+        rc = main(["--requests", "6", "--lengths", "12",
+                   "--buckets", "16", "--msa-depth", "2",
+                   "--max-batch", "2", "--concurrency", "2",
+                   "--num-recycles", "0", "--dim", "32", "--depth", "1",
+                   "--cache", "on", "--cascade",
+                   "--draft-accept-rate", "0.5",
+                   "--express-rate", "0.34",
+                   "--metrics-path", "/tmp/test_cascade_loadtest.jsonl"])
+        assert rc == 0
+        report = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        casc = report["cascade"]
+        assert casc["scripted_gate"] is True
+        assert casc["draft_accepted"] + casc["escalated"] > 0
+        assert casc["cross_tier_hits"] == 0
+        assert casc["flagship_folds"] <= report["served"]
+        assert casc["accel_seconds"]["total"] > 0
+        assert set(report["latency_by_tier"]) == {"draft", "flagship"}
+        assert report["express"].get("served", 0) > 0
+        assert "express" in report["latency_by_lane"]

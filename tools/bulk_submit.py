@@ -4,11 +4,27 @@
 Reads a manifest of sequences (FASTA or JSONL), tokenizes CLIENT-side
 (data.featurize.tokenize — the bulk tier rides the tokenized front-door
 path; the raw/featurize pipeline stays online-only), and submits every
-unfinished sequence as `FoldRequest(qos="bulk")` against one replica's
+unfinished sequence as `FoldRequest(qos="bulk")` against a replica's
 front door. The receiving scheduler parks bulk work in its BulkQueue:
 admitted only by work-stealing through freed batch rows, never ahead of
 online traffic, throttled by the SLO engine's burn rate
 (`serve.BulkPolicy`).
+
+Campaign sharding (ISSUE 19): `--fleet ID=URL,...` spreads the
+manifest across replicas by fold-key RING OWNER — the client computes
+each sequence's `fold_key` and the same blake2b/vnode consistent hash
+the data plane's `ConsistentHashRouter` builds
+(`fleet.router.static_owner_for`), so every fold lands where
+coalescing leadership, the peer-cache home, and checkpoint spill
+locality already are. A submit refused by the owner fails over around
+the ring (the receiving scheduler serves bulk locally either way).
+For the client key to equal the server's, --model-tag /
+--num-recycles / --msa-depth must match the fleet config; the ring
+shard is deterministic across re-runs regardless.
+
+Every ledger record carries the `fold_key`, which is what the control
+plane's checkpoint GC (`fleet.CheckpointGC` ->
+`CheckpointStore.sweep_orphans`) matches terminal folds against.
 
 The campaign is DURABLE and IDEMPOTENT:
 
@@ -109,11 +125,50 @@ def load_ledger(path):
     return state
 
 
+def parse_fleet(spec):
+    """`ID=URL,ID=URL,...` -> ordered [(rid, url)]. Raises ValueError
+    on malformed items or duplicate ids — a typo'd fleet map must fail
+    loudly, not silently shard everything onto one replica."""
+    pairs = []
+    seen = set()
+    for item in (spec or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "=" not in item:
+            raise ValueError(f"fleet item {item!r} is not ID=URL")
+        rid, _, url = item.partition("=")
+        rid, url = rid.strip(), url.strip()
+        if not rid or not url:
+            raise ValueError(f"fleet item {item!r} is not ID=URL")
+        if rid in seen:
+            raise ValueError(f"duplicate fleet replica id {rid!r}")
+        seen.add(rid)
+        pairs.append((rid, url))
+    if not pairs:
+        raise ValueError(f"empty fleet spec {spec!r}")
+    return pairs
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("manifest", help="FASTA or JSONL sequence manifest")
-    ap.add_argument("--url", required=True,
-                    help="replica front-door base URL")
+    ap.add_argument("--url",
+                    help="replica front-door base URL (single-replica "
+                         "campaign; exactly one of --url/--fleet)")
+    ap.add_argument("--fleet",
+                    help="ID=URL,... replica map: shard the manifest "
+                         "by fold-key ring owner with submit failover "
+                         "around the ring")
+    ap.add_argument("--model-tag", default="",
+                    help="serving model tag for client-side fold_key "
+                         "(match the fleet config so ledger keys equal "
+                         "server cache/checkpoint keys)")
+    ap.add_argument("--num-recycles", type=int, default=0,
+                    help="serving num_recycles for client-side fold_key")
+    ap.add_argument("--msa-depth", type=int, default=None,
+                    help="serving msa_depth for client-side fold_key "
+                         "(default: unset, like SchedulerConfig)")
     ap.add_argument("--ledger", required=True,
                     help="campaign ledger JSONL (created if missing)")
     ap.add_argument("--max-inflight", type=int, default=8,
@@ -131,10 +186,14 @@ def main(argv=None):
     ap.add_argument("--poll-budget-s", type=float, default=600.0,
                     help="max wait for one fold's terminal result")
     args = ap.parse_args(argv)
+    if bool(args.url) == bool(args.fleet):
+        ap.error("exactly one of --url / --fleet is required")
 
     import numpy as np  # noqa: F401  (transport decodes need it)
 
+    from alphafold2_tpu.cache import fold_key
     from alphafold2_tpu.data.featurize import tokenize
+    from alphafold2_tpu.fleet.router import static_owner_for
     from alphafold2_tpu.fleet.rpc import HttpTransport
     from alphafold2_tpu.serve import FoldRequest
 
@@ -147,8 +206,14 @@ def main(argv=None):
     if not todo:
         return 0
 
-    transport = HttpTransport(args.url,
-                              poll_budget_s=args.poll_budget_s)
+    if args.fleet:
+        fleet = parse_fleet(args.fleet)
+    else:
+        fleet = [("replica", args.url)]
+    ring_ids = [rid for rid, _ in fleet]
+    transports = {rid: HttpTransport(url,
+                                     poll_budget_s=args.poll_budget_s)
+                  for rid, url in fleet}
     ledger_lock = threading.Lock()
     ledger_fh = open(args.ledger, "a")
     sem = threading.Semaphore(max(1, args.max_inflight))
@@ -162,9 +227,10 @@ def main(argv=None):
             ledger_fh.write(json.dumps(rec) + "\n")
             ledger_fh.flush()
 
-    def on_done(rid, t0):
+    def on_done(rid, t0, fk, owner):
         def _cb(resp):
             record(rid, resp.status, key=resp.request_id,
+                   fold_key=fk, replica=owner,
                    latency_s=round(time.monotonic() - t0, 3),
                    source=resp.source,
                    **({"error": resp.error} if resp.error else {}))
@@ -175,6 +241,9 @@ def main(argv=None):
         sem.acquire()
         try:
             tokens = tokenize(seq)
+            fk = fold_key(tokens, msa_depth=args.msa_depth,
+                          num_recycles=args.num_recycles,
+                          model_tag=args.model_tag)
         except Exception as exc:
             record(rid, "error", error=f"tokenize: {exc}")
             sem.release()
@@ -182,22 +251,33 @@ def main(argv=None):
         req = FoldRequest(
             seq=tokens, qos="bulk",
             deadline_s=(args.deadline_s or None))
+        # shard by ring owner; failover walks the rest of the ring in
+        # deterministic order before backing off (any replica SERVES
+        # bulk locally — the shard is a locality preference, never a
+        # correctness requirement)
+        owner = static_owner_for(fk, ring_ids)
+        candidates = [owner] + [r for r in ring_ids if r != owner]
         ticket = None
+        used = owner
         for attempt in range(max(1, args.submit_tries)):
+            target = candidates[attempt % len(candidates)]
             try:
-                ticket = transport.submit(req)
+                ticket = transports[target].submit(req)
+                used = target
                 break
             except Exception as exc:
                 err = str(exc)
-                time.sleep(args.retry_wait)
+                if attempt % len(candidates) == len(candidates) - 1:
+                    # the whole ring refused this round: back off
+                    time.sleep(args.retry_wait)
         if ticket is None:
             # transport never accepted it: NOT terminal-done — the
             # next run retries this sequence
-            record(rid, "error", error=f"submit: {err}")
+            record(rid, "error", fold_key=fk, error=f"submit: {err}")
             sem.release()
             continue
         t0 = time.monotonic()
-        ticket.add_done_callback(on_done(rid, t0))
+        ticket.add_done_callback(on_done(rid, t0, fk, used))
         outstanding.append((rid, ticket))
 
     for rid, ticket in outstanding:

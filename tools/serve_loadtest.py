@@ -288,6 +288,38 @@ def parse_args(argv=None):
                          "earlier raw sequence (Zipf skew), "
                          "exercising the feature cache + featurize "
                          "coalescing independently of fold dedup")
+    ap.add_argument("--cascade", action="store_true",
+                    help="SPECULATIVE CASCADE (ISSUE 19, "
+                         "serve.CascadePolicy): fold every request on a "
+                         "half-size draft model first (0 recycles, its "
+                         "own model_tag) and accept/escalate on a "
+                         "confidence gate; the report adds a 'cascade' "
+                         "section (accept rate, flagship_folds, "
+                         "accelerator-seconds per accepted fold) and "
+                         "latency_by_tier p50/p99. Single-scheduler "
+                         "mode only")
+    ap.add_argument("--draft-accept-rate", type=float, default=0.6,
+                    help="scripted confidence gate: deterministic "
+                         "fraction of draft folds accepted. The tiny "
+                         "random-param draft's own confidence is "
+                         "arbitrary, so the loadtest scripts the gate "
+                         "decision to exercise BOTH cascade paths at a "
+                         "known mix (serve_smoke.sh phase 17 compares "
+                         "flagship executions against a no-cascade "
+                         "baseline). Negative = use the real "
+                         "serve.ConfidenceGate over the draft's own "
+                         "pLDDT")
+    ap.add_argument("--express-rate", type=float, default=0.0,
+                    help="fraction of submissions sent as qos='express' "
+                         "at the SHORTEST --lengths entry: the "
+                         "interactive express lane with its own metric "
+                         "class (serve_express_requests_total / "
+                         "serve_express_latency_seconds, minted "
+                         "lazily); the report adds latency_by_lane "
+                         "p50/p99. The MSA-BYPASS express featurizer "
+                         "is the raw-path seam — serve.FeaturePool("
+                         "express=StubEmbedder()) — exercised by "
+                         "tests/test_cascade.py, not this driver")
     ap.add_argument("--dim", type=int, default=32)
     ap.add_argument("--depth", type=int, default=1)
     ap.add_argument("--metrics-path", default="/tmp/serve_loadtest.jsonl")
@@ -664,6 +696,69 @@ def _build_tiny_model(args, jax, jnp, policy):
     return model, params
 
 
+class _ScriptedGate:
+    """Deterministic stand-in for serve.ConfidenceGate (--cascade).
+
+    A dim-16 random-param draft emits arbitrary confidence, so
+    thresholding it would pin the loadtest's accept fraction to 0 or 1
+    by luck. This gate ignores the score and accepts a Bresenham-spread
+    `rate` fraction of decisions instead — both cascade paths run at a
+    known mix, and the aggregate accept_rate in serve_stats() converges
+    on `rate` regardless of submitter interleaving. Exposes the two
+    attributes serve_stats()'s cascade section reads off a gate."""
+
+    def __init__(self, rate: float):
+        self.accept_plddt = 0.0       # read by serve_stats(); scripted
+        self.max_entropy = None
+        self.rate = max(0.0, min(1.0, rate))
+        self._acc = 0.0
+        self._lock = threading.Lock()
+
+    def accepts(self, score) -> bool:
+        with self._lock:
+            self._acc += self.rate
+            if self._acc >= 1.0 - 1e-9:
+                self._acc -= 1.0
+                return True
+            return False
+
+
+class _TimedExecutor:
+    """Wall-clock accounting of executor work, the report's
+    accelerator-seconds proxy (the unit survives the move from this
+    CPU smoke to a real accelerator). Only the execution verbs are
+    timed — warmup/compile passes through untimed so the cascade's
+    per-accepted-fold cost reads serving work alone."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.seconds = 0.0
+        self._lock = threading.Lock()
+
+    def _timed(self, fn, *a, **kw):
+        t0 = time.monotonic()
+        try:
+            return fn(*a, **kw)
+        finally:
+            with self._lock:
+                self.seconds += time.monotonic() - t0
+
+    def run(self, *a, **kw):
+        return self._timed(self._inner.run, *a, **kw)
+
+    def run_init(self, *a, **kw):
+        return self._timed(self._inner.run_init, *a, **kw)
+
+    def run_step(self, *a, **kw):
+        return self._timed(self._inner.run_step, *a, **kw)
+
+    def run_init_rows(self, *a, **kw):
+        return self._timed(self._inner.run_init_rows, *a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     if args.slo and not args.procs:
@@ -679,6 +774,14 @@ def main(argv=None) -> int:
         print("--controller requires --procs (the control plane "
               "actuates ProcFleet's spawn/SIGTERM verbs)",
               file=sys.stderr)
+        return 2
+    if (args.cascade or args.express_rate > 0) and \
+            (args.procs or args.replicas > 1
+             or args.feature_latency_ms > 0 or args.feature_pool > 0):
+        print("--cascade/--express-rate drive the single-scheduler "
+              "mode (the fleet/feature/procs drivers exercise the "
+              "cascade through ProcFleet(cascade=) and "
+              "tests/test_cascade.py)", file=sys.stderr)
         return 2
     if args.cross_bucket or args.eager_form:
         args.continuous = True       # both ride the continuous batcher
@@ -758,16 +861,59 @@ def main(argv=None) -> int:
         from alphafold2_tpu import obs
         tracer = obs.Tracer(jsonl_path=args.trace_path,
                             slow_k=args.trace_slow_k)
+    cascade_policy = None
+    draft_sched = None
+    draft_exec = None
+    if args.cascade:
+        from alphafold2_tpu import Alphafold2
+        executor = _TimedExecutor(executor)
+        # the draft tier: half the trunk, zero recycles, its own
+        # model_tag — the speculative cascade's whole premise is that
+        # this config is materially cheaper per fold than the flagship
+        draft_model = Alphafold2(dim=max(args.dim // 2, 16),
+                                 depth=max(args.depth // 2, 1),
+                                 heads=2, dim_head=16,
+                                 predict_coords=True,
+                                 structure_module_depth=1)
+        n0 = policy.edges[0]
+        init_kwargs = dict(mask=jnp.ones((1, n0), bool))
+        if args.msa_depth > 0:
+            init_kwargs["msa"] = jnp.zeros((1, args.msa_depth, n0),
+                                           jnp.int32)
+            init_kwargs["msa_mask"] = jnp.ones((1, args.msa_depth, n0),
+                                               bool)
+        draft_params = draft_model.init(
+            jax.random.PRNGKey(2), jnp.zeros((1, n0), jnp.int32),
+            **init_kwargs)
+        draft_exec = _TimedExecutor(serve.FoldExecutor(
+            draft_model, draft_params, max_entries=policy.num_buckets,
+            model_tag="serve_loadtest#draft"))
+        draft_sched = serve.build_draft_scheduler(
+            draft_exec, policy,
+            config=serve.SchedulerConfig(
+                max_batch_size=args.max_batch,
+                max_wait_ms=args.max_wait_ms,
+                num_recycles=0, msa_depth=args.msa_depth,
+                confidence_summary=True),
+            model_tag="serve_loadtest#draft", cache=cache)
+        gate = (_ScriptedGate(args.draft_accept_rate)
+                if args.draft_accept_rate >= 0
+                else serve.ConfidenceGate())
+        cascade_policy = serve.CascadePolicy(draft=draft_sched,
+                                             gate=gate)
     scheduler = serve.Scheduler(executor, policy, config, metrics,
                                 cache=cache, model_tag="serve_loadtest",
                                 tracer=tracer, retry=retry,
                                 mesh_policy=mesh_policy,
                                 recycle_policy=recycle_policy,
-                                kernel_policy=kernel_policy)
+                                kernel_policy=kernel_policy,
+                                cascade=cascade_policy)
 
     warmup_timer = StepTimer()
     with warmup_timer.measure():
         compiles = scheduler.warmup()
+        if draft_sched is not None:
+            compiles += draft_sched.warmup()
     scheduler.start()
 
     import numpy as np
@@ -793,6 +939,11 @@ def main(argv=None) -> int:
     # latencies feed the report's p50/p99 split
     short_len = min(lengths)
     class_latencies = {"tight": [], "bulk": []}
+    # cascade tier + express lane client-side latency splits (ISSUE 19)
+    tier_latencies = {"draft": [], "flagship": []}
+    lane_latencies = {"express": [], "online": []}
+    short_pool = [p for p in pool
+                  if int(p.seq.shape[0]) == short_len] or list(pool)
     progress_updates = [0]
 
     def run_submitter(stop_at, budget):
@@ -806,6 +957,15 @@ def main(argv=None) -> int:
             idx = schedule[i % len(schedule)]
             is_poison = idx < 0
             req_proto = poisons[-idx - 1] if is_poison else pool[idx]
+            # express lane (ISSUE 19): a deterministic well-spread
+            # subset of submissions rides qos="express" on SHORT
+            # prototypes — the interactive class whose p99 the lane's
+            # own metric class (and phase 17's gate) watches
+            is_express = (args.express_rate > 0 and not is_poison
+                          and ((i * 2654435761) % 1000) / 1000.0
+                          < args.express_rate)
+            if is_express:
+                req_proto = short_pool[idx % len(short_pool)]
             req_len = int(req_proto.seq.shape[0])
             req_deadline = deadline_s
             klass = "bulk"
@@ -813,7 +973,9 @@ def main(argv=None) -> int:
                 klass = "tight" if req_len <= short_len else "bulk"
                 req_deadline = deadline_s if klass == "tight" else None
             req = serve.FoldRequest(seq=req_proto.seq, msa=req_proto.msa,
-                                    deadline_s=req_deadline)
+                                    deadline_s=req_deadline,
+                                    qos=("express" if is_express
+                                         else "online"))
             t_submit = time.monotonic()
             try:
                 # FoldTicket.result(timeout=) is the caller-side hang
@@ -833,8 +995,14 @@ def main(argv=None) -> int:
             with lock:
                 statuses[resp.status] = statuses.get(resp.status, 0) + 1
                 if not is_poison and resp.ok:
-                    class_latencies[klass].append(
-                        time.monotonic() - t_submit)
+                    lat = time.monotonic() - t_submit
+                    class_latencies[klass].append(lat)
+                    if args.cascade:
+                        tier_latencies["draft" if resp.tier == "draft"
+                                       else "flagship"].append(lat)
+                    if args.express_rate > 0:
+                        lane_latencies["express" if is_express
+                                       else "online"].append(lat)
             if is_poison:
                 # a poison request is EXPECTED to terminate "poisoned";
                 # the chaos smoke judges these separately
@@ -942,6 +1110,39 @@ def main(argv=None) -> int:
             live_frac_hist=dict(sorted(hist.items())),
             numerics_max_diff=_kernel_numerics_check(kernel_policy,
                                                      policy))
+    if args.cascade:
+        from alphafold2_tpu.utils.profiling import percentile
+        casc = dict(snap["cascade"])
+        # flagship EXECUTIONS, the number serve_smoke.sh phase 17
+        # gates against a no-cascade baseline: every served fold that
+        # was not an accepted draft folded on the flagship (exact with
+        # dedup off; store hits are counted separately either way)
+        casc["flagship_folds"] = snap["served"] - casc["draft_accepted"]
+        casc["scripted_gate"] = args.draft_accept_rate >= 0
+        total_s = executor.seconds + draft_exec.seconds
+        casc["accel_seconds"] = {
+            "draft": round(draft_exec.seconds, 3),
+            "flagship": round(executor.seconds, 3),
+            "total": round(total_s, 3)}
+        # the cascade's efficiency headline: total accelerator work
+        # per fold the draft tier fully paid for
+        casc["accel_seconds_per_accepted"] = (
+            round(total_s / casc["draft_accepted"], 4)
+            if casc["draft_accepted"] else None)
+        report["cascade"] = casc
+        report["latency_by_tier"] = {
+            k: {"count": len(v),
+                "p50_s": round(percentile(v, 50), 4),
+                "p99_s": round(percentile(v, 99), 4)}
+            for k, v in tier_latencies.items() if v}
+    if args.express_rate > 0:
+        from alphafold2_tpu.utils.profiling import percentile
+        report["express"] = snap.get("express", {})
+        report["latency_by_lane"] = {
+            k: {"count": len(v),
+                "p50_s": round(percentile(v, 50), 4),
+                "p99_s": round(percentile(v, 99), 4)}
+            for k, v in lane_latencies.items() if v}
     # executor step-executions: the apples-to-apples cost unit across
     # the opaque and step-scheduled paths (an opaque fold IS
     # 1 + num_recycles fused steps) — serve_smoke.sh phase 8 compares
@@ -1093,6 +1294,29 @@ def main(argv=None) -> int:
                       f"{args.converge_tol} never admitted a row "
                       f"(recycle stats {rec})", file=sys.stderr)
                 return 1
+        if args.cascade:
+            casc = snap["cascade"]
+            if casc["cross_tier_hits"]:
+                # the tripwire phase 17 pins to 0: equal draft and
+                # flagship cache keys mean a keying regression that
+                # could serve draft structures to flagship callers
+                print(f"SMOKE FAIL: {casc['cross_tier_hits']} "
+                      f"cross-tier cache key hits — tier keying "
+                      f"regressed", file=sys.stderr)
+                return 1
+            if 0.0 < args.draft_accept_rate < 1.0 and (
+                    casc["draft_accepted"] == 0
+                    or casc["escalated"] == 0):
+                print(f"SMOKE FAIL: cascade with accept-rate "
+                      f"{args.draft_accept_rate} never exercised both "
+                      f"paths (cascade stats {casc})", file=sys.stderr)
+                return 1
+        if args.express_rate > 0 and \
+                snap.get("express", {}).get("served", 0) == 0:
+            print(f"SMOKE FAIL: --express-rate {args.express_rate} "
+                  f"but no express request served (express stats "
+                  f"{snap.get('express')})", file=sys.stderr)
+            return 1
         if recycle_policy is not None and args.cross_bucket \
                 and snap["recycle"]["cross_bucket_admissions"] == 0:
             # a mixed-bucket workload that never admitted across
@@ -1111,6 +1335,14 @@ def main(argv=None) -> int:
         if kernel_policy is not None:
             extra += (f", kernel folds "
                       f"{(snap.get('kernel') or {}).get('folds')}")
+        if args.cascade:
+            extra += (f", cascade "
+                      f"{snap['cascade']['draft_accepted']} accepted / "
+                      f"{snap['cascade']['escalated']} escalated")
+        if args.express_rate > 0:
+            extra += (f", express "
+                      f"{snap.get('express', {}).get('served', 0)} "
+                      f"served")
         if recycle_policy is not None:
             extra += (f", {report['executor_steps']} executor steps "
                       f"({snap['recycle']['recycles_skipped']} recycles "

@@ -206,7 +206,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 DURATION="${SMOKE_DURATION_S:-30}"
-PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16}"
+PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17}"
 
 phase_on() {
     case ",${PHASES}," in
@@ -1421,5 +1421,113 @@ print(f"BULK SMOKE OK: {N_SEQS}/{N_SEQS} sequences terminal across a "
       f"{spill.get('spill_resumes')} spill resumes, "
       f"{spill.get('survivors_at_boot')} survivors at boot",
       file=sys.stderr)
+EOF
+fi
+
+# phase 17: speculative model cascade + express lane (ISSUE 19) — the
+# IDENTICAL mixed workload (24/48-length, 25% express-QoS submissions
+# on the short class) run TWICE: the flagship-only baseline, then the
+# cascade arm (--cascade: a half-size 0-recycle draft tier in front,
+# scripted 0.6 accept rate so both gate paths run at a known mix).
+# Gates: both arms 0 bad outcomes with every request served; the
+# cascade arm executes STRICTLY FEWER flagship folds than the baseline
+# (accepted drafts never reach the flagship); both cascade paths
+# actually ran (accepted > 0 AND escalated > 0 — every low-confidence
+# fold resolved ok from the flagship, since 0 bad outcomes); the
+# express lane's client-side p99 beats the online lane's; and ZERO
+# cross-tier cache hits, pinned twice — the report's
+# cascade.cross_tier_hits field and the
+# serve_cascade_cross_tier_hits_total counter in the Prometheus
+# exposition (family must be PRESENT — proving the tripwire was armed
+# — with no nonzero sample). The cascade-subsystem tripwire.
+if phase_on 17; then
+casc_phase() {  # $1 = report path, extra args follow
+    local out="$1"; shift
+    timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+        python tools/serve_loadtest.py \
+        --smoke \
+        --requests 48 \
+        --lengths 24,48 \
+        --buckets 32,64 \
+        --msa-depth 3 \
+        --max-batch 2 \
+        --concurrency 2 \
+        --num-recycles 0 \
+        --cache on \
+        --express-rate 0.25 \
+        --metrics-path /tmp/serve_smoke_casc.jsonl \
+        "$@" > "$out"
+}
+
+casc_phase /tmp/serve_smoke_casc_base.json
+casc_phase /tmp/serve_smoke_casc_on.json \
+    --cascade --draft-accept-rate 0.6 \
+    --prom-path /tmp/serve_smoke_casc.prom
+
+timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python - <<'EOF'
+import json
+import sys
+
+base = json.load(open("/tmp/serve_smoke_casc_base.json"))
+casc = json.load(open("/tmp/serve_smoke_casc_on.json"))
+problems = []
+for name, rep in (("baseline", base), ("cascade", casc)):
+    bad = rep["shed"] + rep["errors"] + rep["rejected"] \
+        + len(rep["failures"])
+    # "ok" counts every resolved ticket — executed folds AND store
+    # hits (the express short-class substitution repeats prototypes,
+    # so a few folds legitimately resolve from the cache)
+    ok = (rep.get("statuses") or {}).get("ok", 0)
+    if bad or ok != rep["requests"]:
+        problems.append(f"{name} arm: {bad} bad outcomes, "
+                        f"{ok}/{rep['requests']} ok")
+
+c = casc.get("cascade") or {}
+# the efficiency gate: accepted drafts must actually displace
+# flagship executions on the identical schedule
+if c.get("flagship_folds", 10**9) >= base["served"]:
+    problems.append(
+        f"cascade arm executed {c.get('flagship_folds')} flagship "
+        f"folds — not fewer than the baseline's {base['served']}")
+if not c.get("draft_accepted") or not c.get("escalated"):
+    problems.append(f"cascade never exercised both gate paths "
+                    f"(accepted {c.get('draft_accepted')}, "
+                    f"escalated {c.get('escalated')})")
+if c.get("cross_tier_hits"):
+    problems.append(f"{c['cross_tier_hits']} cross-tier cache hits "
+                    f"in the report")
+
+lanes = casc.get("latency_by_lane") or {}
+exp, onl = lanes.get("express"), lanes.get("online")
+if not exp or not onl:
+    problems.append(f"lane latency split missing ({lanes})")
+elif exp["p99_s"] >= onl["p99_s"]:
+    problems.append(f"express p99 {exp['p99_s']}s not under online "
+                    f"p99 {onl['p99_s']}s")
+
+# counter pin: the family must exist (tripwire armed) with no
+# nonzero sample — a zero labelless counter exports HELP/TYPE only
+prom = open("/tmp/serve_smoke_casc.prom").read()
+fam = "serve_cascade_cross_tier_hits_total"
+if fam not in prom:
+    problems.append(f"{fam} missing from the Prometheus exposition")
+for line in prom.splitlines():
+    if line.startswith(fam) and not line.startswith("#"):
+        if float(line.split()[-1]) != 0.0:
+            problems.append(f"{fam} nonzero in the exposition: {line}")
+
+if problems:
+    print("CASCADE SMOKE FAIL: " + "; ".join(problems),
+          file=sys.stderr)
+    sys.exit(1)
+print(f"CASCADE SMOKE OK: {c['draft_accepted']} drafts accepted / "
+      f"{c['escalated']} escalated (accept rate "
+      f"{round(c['accept_rate'], 3)}), flagship folds "
+      f"{c['flagship_folds']} < baseline {base['served']}, "
+      f"0 cross-tier hits, express p99 {exp['p99_s']}s < online "
+      f"{onl['p99_s']}s, "
+      f"{c['accel_seconds_per_accepted']} accel-seconds per "
+      f"accepted fold", file=sys.stderr)
 EOF
 fi
