@@ -64,6 +64,12 @@ from alphafold2_tpu.utils.hashing import stable_digest
 # MISS (and be discarded), never resume into the wrong semantics
 CHECKPOINT_SCHEMA = "ckpt-v1"
 
+# orphan manifest (ISSUE 20): the JSON record a preempted replica
+# publishes next to its spilled checkpoints so the controller can
+# actively re-home every in-flight fold instead of waiting for lazy
+# peer probes. Same versioning discipline as the checkpoint payload.
+MANIFEST_SCHEMA = "orphans-v1"
+
 # JSON-able reference-leaf types the wire can carry; anything else
 # makes the row unspillable (counted, skipped — never a torn payload)
 _REF_TYPES = (bool, int, float, str, type(None))
@@ -84,6 +90,47 @@ def checkpoint_key(fold_key: str, model_tag: str = "",
 def key_age(key: str) -> int:
     """Age component of a `checkpoint_key` (raises on malformed)."""
     return int(key.rsplit("-a", 1)[1])
+
+
+def manifest_key(replica_id: str) -> str:
+    """Object-store key of one replica's orphan manifest. Digested so
+    arbitrary replica ids stay filesystem-safe under the same backend
+    the checkpoint mirrors live in, with a distinct prefix space from
+    `checkpoint_group` (different schema string digests apart)."""
+    return stable_digest(MANIFEST_SCHEMA, str(replica_id))
+
+
+def read_manifest(backend, replica_id: str) -> Optional[dict]:
+    """Decode one replica's published orphan manifest from the shared
+    backend; None on miss or anything malformed (a torn/alien payload
+    must read as 'no manifest', never crash a controller tick)."""
+    if backend is None:
+        return None
+    try:
+        data = backend.get(manifest_key(replica_id))
+        if data is None:
+            return None
+        manifest = json.loads(data.decode("utf-8"))
+    except Exception:
+        return None
+    if not isinstance(manifest, dict) \
+            or manifest.get("schema") != MANIFEST_SCHEMA \
+            or not isinstance(manifest.get("orphans"), list):
+        return None
+    return manifest
+
+
+def clear_manifest(backend, replica_id: str) -> bool:
+    """Drop a replica's manifest after its orphans were adopted (the
+    controller's ack — re-reading on the next tick must find nothing,
+    so adoption is idempotent across reconcile rounds)."""
+    if backend is None:
+        return False
+    try:
+        backend.delete(manifest_key(replica_id))
+        return True
+    except Exception:
+        return False
 
 
 # -- sharding specs --------------------------------------------------------
@@ -573,6 +620,60 @@ class CheckpointStore:
                     self._remove(stale)
                 continue
             yield key, ckpt
+
+    # -- orphan manifest (ISSUE 20) ---------------------------------------
+
+    def publish_manifest(self, replica_id: str) -> Optional[dict]:
+        """Preemption hand-off: enumerate every resumable survivor this
+        store holds (newest age per group, current tag), make sure each
+        is mirrored to the shared backend, and publish one JSON
+        manifest under `manifest_key(replica_id)` so the controller can
+        assign the orphans to a live survivor. Also written as a
+        sibling disk file next to the checkpoints (debuggability: the
+        spill directory is self-describing post-mortem). Returns the
+        manifest dict, or None when there is nothing to hand off —
+        publishing an empty manifest would only make every controller
+        tick pay a read for a replica that owed nobody anything."""
+        orphans = []
+        for key, ckpt in self.survivors():
+            group = key.rsplit("-a", 1)[0]
+            if self.backend is not None:
+                # spills mirror on put_row, but the backend may have
+                # been attached after early spills — re-mirror so the
+                # adopter's backend fetch cannot miss what we advertise
+                try:
+                    self.backend.put(group, encode_checkpoint(key, ckpt))
+                except Exception:
+                    pass
+            orphans.append({"group": group,
+                            "fold_key": ckpt.fold_key,
+                            "age": int(ckpt.age),
+                            "model_tag": ckpt.model_tag})
+        if not orphans:
+            return None
+        manifest = {"schema": MANIFEST_SCHEMA,
+                    "replica_id": str(replica_id),
+                    "model_tag": self.model_tag,
+                    "published_s": float(self._clock()),
+                    "orphans": orphans}
+        data = json.dumps(manifest).encode("utf-8")
+        if self.backend is not None:
+            try:
+                self.backend.put(manifest_key(replica_id), data)
+            except Exception:
+                self.stats.bump("disk_errors")
+        try:
+            import os
+            path = os.path.join(self.store.disk_dir,
+                                f"orphans-{manifest_key(replica_id)}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(data)
+            os.replace(tmp, path)
+        except OSError:
+            pass                  # the sibling copy is best-effort
+        self.stats.event("manifest_published")
+        return manifest
 
     # -- plumbing ----------------------------------------------------------
 

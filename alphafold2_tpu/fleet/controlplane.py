@@ -22,6 +22,11 @@ Per cycle, in order:
 2. TTL SWEEP — `registry.sweep()` auto-downs wedged-but-listening
    members (fresh TCP accept, stale heartbeat) with an epoch bump, so
    rings rebuild around them (the ISSUE-16 registry satellite).
+   With `orphan_store=` set (ISSUE 20), a dead replica — preemption
+   notice seen on /healthz, endpoint gone, or TTL-swept — has its
+   orphan manifest read from the shared checkpoint backend and its
+   folds actively assigned to the least-loaded survivor via
+   `POST /admin/adopt`, so adoption latency is reconcile-tick-bounded.
 3. MEMBERSHIP FAN-OUT — joins/leaves/health flips are announced to
    every healthy replica's `POST /admin/peers`, so the DATA plane's
    per-replica registries (and therefore their consistent-hash rings)
@@ -115,6 +120,24 @@ def http_post_json(url: str, payload: dict,
             return json.loads(resp.read().decode("utf-8"))
     except Exception:
         return None
+
+
+def http_probe_json(url: str, timeout_s: float = 2.0):
+    """(status, body-dict) even for error statuses — a preempting
+    replica answers /healthz with a 503 whose BODY carries the state
+    (ISSUE 20), and the plain getter above would collapse that to
+    None. (None, None) on transport failure."""
+    try:
+        with urlrequest.urlopen(url, timeout=timeout_s) as resp:
+            return resp.status, json.loads(resp.read().decode("utf-8"))
+    except Exception as exc:
+        code = getattr(exc, "code", None)
+        if code is None:
+            return None, None
+        try:
+            return code, json.loads(exc.read().decode("utf-8"))
+        except Exception:
+            return code, None
 
 
 _SERIES_RE = re.compile(
@@ -335,6 +358,11 @@ class FleetController:
         fold keys the campaign ledgers / quarantine files record as
         terminal (ISSUE 19). None (default) = no GC, byte-identical
         reconcile records.
+    orphan_store: optional shared `ObjectStoreBackend` (the one the
+        replicas' CheckpointStores mirror into) — enables orphan
+        adoption (ISSUE 20): dead replicas' manifests are read from
+        it and assigned to survivors. None (default) = no adoption,
+        byte-identical records and metric-name set.
     resize: feature-pool resize actuation on/off.
     boot_grace_s: how long a spawned-but-not-yet-joined endpoint
         counts as PENDING toward quorum and the max bound. A replica
@@ -362,6 +390,7 @@ class FleetController:
                  decision_log_max_bytes: int = 0,
                  decision_log_max_age_s: Optional[float] = None,
                  checkpoint_gc: Optional[CheckpointGC] = None,
+                 orphan_store=None,
                  clock=time.monotonic):
         self.fleet = fleet
         self.policy = policy or ScalingPolicy()
@@ -378,6 +407,11 @@ class FleetController:
         self.rollout_backoff_s = float(rollout_backoff_s)
         self.boot_grace_s = float(boot_grace_s)
         self.checkpoint_gc = checkpoint_gc
+        # orphan adoption (ISSUE 20): the shared ObjectStoreBackend the
+        # replicas spill checkpoints + orphan manifests into. None
+        # (default) = no adoption, byte-identical reconcile records and
+        # registry metric-name set.
+        self.orphan_store = orphan_store
         # decision-log retention (ISSUE 18): a controller that runs
         # for weeks appends one JSONL record per reconcile — unbounded
         # by default (byte-identical to PR 16/17 behavior). When
@@ -424,6 +458,18 @@ class FleetController:
         self._m_stragglers = reg.gauge(
             "controller_rollout_stragglers",
             "healthy replicas not yet on the rollout target tag")
+        # adoption series exist only with the knob on (identity pin:
+        # a controller without an orphan store mints no new names)
+        self._m_adoptions = None
+        self._m_adopt_latency = None
+        if orphan_store is not None:
+            self._m_adoptions = reg.counter(
+                "fleet_orphan_adoptions_total",
+                "orphaned folds assigned to survivors by the "
+                "controller, by detection source", ("source",))
+            self._m_adopt_latency = reg.histogram(
+                "fleet_orphan_adoption_seconds",
+                "manifest publish -> survivor adoption latency")
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -433,6 +479,11 @@ class FleetController:
         self._last_poll: Dict[tuple, dict] = {}   # (rid, inc) -> sample
         self._pending_since: Dict[str, float] = {}  # rid -> first seen
         self._announced_up: set = set()   # rids the data plane knows up
+        # adoption state (ISSUE 20): rids whose /healthz announced
+        # preempting (first-seen stamp -> source="notice"), and rids
+        # whose death still owes an adoption attempt
+        self._preempting_seen: Dict[str, float] = {}
+        self._pending_adoptions: set = set()
         self._rollout_tag: Optional[str] = None
         self._warmed: set = set()
         self._warm_tickets: list = []
@@ -497,9 +548,17 @@ class FleetController:
         joined, health = [], {}
         known = set(self.registry.member_ids())
         for rid in sorted(endpoints):
-            hz = http_get_json(endpoints[rid] + "/healthz",
-                               self.probe_timeout_s)
-            if hz is None or not hz.get("running"):
+            status, hz = http_probe_json(endpoints[rid] + "/healthz",
+                                         self.probe_timeout_s)
+            if hz is not None and hz.get("preempting"):
+                # announced reclaim (ISSUE 20): the 503 body names the
+                # state — remember WHEN, so the adoption that follows
+                # this replica's death is source="notice" and the
+                # manifest is read the tick it appears instead of
+                # waiting out a TTL sweep
+                self._preempting_seen.setdefault(rid, now)
+                self._pending_adoptions.add(rid)
+            if status != 200 or hz is None or not hz.get("running"):
                 continue           # no heartbeat: the sweep judges it
             if rid not in known:
                 self.registry.register(rid)
@@ -530,6 +589,16 @@ class FleetController:
         # 2. TTL sweep: wedged-but-listening members go down WITH an
         # epoch bump — they stop owning keys, not just failing them
         swept = self.registry.sweep()
+
+        # 2b. orphan adoption (ISSUE 20): a dead replica's manifest is
+        # actively assigned to a least-loaded survivor THIS tick —
+        # adoption latency is reconcile-bounded, never waiting on a
+        # duplicate submit to stumble into a lazy peer probe
+        adoptions: List[dict] = []
+        if self.orphan_store is not None:
+            self._pending_adoptions.update(left)
+            self._pending_adoptions.update(swept)
+            adoptions = self._adopt_orphans(endpoints, health)
 
         # 3. data-plane membership fan-out
         announced = self._announce_membership(endpoints, health)
@@ -615,7 +684,93 @@ class FleetController:
             # only with the knob on: default reconcile records keep
             # their PR-18 shape
             record["checkpoint_gc_swept"] = gc_swept
+        if self.orphan_store is not None:
+            # only with the knob on, same contract as checkpoint_gc
+            record["orphan_adoptions"] = adoptions
         return record
+
+    # -- orphan adoption (ISSUE 20) ----------------------------------------
+
+    def _adopt_orphans(self, endpoints, health) -> List[dict]:
+        """Assign every pending dead replica's orphan manifest to a
+        live survivor via POST /admin/adopt. A rid stays pending until
+        its manifest is adopted (the manifest may publish a beat after
+        the death is detected — the replica spends its grace window
+        spilling first), or until the rid rejoins (a restart reclaims
+        its own checkpoints through boot discovery)."""
+        from alphafold2_tpu.cache.checkpoints import (clear_manifest,
+                                                      read_manifest)
+        out: List[dict] = []
+        for rid in sorted(self._pending_adoptions):
+            if rid in health:
+                # back from the dead (restart): its own boot discovery
+                # owns the checkpoints now
+                self._pending_adoptions.discard(rid)
+                self._preempting_seen.pop(rid, None)
+                continue
+            manifest = read_manifest(self.orphan_store, rid)
+            if manifest is None:
+                continue                # not published yet: retry
+            orphans = manifest.get("orphans") or []
+            source = ("notice" if rid in self._preempting_seen
+                      else "sweep")
+            if orphans:
+                survivor = self._pick_survivor(endpoints, health, rid)
+                if survivor is None:
+                    continue            # no live member yet: retry
+                resp = http_post_json(
+                    endpoints[survivor] + "/admin/adopt",
+                    {"replica_id": rid, "source": source,
+                     "model_tag": manifest.get("model_tag", ""),
+                     "published_s": manifest.get("published_s"),
+                     "orphans": orphans},
+                    self.probe_timeout_s)
+                if resp is None:
+                    continue            # survivor refused: retry
+                adopted = int(resp.get("adopted", 0) or 0)
+                if self._m_adoptions is not None and adopted:
+                    self._m_adoptions.inc(adopted, source=source)
+                if self._m_adopt_latency is not None:
+                    try:
+                        self._m_adopt_latency.observe(max(
+                            0.0, time.time()
+                            - float(manifest["published_s"])))
+                    except (KeyError, TypeError, ValueError):
+                        pass
+                out.append({"replica": rid, "source": source,
+                            "survivor": survivor,
+                            "orphans": len(orphans),
+                            "adopted": adopted})
+            else:
+                out.append({"replica": rid, "source": source,
+                            "survivor": None, "orphans": 0,
+                            "adopted": 0})
+            clear_manifest(self.orphan_store, rid)
+            self._pending_adoptions.discard(rid)
+            self._preempting_seen.pop(rid, None)
+        return out
+
+    def _pick_survivor(self, endpoints, health,
+                       dead_rid: str) -> Optional[str]:
+        """Least-loaded live member to adopt onto: healthy in the
+        controller's registry, responding, not draining/preempting —
+        sorted by the health payload's queue depth (the same
+        least-loaded notion scaling's drain-target pick uses), rid as
+        the deterministic tiebreak."""
+        candidates = []
+        for rid in sorted(health):
+            if rid == dead_rid or rid not in endpoints:
+                continue
+            hz = health[rid]
+            if not self.registry.is_healthy(rid):
+                continue
+            if hz.get("draining") or hz.get("preempting"):
+                continue
+            candidates.append((int(hz.get("queue_depth", 0) or 0),
+                               rid))
+        if not candidates:
+            return None
+        return min(candidates)[1]
 
     # -- membership fan-out ------------------------------------------------
 
@@ -987,7 +1142,7 @@ class FleetController:
             decisions = list(self.decisions)
         actions = [a for d in decisions
                    for a in d.get("actions", [])]
-        return {
+        out = {
             "reconciles": self._n,
             "registry": self.registry.snapshot(),
             "scale_ups": sum(1 for a in actions
@@ -1000,3 +1155,19 @@ class FleetController:
             "warmed": len(self._warmed),
             "decisions": len(decisions),
         }
+        if self.orphan_store is not None:
+            # adoption summary (ISSUE 20) — key exists only with the
+            # knob on, same identity contract as the metric series
+            ads = [a for d in decisions
+                   for a in d.get("orphan_adoptions", ())]
+            by_source: Dict[str, int] = {}
+            for a in ads:
+                src = str(a.get("source", "?"))
+                by_source[src] = (by_source.get(src, 0)
+                                  + int(a.get("adopted", 0) or 0))
+            out["orphan_adoptions"] = {
+                "events": len(ads),
+                "adopted": sum(int(a.get("adopted", 0) or 0)
+                               for a in ads),
+                "by_source": by_source}
+        return out

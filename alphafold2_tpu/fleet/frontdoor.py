@@ -66,6 +66,19 @@ cache tier in `fleet/peer.py`). The protocol is deliberately tiny:
                                  for f seconds (chaos: an induced
                                  network partition as every caller
                                  experiences it; admin stays reachable)
+    POST /admin/adopt            {"replica_id": dead, "source":
+                                 "notice"|"sweep", "orphans": [...]}
+                                 -> the fleet controller assigns a dead
+                                 replica's orphaned folds to THIS
+                                 replica (ISSUE 20); it pulls each
+                                 orphan's spilled checkpoint and
+                                 resumes mid-loop; 400 unless the
+                                 owner wired `adopt_handler`
+
+A replica that has received a preemption notice (ISSUE 20) reports
+`"preempting": true` in /healthz (as a 503, so probes mark it down
+immediately) and in the /v1/submit draining rejection body, so clients
+fail over on the FIRST refusal instead of counting strikes.
 
 Every terminal status travels verbatim — ok / shed / error / cancelled
 / degraded / poisoned, plus source cache/coalesced/forwarded — so a
@@ -165,6 +178,12 @@ class FrontDoorServer:
         # a control plane can rebuild data-plane rings at runtime;
         # None = 400 (static-membership replicas take no peer verbs)
         self.peer_admin = None
+        # optional callable(payload_dict) -> dict handling
+        # POST /admin/adopt (ISSUE 20 orphan adoption): the owning
+        # process resubmits a dead peer's manifest-listed folds into
+        # ITS scheduler (resuming from the spilled checkpoints); None
+        # = 400 (replicas without a checkpoint store adopt nothing)
+        self.adopt_handler = None
         reg = metrics or get_registry()
         # the registry GET /metrics exposes — the same one the rpc
         # counter below reports into (the process default unless the
@@ -328,6 +347,12 @@ class FrontDoorServer:
             # the recovery probe must keep it marked down
             self._m_rpc.inc(route="healthz", outcome="partitioned")
             return h._reply(503, json.dumps(payload).encode("utf-8"))
+        if payload.get("preempting"):
+            # reclaim announced (ISSUE 20): this replica dies within
+            # the grace window — 503 with the state in the body, so a
+            # single probe marks it down AND tells the prober why
+            self._m_rpc.inc(route="healthz", outcome="preempting")
+            return h._reply(503, json.dumps(payload).encode("utf-8"))
         self._m_rpc.inc(route="healthz", outcome="ok")
         h._json(200, payload)
 
@@ -431,7 +456,13 @@ class FrontDoorServer:
         except DrainingError:
             self._finish_trace(trace, "rejected", "draining")
             self._m_rpc.inc(route="submit", outcome="draining")
-            return h._json(503, {"error": "draining"})
+            body = {"error": "draining"}
+            if getattr(self.scheduler, "preempting", False):
+                # tell the refused caller WHY (ISSUE 20): a preempting
+                # drain never heals, so the client marks this replica
+                # down immediately instead of counting strikes
+                body["preempting"] = True
+            return h._json(503, body)
         except QueueFullError:
             self._finish_trace(trace, "rejected", "queue full")
             self._m_rpc.inc(route="submit", outcome="queue_full")
@@ -632,6 +663,25 @@ class FrontDoorServer:
                 return h._json(500, {"error": repr(exc)})
             self._m_rpc.inc(route="admin_peers", outcome="ok")
             return h._json(200, dict(out or {}, op=op))
+        if path == "/admin/adopt" and method == "POST":
+            if self.adopt_handler is None:
+                self._m_rpc.inc(route="admin_adopt", outcome="error")
+                return h._json(400, {"error": "no adopt handler"})
+            try:
+                payload = json.loads(h._body().decode("utf-8"))
+                if not isinstance(payload.get("orphans"), list):
+                    raise ValueError("orphans must be a list")
+            except Exception as exc:
+                self._m_rpc.inc(route="admin_adopt", outcome="error")
+                return h._json(400, {"error": f"bad payload: {exc!r}"})
+            try:
+                out = self.adopt_handler(payload)
+            except Exception as exc:
+                self._m_rpc.inc(route="admin_adopt", outcome="error")
+                return h._json(500, {"error": repr(exc)})
+            self._m_rpc.inc(route="admin_adopt", outcome="ok")
+            return h._json(200, dict(out or {},
+                                     replica=self.replica_id))
         if path == "/admin/partition" and method == "POST":
             try:
                 payload = json.loads(h._body().decode("utf-8") or "{}")

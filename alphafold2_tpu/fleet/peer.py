@@ -355,6 +355,31 @@ class PeerCacheClient:
             "marked-down peers recovered by a half-open health probe")
         self.stale_tag_hits = 0   # 200s discarded on tag mismatch (== 0
         #                           unless a server is misbehaving)
+        self.preempt_markdowns = 0  # peers marked down on a single
+        #                             `preempting` 503 (ISSUE 20)
+
+    def _note_preempting(self, peer_id: str, exc) -> bool:
+        """Immediate mark-down on an announced reclaim (ISSUE 20): a
+        503 whose JSON body carries `"preempting": true` is not a
+        flaky transport earning strikes — the replica has TOLD us it
+        dies within its grace window, and it will never heal in place.
+        Mark it down on the first refusal (bypassing the
+        `fail_threshold` count-up) so zero further fetches route at
+        it. Returns True when the mark-down happened."""
+        if getattr(exc, "code", None) != 503:
+            return False
+        try:
+            snap = json.loads(exc.read().decode("utf-8"))
+        except Exception:
+            return False
+        if not isinstance(snap, dict) or not snap.get("preempting"):
+            return False
+        with self._lock:
+            self._consecutive_failures.pop(peer_id, None)
+            self._down[peer_id] = time.monotonic()
+            self.preempt_markdowns += 1
+        self.registry.mark(peer_id, up=False)
+        return True
 
     def _note_transport_failure(self, peer_id: str):
         with self._lock:
@@ -442,6 +467,10 @@ class PeerCacheClient:
             return False
         if snap.get("draining") or snap.get("running") is False:
             return False
+        if snap.get("preempting"):
+            # announced reclaim (ISSUE 20): the process dies within
+            # its grace window — never mark it back up
+            return False
         return True
 
     def get(self, key: str, trace=NULL_TRACE) -> Optional[CachedFold]:
@@ -492,7 +521,10 @@ class PeerCacheClient:
                        else "stale_tag" if exc.code == 409 else "error")
             self._note_transport_ok(owner)
             if outcome == "error":
-                self._note_transport_failure(owner)
+                if self._note_preempting(owner, exc):
+                    outcome = "preempting"
+                else:
+                    self._note_transport_failure(owner)
         except ValueError:
             outcome = "corrupt"       # decode_fold: bad bytes, live peer
             self._note_transport_ok(owner)
@@ -562,7 +594,10 @@ class PeerCacheClient:
                            else "ckpt_error")
                 self._note_transport_ok(pid)
                 if outcome == "ckpt_error":
-                    self._note_transport_failure(pid)
+                    if self._note_preempting(pid, exc):
+                        outcome = "ckpt_preempting"
+                    else:
+                        self._note_transport_failure(pid)
             except Exception:
                 self._note_transport_failure(pid)
             self._m_latency.observe(time.monotonic() - t0)

@@ -128,7 +128,8 @@ class ProcFleet:
                  controller: Optional[dict] = None,
                  checkpoint_spill: bool = False,
                  bulk: Optional[dict] = None,
-                 cascade: Optional[dict] = None):
+                 cascade: Optional[dict] = None,
+                 preemption: bool = False):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self.run_dir = os.path.abspath(run_dir)
@@ -153,8 +154,16 @@ class ProcFleet:
             retry=bool(retry), key_log=bool(key_log),
             # durable mid-loop checkpoints (ISSUE 18): each replica
             # spills step-loop carries under its state dir and serves
-            # them to failover peers over the checkpoint artifact kind
-            checkpoint_spill=bool(checkpoint_spill),
+            # them to failover peers over the checkpoint artifact kind.
+            # preemption (ISSUE 20) implies it: a grace-budgeted drain
+            # with nowhere to spill could only cancel.
+            checkpoint_spill=bool(checkpoint_spill) or bool(preemption),
+            # spot-preemptible serving (ISSUE 20): each replica runs a
+            # PreemptionWatcher on a file notice source, mirrors its
+            # spills + orphan manifest into <run_dir>/shared_checkpoints,
+            # and takes /admin/adopt assignments; the preempt() chaos
+            # verb and the controller's adoption step ride this knob
+            preemption=bool(preemption),
             # bulk tier (ISSUE 18): serve.BulkPolicy kwargs; None =
             # no BulkQueue, qos="bulk" submits fold as plain online
             bulk=(None if bulk is None else dict(bulk)),
@@ -247,6 +256,16 @@ class ProcFleet:
             # the controller's telemetry-driven warming (and
             # cache_warm --from-serve-log) reads
             config["key_log_path"] = os.path.join(rdir, "keys.jsonl")
+        if k.get("preemption"):
+            # spot-preemptible serving (ISSUE 20): the file the
+            # preempt() verb writes its notice to, and the shared
+            # backend every replica mirrors checkpoints + manifests
+            # into (what survives the process is what gets adopted)
+            config["preemption"] = True
+            config["preempt_notice_path"] = os.path.join(
+                rdir, "preempt.notice")
+            config["shared_checkpoints"] = os.path.join(
+                self.run_dir, "shared_checkpoints")
         config_path = os.path.join(rdir, "config.json")
         with open(config_path, "w") as fh:
             json.dump(config, fh, indent=1)
@@ -300,6 +319,14 @@ class ProcFleet:
             max(policy_kwargs["min_replicas"], self._n_boot + 2))
         cfg.setdefault("decisions_path", os.path.join(
             self.run_dir, "controller.decisions.jsonl"))
+        if self._knobs.get("preemption"):
+            # orphan adoption (ISSUE 20): the controller reads dead
+            # replicas' manifests from the same shared backend the
+            # replicas mirror their spills into
+            from alphafold2_tpu.fleet.object_store import \
+                FilesystemObjectStore
+            cfg.setdefault("orphan_store", FilesystemObjectStore(
+                os.path.join(self.run_dir, "shared_checkpoints")))
         cfg.setdefault("tracer", Tracer(
             jsonl_path=os.path.join(self.run_dir,
                                     "controller-traces.jsonl"),
@@ -383,6 +410,41 @@ class ProcFleet:
         recovery: persisted rollout epoch + quarantine load at boot)."""
         self.spawn(index)
         self.wait_ready([index], timeout_s=timeout_s)
+
+    def preempt(self, index: int, grace_s: float = 5.0) -> None:
+        """Spot reclaim (ISSUE 20): deliver a preemption notice with a
+        grace window, then hard-kill (-9) whatever is still alive when
+        the window closes — exactly the cloud's contract. The replica's
+        PreemptionWatcher polls the notice file; a well-behaved replica
+        spills its in-flight loops, publishes its orphan manifest, and
+        exits clean before the kill lands. Requires preemption=True.
+
+        Returns immediately; the kill runs on a daemon timer so the
+        test/loadtest can keep driving the survivors through the grace
+        window (where the interesting behavior is)."""
+        h = self.replicas[index]
+        path = h.config.get("preempt_notice_path")
+        if not path:
+            raise RuntimeError(
+                f"{h.replica_id} has no preempt_notice_path "
+                f"(ProcFleet(preemption=True) required)")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"grace_s": float(grace_s),
+                       "detail": "procfleet.preempt"}, f)
+        os.replace(tmp, path)
+
+        def _kill():
+            if h.alive():
+                h.proc.kill()
+                try:
+                    h.proc.wait(30)
+                except Exception:
+                    pass
+
+        t = threading.Timer(float(grace_s), _kill)
+        t.daemon = True
+        t.start()
 
     def partition(self, index: int, duration_s: float) -> bool:
         """Induce a network partition: both the replica's planes refuse
@@ -558,10 +620,50 @@ class FleetClient:
         self.submit_retries = 0       # submit refused, went elsewhere
         self.failovers = 0            # terminal transport-marker errors
         self.timeouts = 0             # result timeouts (remote-cancelled)
+        self.preempt_markdowns = 0    # replicas skipped on announced
+        #                               reclaim (ISSUE 20)
+        self.preempt_failovers = 0    # "preempted" terminals resubmitted
+        self._preempting: set = set()  # base_urls marked preempting
 
     def _count(self, field: str):
         with self._lock:
             setattr(self, field, getattr(self, field) + 1)
+
+    def _note_preempting(self, transport, exc) -> bool:
+        """503 with `"preempting": true` in the body (ISSUE 20): the
+        replica announced its own death — mark it out of the rotation
+        NOW (no strike count-up, no backoff) and return True. Any
+        other refusal returns False and takes the normal retry path."""
+        if getattr(exc, "code", None) != 503:
+            return False
+        try:
+            snap = json.loads(exc.read().decode("utf-8"))
+        except Exception:
+            return False
+        if not isinstance(snap, dict) or not snap.get("preempting"):
+            return False
+        self._mark_preempting(transport)
+        return True
+
+    def _mark_preempting(self, transport):
+        with self._lock:
+            if transport.base_url not in self._preempting:
+                self._preempting.add(transport.base_url)
+                self.preempt_markdowns += 1
+
+    def _pick(self, seat: int):
+        """The round-robin seat, skipping replicas marked preempting —
+        unless every replica is marked, in which case the raw seat
+        stands (a wrong guess beats refusing to try)."""
+        n = len(self.transports)
+        with self._lock:
+            marked = set(self._preempting)
+        if marked:
+            for off in range(n):
+                t = self.transports[(seat + off) % n]
+                if t.base_url not in marked:
+                    return t
+        return self.transports[seat % n]
 
     def set_urls(self, urls: List[str]):
         """Grow the failover set at runtime (ISSUE 16: a controller-
@@ -588,10 +690,17 @@ class FleetClient:
         n = len(self.transports)
         last = None
         for attempt in range(self.max_rounds * n):
-            transport = self.transports[(hint + attempt) % n]
+            transport = self._pick(hint + attempt)
             try:
                 ticket = transport.submit(request, trace=trace)
             except HTTPError as exc:
+                if self._note_preempting(transport, exc):
+                    # announced reclaim (ISSUE 20): skip this replica
+                    # for good and go straight at the next seat — no
+                    # backoff, the refusal was authoritative, not flaky
+                    last = exc
+                    self._count("submit_retries")
+                    continue
                 if exc.code < 500 and exc.code != 429:
                     # deterministic client error (400 bad request,
                     # 409 tag fence): every replica will refuse it the
@@ -623,6 +732,17 @@ class FleetClient:
                 self._count("failovers")
                 time.sleep(self.retry.delay_s(attempt + 1))
                 continue
+            if resp.status == "preempted":
+                # the replica spilled this fold's mid-loop checkpoint
+                # and is exiting (ISSUE 20): resubmit IMMEDIATELY on a
+                # survivor — the survivor's submit consult resumes from
+                # the spilled recycle, so the retry pays only the
+                # recycles since the last checkpoint, and no backoff is
+                # owed (the terminal was an announcement, not a fault)
+                last = RuntimeError(resp.error or "replica preempted")
+                self._count("preempt_failovers")
+                self._mark_preempting(transport)
+                continue
             return resp
         raise RuntimeError(
             f"all {n} replicas failed {request.request_id} "
@@ -630,9 +750,15 @@ class FleetClient:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {"submit_retries": self.submit_retries,
-                    "failovers": self.failovers,
-                    "timeouts": self.timeouts}
+            out = {"submit_retries": self.submit_retries,
+                   "failovers": self.failovers,
+                   "timeouts": self.timeouts}
+            if self.preempt_markdowns or self.preempt_failovers:
+                # keys absent until a reclaim happened, so baseline
+                # loadtest reports compare byte-identical (ISSUE 20)
+                out["preempt_markdowns"] = self.preempt_markdowns
+                out["preempt_failovers"] = self.preempt_failovers
+            return out
 
 
 # -- child: one replica process ------------------------------------------
@@ -849,6 +975,15 @@ def replica_main(config: dict) -> int:
     # peer's spill through the same client that fetches fold results
     if scheduler.checkpoint_store is not None:
         scheduler.checkpoint_store.peer = client
+        if config.get("shared_checkpoints"):
+            # spot preemption (ISSUE 20): mirror spills + the orphan
+            # manifest into the fleet-shared backend — what survives
+            # the reclaimed PROCESS is what the controller can hand a
+            # survivor to adopt after the hard kill lands
+            from alphafold2_tpu.fleet.object_store import \
+                FilesystemObjectStore
+            scheduler.checkpoint_store.backend = FilesystemObjectStore(
+                config["shared_checkpoints"])
     # a rollout re-tags the executor, which orphans every executable
     # compiled under the previous tag (the ISSUE 7 staleness fix) —
     # re-warm in the BACKGROUND so a rolled replica re-compiles its
@@ -920,6 +1055,39 @@ def replica_main(config: dict) -> int:
                 "epoch": registry.epoch}
 
     frontdoor.peer_admin = _peer_admin
+
+    # orphan adoption (ISSUE 20): the controller POSTs a dead peer's
+    # manifest rows here; each fold resumes from its spilled
+    # checkpoint (shared backend / peer artifact tier) at the spilled
+    # recycle age instead of refolding from zero — the fold_key is
+    # content-derived, so the resumed result is byte-equal to an
+    # uninterrupted fold of the same request
+    def _adopt(payload: dict) -> dict:
+        import numpy as np
+        store = scheduler.checkpoint_store
+        if store is None:
+            raise RuntimeError("no checkpoint store: cannot adopt")
+        adopted = failed = 0
+        dead = str(payload.get("replica_id") or "?")
+        for rec in payload.get("orphans") or []:
+            fk = str((rec or {}).get("fold_key") or "")
+            ck = store.latest(fk) if fk else None
+            if ck is None or ck.seq is None:
+                failed += 1
+                continue
+            trace = tracer.start_trace(f"adopt-{fk[:12]}")
+            trace.begin("adopt")
+            req = serve.FoldRequest(
+                seq=np.asarray(ck.seq),
+                msa=None if ck.msa is None else np.asarray(ck.msa),
+                request_id=f"adopt-{dead}-{fk[:12]}")
+            scheduler.submit(req, trace=trace)
+            trace.end("adopt", source=dead, age=int(ck.age))
+            adopted += 1
+        return {"adopted": adopted, "failed": failed}
+
+    if config.get("preemption"):
+        frontdoor.adopt_handler = _adopt
     # peer-cache fetches served here emit continued trace records
     # under the requester's peer_fetch hop (ISSUE 15)
     peer_server.tracer = tracer
@@ -938,6 +1106,27 @@ def replica_main(config: dict) -> int:
     stop_event = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop_event.set())
     signal.signal(signal.SIGINT, lambda *a: stop_event.set())
+
+    # preemption watcher (ISSUE 20): a file notice (the preempt()
+    # chaos verb; in real deployments the metadata/signal sources)
+    # flips the scheduler into reclaim mode on the watcher thread,
+    # then wakes the main thread to run the grace-budgeted shutdown.
+    # SIGTERM stays the GRACEFUL drain (the scale-down contract) —
+    # the notice file is the reclaim channel.
+    notice_box: List = []
+    watcher = None
+    if config.get("preemption") and config.get("preempt_notice_path"):
+        from alphafold2_tpu.serve.preemption import (FileNoticeSource,
+                                                     PreemptionWatcher)
+
+        def _on_notice(n):
+            notice_box.append(n)
+            stop_event.set()
+
+        watcher = PreemptionWatcher(
+            [FileNoticeSource(config["preempt_notice_path"])],
+            scheduler=scheduler, on_notice=_on_notice,
+            poll_s=0.1).start()
     print(json.dumps({"ready": rid,
                       "frontdoor": list(frontdoor.address),
                       "peer": list(peer_server.address),
@@ -946,9 +1135,48 @@ def replica_main(config: dict) -> int:
 
     stop_event.wait()
 
+    if notice_box:
+        # spot reclaim (ISSUE 20): the grace window buys a MIGRATION,
+        # not a finish — spill every loop the budget can't fit,
+        # publish the orphan manifest into the shared backend, and be
+        # gone before the hard kill lands. The last second of grace is
+        # reserved for the manifest + the exit itself.
+        notice = notice_box[0]
+        if watcher is not None:
+            watcher.stop()
+        if feature_pool is not None:
+            feature_pool.stop()
+        budget = max(0.5, notice.deadline_s - time.monotonic() - 1.0)
+        complete = scheduler.drain(grace_s=budget)
+        manifest = None
+        if scheduler.checkpoint_store is not None:
+            try:
+                manifest = scheduler.checkpoint_store.publish_manifest(
+                    rid)
+            except Exception:
+                manifest = None
+        frontdoor.stop()
+        peer_server.stop()
+        tracer.close()
+        print(json.dumps({
+            "preempted": rid, "complete": complete,
+            "grace_s": notice.grace_s,
+            "orphans": (0 if not manifest
+                        else len(manifest.get("orphans", [])))}),
+            flush=True)
+        # _exit, not return: interpreter teardown joins every lingering
+        # thread (spilled-but-stuck step loops, executor atexit hooks)
+        # and can outlive the reclaim deadline — everything durable
+        # (manifest, traces, stdout) is already flushed, so die now
+        # rather than let the hard kill turn a clean exit into -9
+        sys.stdout.flush()
+        os._exit(0)
+
     # graceful drain: refuse new work, finish what we owe, let parked
     # results be picked up, then exit 0 — the SIGTERM contract a
     # rolling restart relies on
+    if watcher is not None:
+        watcher.stop()
     if feature_pool is not None:
         # featurize workers submit into the scheduler: drain them
         # first so the scheduler's drain sees every owed fold

@@ -85,6 +85,10 @@ class ReplicaSignals:
     replica_id: str
     healthy: bool = True
     draining: bool = False
+    # announced spot reclaim (ISSUE 20): the replica is draining under
+    # a grace deadline and WILL die — its burn contribution is the
+    # reclaim's fault, not organic load growth
+    preempting: bool = False
     queue_depth: int = 0
     served: int = 0
     burn_rate: float = 0.0        # max latency burn across SLO classes
@@ -191,6 +195,19 @@ def decide_scale(policy: ScalingPolicy,
         (s.featurize_queue_depth / max(1, s.featurize_workers)
          for s in healthy), default=0.0)
     if fleet_burn > policy.up_burn_rate:
+        if any(getattr(s, "preempting", False) for s in signals):
+            # announced reclaim in progress (ISSUE 20): the survivors'
+            # burn spike is the preemption window's fault — the failover
+            # wave plus the reclaimed member's lost capacity — and
+            # quorum restore (above, cooldown-exempt) already replaces
+            # the member once it is gone. Scaling up on this burn too
+            # would double-provision, then flap back down.
+            d.reason = (f"burn {fleet_burn:.2f} > "
+                        f"{policy.up_burn_rate:.2f} but attributable "
+                        f"to an announced preemption window: "
+                        f"suppressed (quorum restore replaces the "
+                        f"reclaimed member)")
+            return d
         if n >= policy.max_replicas:
             d.reason = (f"burn {fleet_burn:.2f} > "
                         f"{policy.up_burn_rate:.2f} but at "

@@ -61,6 +61,18 @@ The serving stack, bottom-up:
              batch retry, poison isolation by bisection + quarantine,
              non-finite output validation, the executor watchdog, and
              degraded mode (README "Failure handling & degraded mode")
+- preemption: PreemptionWatcher + notice sources (metadata/signal/
+             file) — spot reclaim as a scheduled migration: the notice
+             flips the scheduler into reclaim mode, `drain(grace_s=)`
+             spills every in-flight loop it cannot finish, and the
+             checkpoint store publishes an orphan manifest the fleet
+             controller adopts onto survivors (README "Spot &
+             preemptible serving")
+- xla_errors: classify/attributed_rows — pure-function XLA/TPU error
+             payload parser: transient-vs-deterministic verdicts plus
+             best-effort per-row attribution feeding the row-isolation
+             path; consulted by RetryPolicy only where the legacy
+             marker list has no opinion
 - faults:    FaultPlan — seeded chaos injection threaded through
              FoldExecutor / FoldCache / fleet.PeerCacheClient behind
              no-op defaults (tools/serve_loadtest.py --chaos)
@@ -114,6 +126,11 @@ from alphafold2_tpu.serve.meshpolicy import (AdmissionDecision,  # noqa: F401
                                              SliceLease)
 from alphafold2_tpu.serve.metrics import (KeyFrequencyLog,  # noqa: F401
                                           ServeMetrics)
+from alphafold2_tpu.serve.preemption import (FileNoticeSource,  # noqa: F401
+                                             MetadataNoticeSource,
+                                             PreemptionNotice,
+                                             PreemptionWatcher,
+                                             SignalNoticeSource)
 from alphafold2_tpu.serve.recycle import RecyclePolicy  # noqa: F401
 from alphafold2_tpu.serve.request import (FoldProgress, FoldRequest,  # noqa: F401
                                           FoldResponse, FoldTicket)
@@ -124,3 +141,6 @@ from alphafold2_tpu.serve.resilience import (CircuitBreaker,  # noqa: F401
 from alphafold2_tpu.serve.scheduler import (DrainingError,  # noqa: F401
                                             QueueFullError, Scheduler,
                                             SchedulerConfig)
+from alphafold2_tpu.serve.xla_errors import (XlaErrorClass,  # noqa: F401
+                                             attributed_rows,
+                                             classify)

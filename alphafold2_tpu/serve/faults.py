@@ -79,7 +79,7 @@ class FaultPlan:
 
     KINDS = ("exec_error", "exec_latency", "step_fail", "poison_raise",
              "poison_nan", "peer_error", "cache_corrupt",
-             "featurize_error", "featurize_latency")
+             "featurize_error", "featurize_latency", "preempt_notice")
 
     def __init__(self, seed: int = 0,
                  exec_error_rate: float = 0.0,
@@ -91,6 +91,7 @@ class FaultPlan:
                  featurize_error_rate: float = 0.0,
                  featurize_latency_rate: float = 0.0,
                  featurize_latency_s: float = 0.0,
+                 preempt_notice_rate: float = 0.0,
                  registry: Optional[MetricsRegistry] = None):
         self.step_fail_at = {int(k): float(v)
                              for k, v in (step_fail_at or {}).items()}
@@ -101,6 +102,7 @@ class FaultPlan:
                            ("featurize_error_rate", featurize_error_rate),
                            ("featurize_latency_rate",
                             featurize_latency_rate),
+                           ("preempt_notice_rate", preempt_notice_rate),
                            *((f"step_fail_at[{k}]", v)
                              for k, v in self.step_fail_at.items())):
             if not 0.0 <= rate <= 1.0:
@@ -114,6 +116,7 @@ class FaultPlan:
         self.featurize_error_rate = float(featurize_error_rate)
         self.featurize_latency_rate = float(featurize_latency_rate)
         self.featurize_latency_s = float(featurize_latency_s)
+        self.preempt_notice_rate = float(preempt_notice_rate)
         self._lock = threading.Lock()
         self._armed = False
         # one independent stream per site, seeded from (seed, site) so
@@ -121,7 +124,7 @@ class FaultPlan:
         self._rngs = {site: random.Random(f"{self.seed}:{site}")
                       for site in ("exec", "latency", "peer", "corrupt",
                                    "step", "featurize",
-                                   "featurize_lat")}
+                                   "featurize_lat", "preempt")}
         self._poison: List[dict] = []    # {"seq": np1d, "mode": str}
         self.injected = {k: 0 for k in self.KINDS}
         # (kind, ExecKey variant) -> count: which executable the fault
@@ -281,6 +284,19 @@ class FaultPlan:
             raise FaultInjected(
                 f"injected peer transport failure to {peer_id}")
 
+    def on_preempt_poll(self, replica_id: str = "") -> bool:
+        """Preemption-notice site (ISSUE 20): called from a
+        `serve.preemption` notice source's poll round; True = a
+        synthetic spot reclaim fires for this replica NOW (the caller
+        builds the PreemptionNotice — this site only rolls the seeded
+        dice, exactly like every other site). The draw comes from its
+        own stream, so arming preemption chaos never perturbs the
+        executor/peer fault sequences."""
+        if not self._hit("preempt", self.preempt_notice_rate):
+            return False
+        self._count("preempt_notice")
+        return True
+
     def corrupt_cache_bytes(self, key: str, data: bytes) -> bytes:
         """Called by FoldCache on disk reads before validation."""
         if not self._hit("corrupt", self.corrupt_rate):
@@ -303,7 +319,9 @@ class FaultPlan:
                               "featurize_error":
                                   self.featurize_error_rate,
                               "featurize_latency":
-                                  self.featurize_latency_rate},
+                                  self.featurize_latency_rate,
+                              "preempt_notice":
+                                  self.preempt_notice_rate},
                     "step_fail_at": dict(self.step_fail_at),
                     "poison_registered": len(self._poison),
                     "injected": dict(self.injected),

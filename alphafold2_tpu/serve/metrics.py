@@ -45,6 +45,9 @@ class ServeMetrics:
         self.errors = 0
         self.cancelled = 0
         self.rejected = 0           # backpressure: submit refused
+        # preemption reclaim terminals (ISSUE 20; zero — and absent
+        # from snapshot() — unless a reclaim actually happened)
+        self.preempted = 0
         # resilience outcomes (all zero without a RetryPolicy)
         self.degraded = 0           # fast-shed while the breaker is open
         self.poisoned = 0           # quarantined poison terminal states
@@ -146,6 +149,16 @@ class ServeMetrics:
         with self._lock:
             self.cancelled += n
         self._m_outcomes.inc(n, outcome="cancelled")
+
+    def record_preempted(self, n: int = 1):
+        """Requests resolved "preempted": the replica was reclaimed
+        mid-work; checkpoints (where spillable) were handed off for
+        adoption and the caller retries elsewhere. The outcome label
+        is minted on first use, so a never-preempted server's registry
+        stays byte-identical (ISSUE 20)."""
+        with self._lock:
+            self.preempted += n
+        self._m_outcomes.inc(n, outcome="preempted")
 
     def record_degraded(self, n: int = 1):
         with self._lock:
@@ -298,7 +311,7 @@ class ServeMetrics:
                 "p50": self._admit_pad_hist.percentile(50),
                 "p99": self._admit_pad_hist.percentile(99),
             }
-            return {
+            out = {
                 "enqueued": self.enqueued,
                 "served": self.served,
                 "shed": self.shed,
@@ -321,6 +334,11 @@ class ServeMetrics:
                 "latency_by_bucket": per_bucket,
                 "cache": self._cache_view(),
             }
+            if self.preempted:
+                # only after a reclaim: the never-preempted snapshot
+                # stays byte-identical (the identity pin reads it)
+                out["preempted"] = self.preempted
+            return out
 
     def close(self):
         if self._logger is not None:

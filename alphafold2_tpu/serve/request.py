@@ -95,7 +95,11 @@ class FoldResponse:
             produced non-finite output; duplicates fail fast forever) |
             "too_large" (mesh-aware scheduler only: the analytic HBM
             footprint exceeds the largest configured device slice, so
-            the fold is rejected at submit instead of OOMing mid-batch).
+            the fold is rejected at submit instead of OOMing mid-batch) |
+            "preempted" (the replica received a spot-reclaim notice and
+            spilled this fold's mid-loop checkpoint instead of finishing
+            it; resubmit anywhere — a survivor resumes from the spilled
+            recycle, or the controller adopts it automatically).
     source: how the result was obtained — "fold" (ran on the
             accelerator), "cache" (content-addressed result store hit),
             "coalesced" (attached to an identical in-flight fold; for

@@ -163,6 +163,13 @@ class RetryPolicy:
         exception instance of a listed type, or whose repr contains a
         marker substring (case-insensitive), is treated as transient.
         `TransientExecutorError`/`WatchdogTimeout` always are.
+    xla_classify: consult the `serve.xla_errors` payload classifier
+        (ISSUE 20) when — and only when — the marker list has no
+        opinion. Default-on but inert: every payload the legacy
+        markers already decide keeps its exact legacy verdict; the
+        classifier only adds verdicts on XLA/TPU shapes the flat list
+        never matched (program aborts, CHECK failures, ABORTED slice
+        halts). False restores the pure-marker classification.
     """
 
     max_attempts: int = 3
@@ -182,6 +189,7 @@ class RetryPolicy:
     transient_markers: Tuple[str, ...] = (
         "transient", "resource_exhausted", "deadline_exceeded",
         "unavailable", "connection reset")
+    xla_classify: bool = True
     _rng: random.Random = field(init=False, repr=False, compare=False,
                                 default=None)
 
@@ -212,7 +220,17 @@ class RetryPolicy:
         if self.transient_types and isinstance(exc, self.transient_types):
             return True
         r = repr(exc).lower()
-        return any(m.lower() in r for m in self.transient_markers)
+        if any(m.lower() in r for m in self.transient_markers):
+            return True
+        if self.xla_classify:
+            # XLA payload shapes the flat marker list never matched
+            # (ISSUE 20) — consulted last so legacy verdicts are
+            # untouched; no opinion falls through to the legacy False
+            from alphafold2_tpu.serve.xla_errors import classify
+            verdict = classify(repr(exc))
+            if verdict is not None:
+                return verdict.transient
+        return False
 
     def delay_s(self, attempts: int,
                 rng: Optional[random.Random] = None) -> float:

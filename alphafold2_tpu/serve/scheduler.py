@@ -737,6 +737,20 @@ class Scheduler:
         self._running = False
         self._drain = True
         self._draining = False     # graceful drain: admitting stopped
+        # preemption reclaim (ISSUE 20): a spot notice flips the
+        # scheduler into reclaim mode — a drain variant that stops
+        # founding batches and admitting rows, and spills in-flight
+        # loops whose remaining recycles cannot fit the grace window.
+        # Counters are minted LAZILY on the first notice so a
+        # never-preempted scheduler's registry metric-name set and
+        # scrubbed stats stay byte-identical (the identity pin).
+        self._reclaiming = False
+        self._reclaim_deadline: Optional[float] = None
+        self._reclaim_source = ""
+        self._n_preempt_notices = 0
+        self._n_preempt_spills = 0
+        self._c_preempt_notices = None
+        self._c_preempt_spills = None
         self._outstanding_forwards = 0   # guarded by _cond
         self._worker: Optional[threading.Thread] = None
 
@@ -819,7 +833,8 @@ class Scheduler:
             self._mesh_pool.shutdown(wait=True)
             self._mesh_pool = None
 
-    def drain(self, timeout_s: float = 30.0) -> bool:
+    def drain(self, timeout_s: float = 30.0,
+              grace_s: Optional[float] = None) -> bool:
         """Graceful drain — THE process-level shutdown path (wire it to
         SIGTERM): stop admitting (new submits raise DrainingError — a
         fleet front door maps that to 503 so callers retry elsewhere),
@@ -833,25 +848,54 @@ class Scheduler:
         rolling restart costs requests. Returns True when the drain
         fully completed (False = the forwarded-ticket wait timed out;
         local work still resolved). Idempotent; safe from a signal-
-        handler-fed thread."""
+        handler-fed thread.
+
+        grace_s (ISSUE 20): GRACE-BUDGETED drain for a preemption
+        reclaim — the process dies in `grace_s` seconds no matter
+        what, so finishing folds is conditional: in-flight step loops
+        whose remaining recycles FIT the window run to completion;
+        loops that cannot fit checkpoint-spill every row at the next
+        gap and resolve them "preempted" (the checkpoint survives for
+        adoption — see `CheckpointStore.publish_manifest`); queued
+        work that never founded resolves "preempted" immediately
+        instead of being folded. None (the default) is byte-for-byte
+        the finish-everything drain above."""
+        if grace_s is None:
+            with self._cond:
+                if not self._running and not self._draining:
+                    return True        # never started / already stopped
+                first = not self._draining
+                self._draining = True
+                if first:
+                    for e in itertools.chain(self._incoming,
+                                             *self._pending.values()):
+                        e.trace.begin("drain")
+                    # wake submitters blocked on a full queue NOW: they
+                    # must raise DrainingError immediately, not wait out
+                    # the forwarded-ticket grace below
+                    self._cond.notify_all()
+            if first:
+                self._n_drains += 1
+                self._c_drains.inc()
+            complete = True
+            deadline = time.monotonic() + timeout_s
+            with self._cond:
+                while self._outstanding_forwards > 0:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        complete = False
+                        break
+                    self._cond.wait(timeout=remaining)
+            self.stop(drain=True)
+            return complete
+        # grace-budgeted reclaim drain
         with self._cond:
             if not self._running and not self._draining:
-                return True            # never started / already stopped
-            first = not self._draining
-            self._draining = True
-            if first:
-                for e in itertools.chain(self._incoming,
-                                         *self._pending.values()):
-                    e.trace.begin("drain")
-                # wake submitters blocked on a full queue NOW: they
-                # must raise DrainingError immediately, not wait out
-                # the forwarded-ticket grace below
-                self._cond.notify_all()
-        if first:
-            self._n_drains += 1
-            self._c_drains.inc()
+                return True
+        self.preempt_notice(grace_s)
         complete = True
-        deadline = time.monotonic() + timeout_s
+        deadline = self._reclaim_deadline or \
+            (time.monotonic() + float(grace_s))
         with self._cond:
             while self._outstanding_forwards > 0:
                 remaining = deadline - time.monotonic()
@@ -859,8 +903,64 @@ class Scheduler:
                     complete = False
                     break
                 self._cond.wait(timeout=remaining)
-        self.stop(drain=True)
+        # stop WITHOUT the finish-everything drain: queued entries
+        # resolve "preempted" via _cancel_remaining (the reclaim flag
+        # switches its status), in-flight loops exit through the gap
+        # fit-test (finish when it fits, spill when it cannot) before
+        # the worker join / mesh-pool shutdown below return
+        self.stop(drain=False)
         return complete
+
+    def preempt_notice(self, grace_s: float, source: str = ""):
+        """Reclaim mode (ISSUE 20): this process has `grace_s` seconds
+        to live. Stops founding batches and admitting rows (bulk
+        included), 503s new submits (`_draining` — the front door
+        advertises `preempting` so clients mark this replica down
+        immediately), makes every recycle gap checkpoint, and arms the
+        gap-time fit test that spills loops the window cannot finish.
+        Idempotent — a later duplicate notice only tightens the
+        deadline, never extends it. Safe from any thread (the
+        PreemptionWatcher's poll thread calls it). Does NOT stop the
+        scheduler: the caller owns the actual drain
+        (`drain(grace_s=)`) and exit."""
+        now = time.monotonic()
+        deadline = now + float(grace_s)
+        with self._cond:
+            first = not self._reclaiming
+            self._reclaiming = True
+            if self._reclaim_deadline is None \
+                    or deadline < self._reclaim_deadline:
+                self._reclaim_deadline = deadline
+            if source:
+                self._reclaim_source = source
+            if first:
+                self._draining = True
+                for e in itertools.chain(self._incoming,
+                                         *self._pending.values()):
+                    e.trace.begin("preempt")
+                self._cond.notify_all()
+        if first:
+            self._n_preempt_notices += 1
+            if self._c_preempt_notices is None:
+                # lazy mint: the first notice ever is when the metric
+                # family appears (identity discipline)
+                self._c_preempt_notices = self._registry.counter(
+                    "serve_preempt_notices_total",
+                    "preemption notices that flipped the scheduler "
+                    "into reclaim mode")
+                self._c_preempt_spills = self._registry.counter(
+                    "serve_preempt_drain_spills_total",
+                    "in-flight step-loop rows checkpoint-spilled by a "
+                    "grace-budgeted reclaim drain (resolved "
+                    "'preempted' for controller adoption)")
+            self._c_preempt_notices.inc()
+
+    @property
+    def preempting(self) -> bool:
+        """True once a preemption notice flipped this scheduler into
+        reclaim mode — the health/503 payloads advertise it so peers
+        and clients mark the replica down without a count-up."""
+        return self._reclaiming
 
     def health(self) -> dict:
         """The one health payload every probe shares (the front door's
@@ -872,12 +972,18 @@ class Scheduler:
             depth = self._depth
             running = self._running
             draining = self._draining
+            reclaiming = self._reclaiming
         payload = {"running": running,
                    "draining": draining,
                    "queue_depth": depth,
                    "breaker": (None if self._breaker is None
                                else self._breaker.state),
                    "model_tag": self.model_tag}
+        if reclaiming:
+            # only under reclaim: the healthy payload stays
+            # byte-identical, and probes treat `preempting` as an
+            # immediate mark-down (no consecutive-failure count-up)
+            payload["preempting"] = True
         if self._allocator is not None:
             # mesh occupancy rides the one health payload every probe
             # shares, so the fleet front door / peer probes see it free
@@ -1842,6 +1948,95 @@ class Scheduler:
             self._cond.notify_all()
         return len(requeued)
 
+    # -- preemption reclaim (ISSUE 20) -----------------------------------
+
+    def _reclaim_fits(self, bucket_len: int, ages: List[int],
+                      num_recycles: int) -> bool:
+        """Can this loop's remaining recycles finish inside the grace
+        window? Priced with the bucket's measured step-seconds EWMA at
+        a 2x safety margin — the window must also pay for the final
+        fetch, the manifest publish, and the process exit, and
+        finishing 'probably' is not worth losing the spill. An unknown
+        EWMA (no step measured yet) says NO: spilling loses at most
+        `checkpoint_every` recycles, overrunning the window loses the
+        whole fold."""
+        deadline = self._reclaim_deadline
+        if deadline is None:
+            return False
+        ewma = self._step_ewma.get(bucket_len)
+        if ewma is None:
+            return False
+        remaining = max(num_recycles - a for a in ages)
+        return remaining * ewma * 2.0 <= deadline - time.monotonic()
+
+    def _preempt_spill_loop(self, bucket_len: int, state,
+                            active: List[_Entry], rows: List[int],
+                            ages: List[int],
+                            all_members: List[_Entry]) -> int:
+        """Grace-budgeted hand-off of one in-flight step loop: spill
+        every row's carry to the durable store (where one is
+        configured and the carry slices), then resolve EVERY row
+        "preempted" — the ticket must never outlive the process, and
+        the "preempted" terminal keeps its checkpoint so the adopting
+        survivor resumes at this exact age. Unspillable rows (no
+        store, unkeyable, unsliceable) still resolve "preempted":
+        their callers re-fold from zero on a survivor — work lost,
+        tickets never. Returns the number of rows spilled."""
+        store = self._ckpt_store
+        snap = None
+        if store is not None and active:
+            from alphafold2_tpu.cache.checkpoints import row_checkpoint
+            from alphafold2_tpu.predict import snapshot_step_state
+            try:
+                snap = snapshot_step_state(state)
+            except Exception:
+                snap = None
+        spilled = 0
+        now = time.monotonic()
+        members = list(active)
+        member_rows = list(rows)
+        member_ages = list(ages)
+        for i, e in enumerate(members):
+            wrote = False
+            if snap is not None:
+                key = self._entry_key(e)
+                if key is not None:
+                    try:
+                        ck = row_checkpoint(
+                            snap, member_rows[i], fold_key=key,
+                            model_tag=self.model_tag,
+                            age=member_ages[i],
+                            seq=e.request.seq, msa=e.request.msa)
+                        wrote = store.put_row(ck) is not None
+                    except ValueError:
+                        wrote = False
+            if wrote:
+                spilled += 1
+            e.trace.begin("preempt")
+            e.trace.event("preempt_spilled" if wrote
+                          else "preempt_dropped",
+                          recycle=member_ages[i])
+            self.metrics.record_preempted()
+            self._resolve_entry(e, FoldResponse(
+                request_id=e.request.request_id, status="preempted",
+                bucket_len=e.bucket_len, attempts=e.attempts,
+                latency_s=now - e.enqueued_at,
+                recycles=member_ages[i],
+                error=("replica preempted mid-loop; checkpoint "
+                       "spilled for adoption" if wrote else
+                       "replica preempted mid-loop; carry not "
+                       "spillable — refold on a survivor")))
+        gone_ids = {id(e) for e in members}
+        active[:] = []
+        rows[:] = []
+        ages[:] = []
+        all_members[:] = [e for e in all_members
+                          if id(e) not in gone_ids]
+        self._n_preempt_spills += spilled
+        if spilled and self._c_preempt_spills is not None:
+            self._c_preempt_spills.inc(spilled)
+        return spilled
+
     # -- fleet routing ---------------------------------------------------
 
     def _route(self, entry: _Entry, key: str) -> bool:
@@ -2109,8 +2304,12 @@ class Scheduler:
         # outlive the work (ISSUE 18): resumable survivors exist only
         # for folds some ticket still waits on. Requeue/bisection/
         # resume paths never come through here, so their checkpoints
-        # survive for the retry to consume.
-        if self._ckpt_store is not None:
+        # survive for the retry to consume. "preempted" is the one
+        # terminal that KEEPS its checkpoint (ISSUE 20): the fold is
+        # not done, it is migrating — the orphan manifest hands it to
+        # an adopting survivor that resumes from exactly these bytes.
+        if self._ckpt_store is not None \
+                and response.status != "preempted":
             key = self._entry_key(entry)
             if key is not None:
                 try:
@@ -2280,6 +2479,21 @@ class Scheduler:
             stats["outstanding_forwards"] = self._outstanding_forwards
         stats["failovers"] = self._n_failovers
         stats["drains"] = self._n_drains
+        if self._n_preempt_notices:
+            # preemption reclaim (ISSUE 20): key absent until a notice
+            # lands, so scrubbed stats stay identical with the feature
+            # unexercised
+            with self._cond:
+                deadline = self._reclaim_deadline
+                stats["preemption"] = {
+                    "reclaiming": self._reclaiming,
+                    "source": self._reclaim_source,
+                    "notices": self._n_preempt_notices,
+                    "drain_spills": self._n_preempt_spills,
+                    "grace_remaining_s": (
+                        max(0.0, deadline - time.monotonic())
+                        if deadline is not None else 0.0),
+                }
         return stats
 
     # -- worker ----------------------------------------------------------
@@ -2452,6 +2666,12 @@ class Scheduler:
         terminal state and retries are disabled while stopping)."""
         cfg = self.config
         now = time.monotonic()
+        if self._reclaiming:
+            # reclaim mode (ISSUE 20): a preempted process must never
+            # FOUND a batch — work it starts now it cannot finish, and
+            # queued entries resolve "preempted" at stop so their
+            # callers re-fold on a survivor instead
+            return None
         if not stopping and self._breaker is not None \
                 and not self._breaker.allow_execute():
             return None
@@ -3130,6 +3350,21 @@ class Scheduler:
                             # (not can_repack: rows retire in place —
                             # the position -> row map already shrank
                             # above)
+                        # preemption reclaim (ISSUE 20): when the
+                        # announced grace window cannot fit this
+                        # loop's remaining recycles, spilling NOW
+                        # beats finishing never — checkpoint every
+                        # row, resolve "preempted" (the checkpoints
+                        # survive _resolve_entry for adoption), and
+                        # leave the loop
+                        if active and self._reclaiming \
+                                and not self._reclaim_fits(
+                                    bucket_len, ages, num_recycles):
+                            self._preempt_spill_loop(
+                                bucket_len, state, active, rows,
+                                ages, all_members)
+                            if not active:
+                                break
                         # bulk yield (ISSUE 18): under online burn,
                         # bulk rows checkpoint-and-yield at this gap —
                         # spill to the durable store, requeue as
@@ -3648,6 +3883,12 @@ class Scheduler:
         Mutates active/rows/ages/all_members in place for the admitted
         entries; returns (batch, state, admitted)."""
         cfg = self.config
+        if self._reclaiming:
+            # reclaim mode (ISSUE 20): stop admitting rows — a row
+            # admitted now restarts at recycle 0 inside a process that
+            # is about to die; the pending entry is worth more resolved
+            # "preempted" so its caller re-folds on a survivor
+            return batch, state, []
         occupied = set(rows)
         free = [k for k in range(cfg.max_batch_size)
                 if k not in occupied]
@@ -4120,7 +4361,9 @@ class Scheduler:
         """Per-row poison isolation for a row-attributed DETERMINISTIC
         failure (ISSUE 14): when the exception names the batch rows it
         came from (`exc.rows` — content-addressed chaos does; real XLA
-        errors do not and fall back to bisection), quarantine exactly
+        payloads go through the `serve.xla_errors` attribution parser,
+        ISSUE 20, and fall back to bisection only when the message
+        names no row), quarantine exactly
         those entries (a deterministic single-row attribution IS the
         proof — same standard as the batch-of-1 bisection terminal),
         resolve them "poisoned", scrub their rows from the batch
@@ -4132,6 +4375,12 @@ class Scheduler:
         if retry is None or not getattr(retry, "row_isolation", False):
             return None
         bad_rows = getattr(exc, "rows", None)
+        if not bad_rows and getattr(retry, "xla_classify", False):
+            # real XLA payloads carry no .rows — fall back to parsing
+            # the message for a named batch position (ISSUE 20); ()
+            # keeps the legacy bisection path
+            from alphafold2_tpu.serve.xla_errors import attributed_rows
+            bad_rows = attributed_rows(repr(exc)) or None
         if not bad_rows or retry.is_transient(exc):
             return None
         bad = {int(x) for x in bad_rows}
@@ -4762,6 +5011,19 @@ class Scheduler:
 
     def _cancel_remaining(self):
         leftovers = self._drain_all_entries()
+        if self._reclaiming:
+            # reclaim stop (ISSUE 20): queued work that never founded
+            # resolves "preempted" — a RETRIABLE terminal the fleet
+            # client fails over on immediately, and whose spilled
+            # checkpoint (for requeued mid-loop yields) survives for
+            # the adopting replica to resume
+            self.metrics.record_preempted(len(leftovers))
+            for e in leftovers:
+                self._resolve_entry(e, FoldResponse(
+                    request_id=e.request.request_id, status="preempted",
+                    bucket_len=e.bucket_len, attempts=e.attempts or 1,
+                    error="replica preempted before folding"))
+            return
         self.metrics.record_cancelled(len(leftovers))
         for e in leftovers:
             self._resolve_entry(e, FoldResponse(

@@ -1,0 +1,123 @@
+"""Classify XLA/TPU error payloads: transient vs deterministic, with
+best-effort per-row attribution (ISSUE 20, ROADMAP open item).
+
+The retry stack so far classifies failures with a flat marker list
+(`RetryPolicy.transient_markers`: substring match over `repr(exc)`).
+That works for the gRPC-style status prefixes JAX surfaces
+(RESOURCE_EXHAUSTED, DEADLINE_EXCEEDED, UNAVAILABLE) but has no opinion
+on the rest of the zoo a real TPU serving fleet sees — program aborts,
+`Check failed:` CHECK crashes, TPU halt messages, compile-time
+INVALID_ARGUMENTs — and it can never attribute a failure to specific
+batch rows, so every opaque deterministic failure pays the full batch
+bisection.
+
+This module is a pure-function parser over the error PAYLOAD STRING
+(`repr(exc)` or a captured log line); it imports nothing heavy and
+raises never. Three verdicts:
+
+- transient: worth retrying in place (capacity/queueing trouble —
+  RESOURCE_EXHAUSTED allocation failures, ABORTED slice halts from a
+  maintenance event, transport resets);
+- deterministic: retrying the same bytes reproduces it (shape/dtype
+  INVALID_ARGUMENT, FAILED_PRECONDITION, CHECK failures, program
+  aborts, non-finite detections) — the batch-bisection / row-isolation
+  path should run instead of the retry loop;
+- no opinion (`classify` returns None): the payload matches no known
+  shape; the caller keeps its legacy default.
+
+Row attribution: many XLA/runtime messages name the offending batch
+position ("batch index 3", "row=2", "at batch row 5: non-finite").
+`attributed_rows` extracts them so the scheduler's existing
+`FaultInjected.rows`-style isolation path (quarantine + retire exactly
+those rows, survivors keep stepping) works on REAL errors, not just
+injected ones.
+
+Wiring (default-on but inert): `RetryPolicy.is_transient` consults
+`classify` only AFTER the legacy marker list has no opinion, so every
+payload the markers already decide keeps its exact legacy verdict; and
+`Scheduler._isolate_poison_rows` falls back to `attributed_rows` only
+when the exception carries no explicit `.rows`. With neither novel
+payloads nor row_isolation in play, behavior and stats are
+byte-identical.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+# -- payload shapes ------------------------------------------------------
+
+# transient: capacity or infrastructure trouble — the same bytes may
+# well succeed on retry (in place or elsewhere). Ordered: first match
+# wins, so more specific shapes precede generic status codes.
+_TRANSIENT_SHAPES: Tuple[Tuple[str, str], ...] = (
+    (r"resource[_ ]exhausted", "resource_exhausted"),
+    (r"out of memory allocating", "hbm_oom"),
+    (r"failed to allocate request", "hbm_oom"),
+    (r"deadline[_ ]exceeded", "deadline_exceeded"),
+    (r"\bunavailable\b", "unavailable"),
+    (r"\baborted\b", "aborted"),
+    (r"connection reset", "connection_reset"),
+    (r"socket closed", "connection_reset"),
+    (r"tpu.{0,40}(?:maintenance|terminated|preempt)", "tpu_reclaim"),
+    (r"slice health", "slice_health"),
+)
+
+# deterministic: the program or its inputs are wrong — retrying the
+# same batch reproduces the failure; isolation/bisection should run.
+_DETERMINISTIC_SHAPES: Tuple[Tuple[str, str], ...] = (
+    (r"invalid[_ ]argument", "invalid_argument"),
+    (r"failed[_ ]precondition", "failed_precondition"),
+    (r"out[_ ]of[_ ]range", "out_of_range"),
+    (r"unimplemented", "unimplemented"),
+    (r"check failed", "check_failed"),
+    (r"program (?:abort|halt)", "program_abort"),
+    (r"tpu program (?:abort|halt)", "program_abort"),
+    (r"core halted", "program_abort"),
+    (r"halt(?:ed|ing)? unexpectedly", "program_abort"),
+    (r"\bnan\b|non-?finite", "non_finite"),
+    (r"internal: .{0,80}(?:hlo|xla)", "xla_internal"),
+)
+
+# row attribution: "batch index 3", "batch row 5", "row=2", "row: 7"
+_ROW_RE = re.compile(
+    r"(?:batch(?:\s+index|\s+row)?|row)[ =:]+(\d+)", re.IGNORECASE)
+
+
+@dataclass(frozen=True)
+class XlaErrorClass:
+    """One classified payload: retryable or not, why, and (best-effort)
+    which batch rows the runtime blamed."""
+
+    transient: bool
+    reason: str
+    rows: Tuple[int, ...] = ()
+
+
+def attributed_rows(payload: str) -> Tuple[int, ...]:
+    """Batch rows the payload names, sorted and deduplicated; () when
+    the message attributes nothing (most real XLA errors)."""
+    try:
+        return tuple(sorted({int(m) for m in _ROW_RE.findall(payload)}))
+    except Exception:
+        return ()
+
+
+def classify(payload: str) -> Optional[XlaErrorClass]:
+    """Classify one error payload string; None = no opinion (caller
+    keeps its legacy default). Never raises."""
+    try:
+        low = payload.lower()
+    except Exception:
+        return None
+    for pattern, reason in _TRANSIENT_SHAPES:
+        if re.search(pattern, low):
+            return XlaErrorClass(transient=True, reason=reason,
+                                 rows=attributed_rows(payload))
+    for pattern, reason in _DETERMINISTIC_SHAPES:
+        if re.search(pattern, low):
+            return XlaErrorClass(transient=False, reason=reason,
+                                 rows=attributed_rows(payload))
+    return None

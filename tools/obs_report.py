@@ -87,7 +87,8 @@ STAGE_ORDER = ("reconcile", "featurize", "submit", "forward", "rpc",
                "queue", "parked", "retry", "drain", "batch_form",
                "shard", "compile", "fold", "recycle", "admit",
                "watchdog", "resume", "writeback", "peer_fetch",
-               "peer_serve", "cache_lookup", "write")
+               "peer_serve", "cache_lookup", "write", "preempt",
+               "adopt")
 
 # span/trace boundary slack: start_s, dur_s, and duration_s are each
 # INDEPENDENTLY rounded to 1e-6 when emitted, so a span auto-closed at

@@ -131,6 +131,15 @@ def parse_args(argv=None):
     ap.add_argument("--proc-drain-at", type=float, default=0.0,
                     help="rolling drain-restart (SIGTERM -> exit 0 -> "
                          "respawn) one replica after this fraction")
+    ap.add_argument("--preempt-at", type=float, default=0.0,
+                    help="spot-preempt one replica (ISSUE 20: notice "
+                         "file, grace-budgeted drain + orphan "
+                         "manifest, then kill -9) after this fraction "
+                         "of the budget; arms "
+                         "ProcFleet(preemption=True)")
+    ap.add_argument("--preempt-grace-s", type=float, default=5.0,
+                    help="grace window between the preemption notice "
+                         "and the hard kill")
     ap.add_argument("--controller", action="store_true",
                     help="CONTROL PLANE (ISSUE 16, --procs only): arm "
                          "FleetController on the ProcFleet — the "
@@ -2114,7 +2123,9 @@ def _run_procs(args) -> int:
     """--procs N: drive a REAL multi-process fleet (fleet.procfleet)
     over HTTP with driver-side failover, inducing the --proc-* chaos
     schedule mid-run: one kill -9 + restart, one network partition,
-    one rolling drain-restart, plus an optional fleet-wide rollout.
+    one rolling drain-restart, one spot preemption (--preempt-at:
+    notice -> grace-budgeted drain -> kill -9, orphans adopted by the
+    controller), plus an optional fleet-wide rollout.
     One JSON line, `"metric": "serve_loadtest_procs"`. With --smoke:
     FAILS unless every request (chaos notwithstanding) reached an ok
     terminal state, zero requests were lost, the drained replica
@@ -2157,6 +2168,7 @@ def _run_procs(args) -> int:
             eager_form=args.eager_form)),
         slo=args.slo, slo_window_s=args.slo_window_s,
         key_log=bool(args.controller),
+        preemption=bool(args.preempt_at),
         controller=(None if not args.controller else dict(
             {"min_replicas": args.scale_min} if args.scale_min else {},
             **({"max_replicas": args.scale_max}
@@ -2255,10 +2267,14 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
     kill_at = _trigger(args.proc_kill_at)
     part_at = _trigger(args.proc_partition_at)
     drain_at = _trigger(args.proc_drain_at)
+    preempt_at = _trigger(args.preempt_at)
     bump_at = _trigger(args.rollout_at)
     kill_victim = n - 1
     part_victim = 1 % n
     drain_victim = 0
+    # the preempt victim dodges the kill victim when both are armed
+    # (a preempted-then-killed process would test neither verb)
+    preempt_victim = max(0, n - 1 - (1 if kill_at else 0))
     events = []
     events_lock = threading.Lock()
     fired = set()
@@ -2326,6 +2342,43 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
         _note("partition", at_request=i, replica=part_victim,
               duration_s=args.proc_partition_s)
         fleet.partition(part_victim, args.proc_partition_s)
+
+    preempt_box = {"rc": None, "orphans": None}
+
+    def _do_preempt(i):
+        # spot reclaim (ISSUE 20): notice + timer kill via the fleet
+        # verb; NO driver restart either way — with the controller on,
+        # quorum restore replaces the member, and without it the
+        # survivors absorb the traffic through client failover. The
+        # victim's own exit line reports what it spilled.
+        _note("preempt", at_request=i, replica=preempt_victim,
+              grace_s=args.preempt_grace_s)
+        h = fleet.replicas[preempt_victim]
+        fleet.preempt(preempt_victim, grace_s=args.preempt_grace_s)
+
+        def _reap():
+            try:
+                rc = h.proc.wait(args.preempt_grace_s + 120)
+            except Exception:
+                return
+            preempt_box["rc"] = rc
+            try:
+                with open(h.log_path) as fh:
+                    for line in fh:
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            continue
+                        if rec.get("preempted"):
+                            preempt_box["orphans"] = rec.get("orphans")
+            except OSError:
+                pass
+            _note("preempted", rc=preempt_box["rc"],
+                  orphans=preempt_box["orphans"])
+
+        t = threading.Thread(target=_reap, daemon=True)
+        restart_threads.append(t)   # joined before the truth snapshot
+        t.start()
 
     def _do_drain(i):
         # burst a few submits straight at the victim so the drain has
@@ -2429,6 +2482,8 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
                           epochs=fleet.rollout(rolled_tag))
             if drain_at and i == drain_at:
                 _fire("drain", i, _do_drain)
+            if preempt_at and i == preempt_at:
+                _fire("preempt", i, _do_preempt)
             _submit_one(i)
 
     # --traffic-wave F0:F1:MULT: while the shared counter sits inside
@@ -2602,7 +2657,11 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
     for i, h in enumerate(fleet.replicas):
         snap = fleet.stats(i)
         hz = fleet.healthz(i)
-        if not controller_on or (hz and hz.get("running")):
+        # a dead handle is an expected shape under the controller (the
+        # kill victim stays dead) and for the preempt victim (reclaimed
+        # for real; only a controller-spawned replacement succeeds it)
+        dead_ok = controller_on or (preempt_at and i == preempt_victim)
+        if not dead_ok or (hz and hz.get("running")):
             tags[h.replica_id] = (hz or {}).get("model_tag") or \
                 (hz or {}).get("tag")
         if snap is None:
@@ -2699,8 +2758,17 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
         "events": events,
         "per_replica": per_replica,
         "span_counts": {k: span_counts[k]
-                        for k in ("rpc", "drain", "forward", "fold")
+                        for k in ("rpc", "drain", "forward", "fold",
+                                  "preempt", "adopt")
                         if k in span_counts},
+        "preemption": (None if not preempt_at else {
+            "victim": preempt_victim,
+            "grace_s": args.preempt_grace_s,
+            "exit_code": preempt_box["rc"],
+            "orphans": preempt_box["orphans"],
+            "adoptions": (None if ctrl_snap is None
+                          else ctrl_snap.get("orphan_adoptions")),
+        }),
         "trace_path": args.trace_path or None,
         "slo": slo_report,
         "slo_gauges_scraped": scraped_slo_gauges,
@@ -2732,6 +2800,30 @@ def _drive_procs(args, fleet, run_dir, model_tag, rolled_tag) -> int:
         problems.append(f"drained replica exited {drain_rc[0]}, not 0")
     if kill_at and "killed" not in {e["event"] for e in events}:
         problems.append("kill never fired")
+    if preempt_at:
+        if "preempted" not in {e["event"] for e in events}:
+            problems.append("preempt armed but the victim never "
+                            "exited inside the reap window")
+        elif preempt_box["rc"] != 0:
+            problems.append(
+                f"preempted replica exited {preempt_box['rc']}, not 0 "
+                f"(the grace-budgeted drain should beat the hard "
+                f"kill)")
+        orphans_n = preempt_box["orphans"] or 0
+        ads = ((ctrl_snap or {}).get("orphan_adoptions") or {})
+        if controller_on and orphans_n and not ads.get("adopted"):
+            problems.append(
+                f"{orphans_n} orphans published but the controller "
+                f"adopted none (expected active /admin/adopt "
+                f"assignment, not lazy peer probes)")
+        if tracer is not None and orphans_n \
+                and not span_counts.get("preempt"):
+            problems.append("orphans spilled but no preempt spans in "
+                            "the merged traces")
+        if tracer is not None and ads.get("adopted") \
+                and not span_counts.get("adopt"):
+            problems.append("controller adoptions landed but no adopt "
+                            "spans in the merged traces")
     if stale_tag_hits:
         problems.append(f"{stale_tag_hits} stale-tag peer hits")
     bad_tags = {r: t for r, t in tags.items() if t != expected_tag}

@@ -174,6 +174,15 @@
 #      (including the replica-identity pins), and cache_warm
 #      --from-serve-log can rebuild a warm profile from the run's own
 #      keys.jsonl telemetry. The fleet-runs-itself tripwire.
+#  18. spot-preemptible serving (ISSUE 20, --preempt-at): a
+#      3-process fleet + controller loses one replica to a real spot
+#      reclaim (notice -> grace-budgeted drain -> kill -9). FAILS
+#      unless the victim spills what the grace window can't fit,
+#      publishes its orphan manifest, and exits 0 before the kill;
+#      the controller adopts EVERY orphan onto a survivor through
+#      POST /admin/adopt; 0 folds are lost; and preempt/adopt spans
+#      are present with obs_report --check clean. The spot-reclaim
+#      tripwire.
 #   7. multi-chip mesh serving (--mesh-policy, serve.MeshPolicy) under
 #      XLA_FLAGS=--xla_force_host_platform_device_count=8: a mixed
 #      short+long workload where the long bucket is pinned to a 4-chip
@@ -206,7 +215,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 DURATION="${SMOKE_DURATION_S:-30}"
-PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17}"
+PHASES="${SMOKE_PHASES:-1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18}"
 
 phase_on() {
     case ",${PHASES}," in
@@ -1529,5 +1538,92 @@ print(f"CASCADE SMOKE OK: {c['draft_accepted']} drafts accepted / "
       f"{onl['p99_s']}s, "
       f"{c['accel_seconds_per_accepted']} accel-seconds per "
       f"accepted fold", file=sys.stderr)
+EOF
+fi
+
+# phase 18: spot-preemptible serving (ISSUE 20) — a 3-process fleet
+# with the preemption knob + FleetController loses one replica to a
+# REAL spot reclaim mid-campaign: the preempt() verb delivers a
+# notice file, the victim's PreemptionWatcher flips its scheduler
+# into reclaim mode, the grace-budgeted drain spills every mid-loop
+# fold the window can't fit (num-recycles is deliberately far larger
+# than the grace window buys, so the spill-over-finish decision MUST
+# fire), the orphan manifest lands in the shared backend, the victim
+# exits 0 BEFORE the hard kill -9, and the controller actively
+# assigns the orphans to a least-loaded survivor through
+# POST /admin/adopt. FAILS unless every request resolves ok with 0
+# lost (the survivors + client fast failover absorb the window),
+# the victim exited 0, >= 1 orphan was spilled AND every orphan was
+# adopted by controller assignment (not lazy peer probes), preempt +
+# adopt spans are present in the merged traces, and obs_report
+# --check is clean over them. The spot-reclaim tripwire.
+if phase_on 18; then
+rm -rf /tmp/serve_smoke_preempt
+rm -f /tmp/serve_smoke_preempt_traces.jsonl
+
+timeout -k 10 600 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/serve_loadtest.py \
+    --smoke \
+    --procs 3 \
+    --controller \
+    --scale-min 3 \
+    --scale-max 5 \
+    --preempt-at 0.4 \
+    --preempt-grace-s 3 \
+    --requests 36 \
+    --lengths 48,96 \
+    --buckets 64,128 \
+    --msa-depth 3 \
+    --max-batch 2 \
+    --concurrency 3 \
+    --deadline-s 180 \
+    --num-recycles 32 \
+    --proc-run-dir /tmp/serve_smoke_preempt \
+    --trace-path /tmp/serve_smoke_preempt_traces.jsonl \
+    > /tmp/serve_smoke_preempt.json
+cat /tmp/serve_smoke_preempt.json
+
+timeout -k 10 120 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    python tools/obs_report.py /tmp/serve_smoke_preempt_traces.jsonl \
+    --check --json > /tmp/serve_smoke_preempt_obs.json
+
+env -u PYTHONPATH python - <<'EOF'
+import json, sys
+run = json.load(open("/tmp/serve_smoke_preempt.json"))
+obs = json.load(open("/tmp/serve_smoke_preempt_obs.json"))
+problems = []
+pre = run.get("preemption") or {}
+if run.get("lost", 0):
+    problems.append(f"{run['lost']} LOST requests")
+if pre.get("exit_code") != 0:
+    problems.append(f"victim exited {pre.get('exit_code')}, not 0 "
+                    f"(grace drain should beat the kill -9)")
+orphans = pre.get("orphans") or 0
+if orphans < 1:
+    problems.append("no orphans spilled — the grace window fit the "
+                    "whole backlog and the spill decision never ran")
+ads = pre.get("adoptions") or {}
+if ads.get("adopted", 0) < orphans:
+    problems.append(f"{ads.get('adopted', 0)}/{orphans} orphans "
+                    f"adopted by the controller")
+if not (ads.get("by_source") or {}):
+    problems.append("no adoption source recorded (expected notice "
+                    "or sweep)")
+spans = run.get("span_counts") or {}
+if orphans and not spans.get("preempt"):
+    problems.append("no preempt spans in the merged traces")
+if ads.get("adopted") and not spans.get("adopt"):
+    problems.append("no adopt spans in the merged traces")
+if obs.get("problems"):
+    problems.append(f"obs_report check: {obs['problems'][:3]}")
+if problems:
+    print("PREEMPT SMOKE FAIL: " + "; ".join(problems),
+          file=sys.stderr)
+    sys.exit(1)
+print(f"PREEMPT SMOKE OK: victim exited 0 inside "
+      f"{pre.get('grace_s')}s grace, {orphans} orphan(s) spilled "
+      f"and {ads.get('adopted')} adopted via "
+      f"{list((ads.get('by_source') or {}).keys())}, 0 lost folds, "
+      f"preempt/adopt spans present", file=sys.stderr)
 EOF
 fi
